@@ -1,0 +1,95 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capabilities of DeepSpeed (reference: /root/reference, v0.15.5), built on
+JAX/XLA/Pallas/pjit rather than torch/CUDA/NCCL.
+
+Top-level API mirrors ``deepspeed/__init__.py``:
+  - ``initialize(...)`` -> (engine, optimizer, dataloader, lr_scheduler)
+  - ``init_inference(...)`` -> InferenceEngine
+  - ``comm`` — collectives facade
+  - ``zero`` — ZeRO sharding utilities
+"""
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from . import comm  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .parallel.mesh import MeshTopology, TopologyConfig, get_topology, set_topology  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               mesh_param=None,
+               config_params=None):
+    """Initialize the engine (reference: deepspeed/__init__.py:69).
+
+    `model` may be a deepspeed_tpu Model (models/base.py), a flax Module,
+    or an (init_fn, apply_fn) pair. Returns a tuple of
+    ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    try:
+        from .runtime.engine import DeepSpeedEngine
+        from .runtime.pipe.module import PipelineModule
+    except ModuleNotFoundError as e:  # pragma: no cover
+        raise NotImplementedError(
+            f"deepspeed_tpu.initialize requires {e.name}, which is not built "
+            "yet in this checkout") from e
+
+    config = config if config is not None else config_params
+    if isinstance(model, PipelineModule):
+        from .runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(
+            model=model, optimizer=optimizer, config=config,
+            training_data=training_data, lr_scheduler=lr_scheduler,
+            collate_fn=collate_fn, mpu=mpu or model.topology(), args=args)
+    else:
+        engine = DeepSpeedEngine(
+            args=args, model=model, optimizer=optimizer,
+            model_parameters=model_parameters, training_data=training_data,
+            lr_scheduler=lr_scheduler, mpu=mpu, config=config,
+            collate_fn=collate_fn, mesh_param=mesh_param)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build an inference engine (reference: deepspeed/__init__.py:291)."""
+    try:
+        from .inference.engine import InferenceEngine
+        from .inference.config import DeepSpeedInferenceConfig
+    except ModuleNotFoundError as e:  # pragma: no cover
+        raise NotImplementedError(
+            f"deepspeed_tpu.init_inference requires {e.name}, which is not "
+            "built yet in this checkout") from e
+    cfg = DeepSpeedInferenceConfig.from_any(config, **kwargs)
+    return InferenceEngine(model, cfg)
+
+
+def add_config_arguments(parser):
+    """argparse passthrough (reference: deepspeed/__init__.py:268)."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag for user code)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed-TPU json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_hidden())
+    return parser
+
+
+def argparse_hidden():
+    import argparse
+    return argparse.SUPPRESS
+
+
+def default_inference_config():
+    from .inference.config import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().model_dump()

