@@ -1,0 +1,101 @@
+"""Model interface for the TPU runtime.
+
+The reference wraps user-provided ``torch.nn.Module``s; the TPU-native
+equivalent is a functional model: a pytree of parameters plus pure
+``init``/``apply``/``loss`` functions. The engine only relies on this
+protocol, so users can bring flax/haiku modules via thin adapters
+(models/adapters.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Protocol
+
+import jax
+from jax.sharding import PartitionSpec
+
+PyTree = Any
+Rules = list[tuple[str, PartitionSpec]]
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: int | None = None  # None -> MHA
+    max_seq_len: int = 1024
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    # architecture switches
+    norm_type: str = "layernorm"        # layernorm | rmsnorm
+    activation: str = "gelu"            # gelu | swiglu
+    position_embedding: str = "learned"  # learned | rope
+    use_bias: bool = True
+    # numerics
+    param_dtype: Any = None   # set to jnp dtype in __post_init__
+    remat: bool = True
+    attn_impl: str = "reference"  # reference | flash
+
+    def __post_init__(self):
+        import jax.numpy as jnp
+        if self.param_dtype is None:
+            self.param_dtype = jnp.float32
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        """Analytic parameter count (embedding + layers + final norm)."""
+        d, f, v, L = (self.hidden_size, self.intermediate_size,
+                      self.vocab_size, self.num_layers)
+        kv = self.num_kv_heads * self.head_dim
+        attn = d * d + 2 * d * kv + d * d  # wq, wk, wv, wo
+        mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
+        per_layer = attn + mlp + 2 * d
+        embed = v * d + (0 if self.tie_embeddings else v * d)
+        pos = self.max_seq_len * d if self.position_embedding == "learned" else 0
+        return embed + pos + L * per_layer + d
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token (fwd+bwd ~= 6*N + attention term),
+        the standard MFU accounting (used for BASELINE.md §9 MFU)."""
+        n = self.num_params()
+        attn_flops = 12 * self.num_layers * self.hidden_size * seq_len
+        return 6 * n + attn_flops
+
+
+class Model(Protocol):
+    config: ModelConfig
+
+    def init(self, rng: jax.Array) -> PyTree: ...
+
+    def apply(self, params: PyTree, tokens: jax.Array, **kw) -> jax.Array: ...
+
+    def loss(self, params: PyTree, batch: Any, **kw) -> jax.Array: ...
+
+    def partition_rules(self) -> Rules: ...
+
+
+_MODEL_REGISTRY: dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    def deco(cls):
+        _MODEL_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_model_class(name: str):
+    if name not in _MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; available: {sorted(_MODEL_REGISTRY)}")
+    return _MODEL_REGISTRY[name]

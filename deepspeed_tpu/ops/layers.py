@@ -1,0 +1,131 @@
+"""Core neural-net ops, jnp reference implementations.
+
+These are the XLA-fused equivalents of the reference's fused CUDA kernels
+(``csrc/transformer/*_kernels.cu``: gelu/layernorm/softmax/transform). On
+TPU, XLA fuses these elementwise/norm ops into surrounding matmuls; Pallas
+variants (deepspeed_tpu/ops/pallas/) replace the ones XLA can't fuse well
+(flash attention, quantized collectives, fused optimizers).
+
+Everything here is shape-static and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def layer_norm(x, scale, bias, eps: float = 1e-5):
+    """LayerNorm in fp32 accumulations regardless of input dtype
+    (reference kernel: csrc/transformer/normalize_kernels.cu)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(dtype)
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    """RMSNorm (reference kernel: csrc/transformer/inference rms_norm.cu)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * scale).astype(dtype)
+
+
+def gelu(x):
+    """tanh-approximated GELU, matching the reference's gelu kernel
+    (csrc/transformer/gelu_kernels.cu uses the tanh approximation)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def rotary_embedding(seq_len: int, head_dim: int, theta: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute RoPE cos/sin tables [seq, head_dim//2]."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(seq_len)
+    freqs = np.outer(t, inv_freq)
+    return jnp.asarray(np.cos(freqs), dtype), jnp.asarray(np.sin(freqs), dtype)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """Apply rotary embedding. x: [B, S, H, D]; cos/sin: [S_max, D//2] or
+    already-sliced [S, D//2]; positions: optional [B, S] int32 for
+    decode-time offsets (reference kernel: apply_rotary_pos_emb.cu)."""
+    if positions is not None:
+        cos = cos[positions]  # [B, S, D//2]
+        sin = sin[positions]
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        s = x.shape[1]
+        cos = cos[None, :s, None, :]
+        sin = sin[None, :s, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
+                          segment_ids=None, softmax_scale: float | None = None):
+    """Reference attention: q,k,v [B, S, H, D] (k/v may have fewer heads —
+    GQA: H_q % H_kv == 0). Computes in fp32, returns q.dtype.
+
+    This is the jnp fallback; the Pallas flash kernel
+    (ops/pallas/flash_attention.py) is numerically interchangeable.
+    """
+    b, sq, hq, d = q.shape
+    _, skv, hkv, _ = k.shape
+    if softmax_scale is None:
+        softmax_scale = 1.0 / np.sqrt(d)
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * softmax_scale
+    if bias is not None:
+        logits = logits + bias
+    mask = None
+    if causal:
+        qi = jnp.arange(sq)[:, None] + (skv - sq)
+        ki = jnp.arange(skv)[None, :]
+        mask = qi >= ki
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        seg_mask = seg_mask[:, None, :, :]
+        mask = seg_mask if mask is None else (mask[None, None] & seg_mask)
+    if mask is not None:
+        if mask.ndim == 2:
+            mask = mask[None, None]
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def cross_entropy_loss(logits, targets, *, ignore_index: int = -100,
+                       z_loss: float = 0.0):
+    """Mean token cross-entropy in fp32 with optional z-loss.
+
+    logits: [..., V]; targets: [...] int32. Tokens equal to `ignore_index`
+    are masked out of the mean.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(
+        logits, safe_targets[..., None], axis=-1)[..., 0]
+    nll = lse - true_logit
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(lse)
+    nll = jnp.where(valid, nll, 0.0)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / count
