@@ -1,0 +1,79 @@
+"""Adapters: bring non-native models into the Model protocol.
+
+The reference accepts any torch.nn.Module; here we accept flax linen
+modules and plain (init, apply, loss) function triples.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.layers import cross_entropy_loss
+from .base import ModelConfig
+
+
+class FunctionalModel:
+    """Wrap (init_fn, apply_fn[, loss_fn]) into the Model protocol."""
+
+    def __init__(self, init_fn: Callable, apply_fn: Callable,
+                 loss_fn: Optional[Callable] = None, partition_rules=None,
+                 config: ModelConfig | None = None):
+        self._init = init_fn
+        self._apply = apply_fn
+        self._loss = loss_fn
+        self._rules = partition_rules or []
+        self.config = config
+
+    def init(self, rng):
+        return self._init(rng)
+
+    def apply(self, params, *args, **kw):
+        return self._apply(params, *args, **kw)
+
+    def loss(self, params, batch, **kw):
+        if self._loss is not None:
+            return self._loss(params, batch)
+        tokens, targets = batch if not isinstance(batch, dict) \
+            else (batch["tokens"], batch["targets"])
+        logits = self._apply(params, tokens)
+        return cross_entropy_loss(logits, targets)
+
+    def partition_rules(self):
+        return self._rules
+
+
+class FlaxModel(FunctionalModel):
+    """Wrap a flax.linen.Module. The module's __call__ must map tokens to
+    logits; loss defaults to next-token cross entropy."""
+
+    def __init__(self, module, example_tokens=None, loss_fn=None,
+                 partition_rules=None, config=None):
+        self.flax_module = module
+        example = example_tokens if example_tokens is not None \
+            else jnp.zeros((1, 8), jnp.int32)
+
+        def init_fn(rng):
+            return module.init(rng, example)["params"]
+
+        def apply_fn(params, tokens, **kw):
+            return module.apply({"params": params}, tokens, **kw)
+
+        super().__init__(init_fn, apply_fn, loss_fn, partition_rules, config)
+
+
+def wrap_model(model):
+    try:
+        import flax.linen as nn
+        if isinstance(model, nn.Module):
+            return FlaxModel(model)
+    except ImportError:
+        pass
+    if isinstance(model, (tuple, list)) and len(model) in (2, 3):
+        return FunctionalModel(*model)
+    raise TypeError(
+        f"cannot adapt {type(model)!r} into the Model protocol; provide an "
+        "object with init/apply/loss/partition_rules, a flax Module, or an "
+        "(init, apply[, loss]) tuple")

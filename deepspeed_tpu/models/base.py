@@ -53,16 +53,26 @@ class ModelConfig:
         return self.hidden_size // self.num_heads
 
     def num_params(self) -> int:
-        """Analytic parameter count (embedding + layers + final norm)."""
+        """Analytic parameter count (embedding + layers + final norm),
+        matching the trees DecoderLM.init builds exactly."""
         d, f, v, L = (self.hidden_size, self.intermediate_size,
                       self.vocab_size, self.num_layers)
+        nh_d = self.num_heads * self.head_dim
         kv = self.num_kv_heads * self.head_dim
-        attn = d * d + 2 * d * kv + d * d  # wq, wk, wv, wo
+        attn = d * nh_d + 2 * d * kv + nh_d * d  # wq, wk, wv, wo
         mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
-        per_layer = attn + mlp + 2 * d
+        per_layer = attn + mlp + 2 * d  # + ln scales
+        if self.use_bias:
+            per_layer += nh_d + 2 * kv + d  # attn biases
+            per_layer += f + d              # w_up_b, w_down_b
+            if self.activation == "swiglu":
+                per_layer += f              # w_gate_b
+        if self.norm_type == "layernorm":
+            per_layer += 2 * d              # ln biases
         embed = v * d + (0 if self.tie_embeddings else v * d)
         pos = self.max_seq_len * d if self.position_embedding == "learned" else 0
-        return embed + pos + L * per_layer + d
+        final_norm = d + (d if self.norm_type == "layernorm" else 0)
+        return embed + pos + L * per_layer + final_norm
 
     def flops_per_token(self, seq_len: int) -> float:
         """Training FLOPs/token (fwd+bwd ~= 6*N + attention term),

@@ -30,6 +30,9 @@ def gpt2_config(size: str = "125m", **overrides) -> ModelConfig:
 
 @register_model("gpt2")
 class GPT2(DecoderLM):
-    def __init__(self, config: ModelConfig | None = None, size: str = "125m",
-                 **overrides):
-        super().__init__(config or gpt2_config(size, **overrides))
+    def __init__(self, config: ModelConfig | None = None,
+                 size: str | None = None, **overrides):
+        if config is not None and (size is not None or overrides):
+            raise ValueError(
+                "pass either an explicit config or size/overrides, not both")
+        super().__init__(config or gpt2_config(size or "125m", **overrides))

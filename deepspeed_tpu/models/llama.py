@@ -31,6 +31,9 @@ def llama_config(size: str = "7b", **overrides) -> ModelConfig:
 
 @register_model("llama")
 class Llama(DecoderLM):
-    def __init__(self, config: ModelConfig | None = None, size: str = "7b",
-                 **overrides):
-        super().__init__(config or llama_config(size, **overrides))
+    def __init__(self, config: ModelConfig | None = None,
+                 size: str | None = None, **overrides):
+        if config is not None and (size is not None or overrides):
+            raise ValueError(
+                "pass either an explicit config or size/overrides, not both")
+        super().__init__(config or llama_config(size or "7b", **overrides))

@@ -87,6 +87,8 @@ class DecoderLM:
                 "w_up_b": jnp.zeros((c.num_layers, f), dt),
                 "w_down_b": jnp.zeros((c.num_layers, d), dt),
             })
+            if c.activation == "swiglu":
+                layers["w_gate_b"] = jnp.zeros((c.num_layers, f), dt)
         params: dict[str, Any] = {
             "embed": {"tokens": _dense_init(keys[1], (v, d), std, dt)},
             "layers": layers,
@@ -110,6 +112,10 @@ class DecoderLM:
     def embed(self, params: PyTree, tokens: jax.Array,
               positions: jax.Array | None = None) -> jax.Array:
         c = self.config
+        if tokens.shape[-1] > c.max_seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[-1]} exceeds max_seq_len "
+                f"{c.max_seq_len}")
         x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
         if c.position_embedding == "learned":
             if positions is None:
@@ -149,7 +155,12 @@ class DecoderLM:
 
         h = self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
         if c.activation == "swiglu":
-            m = L.silu(h @ p["w_gate"]) * (h @ p["w_up"])
+            gate = h @ p["w_gate"]
+            up = h @ p["w_up"]
+            if c.use_bias:
+                gate = gate + p["w_gate_b"]
+                up = up + p["w_up_b"]
+            m = L.silu(gate) * up
         else:
             up = h @ p["w_up"]
             if c.use_bias:
@@ -197,7 +208,7 @@ class DecoderLM:
             (r"embed/tokens", P("tp", None)),
             (r"embed/positions", P()),
             (r"layers/(wq|wk|wv|w_up|w_gate)$", P(None, None, "tp")),
-            (r"layers/(wq_b|wk_b|wv_b|w_up_b)$", P(None, "tp")),
+            (r"layers/(wq_b|wk_b|wv_b|w_up_b|w_gate_b)$", P(None, "tp")),
             (r"layers/(wo|w_down)$", P(None, "tp", None)),
             (r"layers/(wo_b|w_down_b)$", P()),
             (r"layers/ln\d_(scale|bias)", P()),

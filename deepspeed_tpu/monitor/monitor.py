@@ -1,0 +1,107 @@
+"""Monitoring fan-out (reference: deepspeed/monitor/monitor.py).
+
+``MonitorMaster`` routes event tuples ``(name, value, step)`` to every
+enabled backend: TensorBoard (via flax's summary writer if available), CSV,
+and Weights & Biases (if installed). Backends degrade to no-ops when their
+packages are missing — same behavior as the reference's import guards.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Any, List, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, Any, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        out = config.output_path or "./csv_monitor"
+        os.makedirs(out, exist_ok=True)
+        self.path = os.path.join(out, f"{config.job_name}.csv")
+        self._writer = None
+
+    def write_events(self, events: List[Event]):
+        new = not os.path.exists(self.path)
+        with open(self.path, "a", newline="") as f:
+            w = csv.writer(f)
+            if new:
+                w.writerow(["name", "value", "step"])
+            for name, value, step in events:
+                w.writerow([name, float(value), int(step)])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        try:
+            from flax.metrics import tensorboard
+            path = os.path.join(config.output_path or "./runs",
+                                config.job_name)
+            self.writer = tensorboard.SummaryWriter(path)
+        except Exception as e:  # tensorboard not installed
+            logger.warning(f"tensorboard monitor disabled: {e}")
+
+    def write_events(self, events: List[Event]):
+        if self.writer is None:
+            return
+        for name, value, step in events:
+            self.writer.scalar(name, float(value), int(step))
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.run = None
+        try:
+            import wandb
+            self.run = wandb.init(
+                project=config.project, group=config.group,
+                entity=config.team)
+        except Exception as e:
+            logger.warning(f"wandb monitor disabled: {e}")
+
+    def write_events(self, events: List[Event]):
+        if self.run is None:
+            return
+        import wandb
+        for name, value, step in events:
+            wandb.log({name: float(value)}, step=int(step))
+
+
+class MonitorMaster(Monitor):
+    """reference: monitor.py:30 — rank-0-only fan-out."""
+
+    def __init__(self, ds_config):
+        self.monitors: list[Monitor] = []
+        if jax.process_index() != 0:
+            return
+        if ds_config.tensorboard.enabled:
+            self.monitors.append(TensorBoardMonitor(ds_config.tensorboard))
+        if ds_config.csv_monitor.enabled:
+            self.monitors.append(CSVMonitor(ds_config.csv_monitor))
+        if ds_config.wandb.enabled:
+            self.monitors.append(WandbMonitor(ds_config.wandb))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.monitors)
+
+    def write_events(self, events: List[Event]):
+        for m in self.monitors:
+            m.write_events(events)

@@ -1,0 +1,93 @@
+"""Data loader (reference: engine.deepspeed_io, runtime/dataloader.py).
+
+A minimal repeatable loader over in-memory datasets (arrays, lists of
+samples, or mapping-style datasets with __len__/__getitem__), producing
+global batches sharded over the data axes of the mesh. Curriculum/
+difficulty-based sampling lives in runtime/data_pipeline/.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def default_collate(samples: list[Any]):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(np.stack([s[i] for s in samples])
+                     for i in range(len(first)))
+    return np.stack(samples)
+
+
+class RepeatingLoader:
+    """reference: runtime/dataloader.py RepeatingLoader."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    def __init__(self, dataset, batch_size: int, topology=None,
+                 collate_fn: Optional[Callable] = None, seed: int = 0,
+                 shuffle: bool = True, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.topology = topology
+        self._epoch = 0
+        if topology is not None:
+            self._sharding = NamedSharding(
+                topology.mesh, PartitionSpec(topology.batch_axes()))
+        else:
+            self._sharding = None
+
+    def __len__(self):
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self._epoch)
+            rng.shuffle(order)
+        for start in range(0, n - self.batch_size + 1, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            samples = [self.dataset[int(i)] for i in idx]
+            batch = self.collate_fn(samples)
+            yield self._put(batch)
+        self._epoch += 1
+
+    def _put(self, batch):
+        def put(x):
+            x = jnp.asarray(x)
+            if self._sharding is not None:
+                return jax.device_put(x, self._sharding)
+            return x
+        return jax.tree.map(put, batch)

@@ -1,0 +1,56 @@
+"""Dynamic loss scaling for fp16 (reference: runtime/fp16/loss_scaler.py).
+
+State is a small pytree of scalars living inside the jitted train step —
+the TPU translation of ``DynamicLossScaler.update_scale`` called from the
+eager optimizer step. Semantics match the reference: on overflow, halve the
+scale (respecting hysteresis) and skip the step; after ``scale_window``
+consecutive good steps, double it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray        # f32 scalar
+    good_steps: jnp.ndarray   # i32 scalar
+    hysteresis: jnp.ndarray   # i32 scalar
+
+
+def init_loss_scale(config) -> LossScaleState:
+    """config: runtime.config.FP16Config. Static scale (loss_scale>0) is
+    modeled as dynamic with an infinite window and no growth/backoff."""
+    if not config.enabled:
+        scale = 1.0
+    elif config.loss_scale > 0:
+        scale = config.loss_scale
+    else:
+        scale = 2.0 ** config.initial_scale_power
+    return LossScaleState(
+        scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(config.hysteresis, jnp.int32),
+    )
+
+
+def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray, *,
+                      dynamic: bool, scale_window: int, min_scale: float,
+                      hysteresis: int) -> LossScaleState:
+    if not dynamic:
+        return state
+    # overflow path: consume hysteresis; halve once it is exhausted
+    hyst_left = jnp.where(overflow, state.hysteresis - 1, state.hysteresis)
+    backoff = overflow & (hyst_left <= 0)
+    new_scale = jnp.where(
+        backoff, jnp.maximum(state.scale / 2.0, min_scale), state.scale)
+    new_hyst = jnp.where(backoff, hysteresis, jnp.maximum(hyst_left, 1))
+    # growth path
+    good = jnp.where(overflow, 0, state.good_steps + 1)
+    grow = good >= scale_window
+    new_scale = jnp.where(grow, new_scale * 2.0, new_scale)
+    good = jnp.where(grow, 0, good)
+    return LossScaleState(scale=new_scale, good_steps=good,
+                          hysteresis=new_hyst)

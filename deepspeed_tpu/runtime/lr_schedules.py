@@ -1,0 +1,161 @@
+"""LR schedules (reference: deepspeed/runtime/lr_schedules.py, 1,050 LoC).
+
+Same five schedules under the reference's config names. Schedules are pure
+``step -> lr`` callables (usable inside jit), not stateful objects; the
+engine exposes a ``.lr_scheduler`` shim with ``step()``/``get_last_lr()``
+for API parity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+Schedule = Callable[[Any], Any]
+
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+ONE_CYCLE = "OneCycle"
+LR_RANGE_TEST = "LRRangeTest"
+
+
+def _to_float(x):
+    return float(x)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log",
+              **_ignored) -> Schedule:
+    """reference: lr_schedules.py WarmupLR (log or linear warmup, then flat)."""
+    import jax.numpy as jnp
+
+    def sched(step):
+        s = jnp.minimum(step + 1, warmup_num_steps)
+        if warmup_type == "log":
+            # matches reference: lr scales with log(step)/log(warmup_steps)
+            frac = jnp.log(s) / math.log(max(warmup_num_steps, 2))
+        else:
+            frac = s / warmup_num_steps
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", **_ignored) -> Schedule:
+    """Warmup then linear decay to zero (reference WarmupDecayLR)."""
+    import jax.numpy as jnp
+    warm = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def sched(step):
+        decay = jnp.clip(
+            (total_num_steps - step) /
+            max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, warm(step),
+                         warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 0.001, **_ignored) -> Schedule:
+    """reference WarmupCosineLR: ratios are relative to the optimizer lr;
+    here warmup_max_lr is the peak."""
+    import jax.numpy as jnp
+
+    def sched(step):
+        warm_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            (step + 1) / max(warmup_num_steps, 1), 0.0, 1.0)
+        progress = jnp.clip((step - warmup_num_steps) /
+                            max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        cos_frac = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (
+            1 + jnp.cos(math.pi * progress))
+        frac = jnp.where(step < warmup_num_steps, warm_frac, cos_frac)
+        return warmup_max_lr * frac
+
+    return sched
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: int | None = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+              **_ignored) -> Schedule:
+    """reference OneCycle (lr triangle then optional decay); momentum
+    cycling is owned by the optimizer, not modeled here."""
+    import jax.numpy as jnp
+    second = cycle_second_step_size or cycle_first_step_size
+    total = cycle_first_step_size + second
+
+    def sched(step):
+        up = step / max(cycle_first_step_size, 1)
+        down = 1.0 - (step - cycle_first_step_size) / max(second, 1)
+        in_cycle = jnp.where(step < cycle_first_step_size, up,
+                             jnp.clip(down, 0.0, 1.0))
+        lr = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.clip(in_cycle, 0.0, 1.0)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - total, 0) / decay_step_size
+            lr = jnp.where(step > total,
+                           cycle_min_lr / (1.0 + decay_steps * decay_lr_rate), lr)
+        return lr
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False,
+                  **_ignored) -> Schedule:
+    import jax.numpy as jnp
+
+    def sched(step):
+        interval = step / lr_range_test_step_size
+        if lr_range_test_staircase:
+            interval = jnp.floor(interval)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+SCHEDULES = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def build_schedule(name: str | None, params: dict, base_lr: float) -> Schedule:
+    if name is None:
+        return lambda step: base_lr
+    if name not in SCHEDULES:
+        raise ValueError(f"unknown scheduler {name!r}; known: {sorted(SCHEDULES)}")
+    params = dict(params)
+    params.setdefault("warmup_max_lr", base_lr)
+    return SCHEDULES[name](**params)
+
+
+class LRSchedulerShim:
+    """Object-style scheduler for API parity with torch schedulers."""
+
+    def __init__(self, schedule: Schedule, engine):
+        self._schedule = schedule
+        self._engine = engine
+
+    def step(self, *a, **k):
+        pass  # stepping happens inside the jitted train step
+
+    def get_last_lr(self):
+        return [float(self._schedule(self._engine.global_steps))]
+
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, sd):
+        pass

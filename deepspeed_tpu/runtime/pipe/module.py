@@ -1,0 +1,51 @@
+"""PipelineModule (reference: runtime/pipe/module.py:86).
+
+Placeholder shell for the pipeline milestone: holds layer specs and the
+stage topology so ``initialize`` can dispatch to PipelineEngine. The 1F1B
+engine lands in runtime/pipe/engine.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+
+class LayerSpec:
+    """Lazy layer constructor (reference: module.py:30)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """reference: module.py:77 — layers sharing parameters across stages."""
+
+    def __init__(self, key: str, typename: Callable, *args,
+                 tied_weight_attr="weight", **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Declares a stage-partitionable model. Real scheduling lives in
+    PipelineEngine (runtime/pipe/engine.py)."""
+
+    def __init__(self, layers: Sequence[Any], num_stages: int | None = None,
+                 topology=None, loss_fn: Callable | None = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        self.layers = list(layers)
+        self.num_stages = num_stages
+        self._topology = topology
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+
+    def topology(self):
+        return self._topology

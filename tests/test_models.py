@@ -68,10 +68,21 @@ def test_loss_decreases_on_overfit(tiny_gpt2):
     assert float(loss5) < float(loss0)
 
 
-def test_param_count_matches_analytic(tiny_llama):
-    model, params = tiny_llama
-    actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
-    assert actual == model.config.num_params()
+def test_param_count_matches_analytic(tiny_llama, tiny_gpt2):
+    for model, params in (tiny_llama, tiny_gpt2):
+        actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert actual == model.config.num_params()
+
+
+def test_seq_len_overflow_raises(tiny_gpt2):
+    model, params = tiny_gpt2
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        model.apply(params, jnp.zeros((1, 200), jnp.int32))
+
+
+def test_config_size_conflict_raises():
+    with pytest.raises(ValueError, match="not both"):
+        GPT2(config=gpt2_config("tiny"), size="125m")
 
 
 def test_gqa_heads(tiny_llama):
