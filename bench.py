@@ -1,0 +1,91 @@
+"""Benchmark: GPT-2 125M training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is MFU / 0.45 — the north-star MFU target from BASELINE.md §9
+(the reference's headline training-efficiency claim class; e.g. Ulysses
+sustains 54% of peak on A100, BASELINE.md §3).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOPS by device kind (per chip)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # trillium
+    "cpu": 1e12,             # arbitrary floor for CPU smoke runs
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 1e12
+
+
+def main():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    seq = 1024 if on_tpu else 128
+    batch = 32 if on_tpu else 2
+    size = "125m" if on_tpu else "tiny"
+
+    # vocab padded to a multiple of 128 lanes: GPT-2's 50257 fragments the
+    # MXU tiling on the logits matmul (worth ~2x step time at 125M)
+    model = (GPT2(size=size, vocab_size=50304) if on_tpu
+             else GPT2(size=size, max_seq_len=seq))
+    config = {
+        "train_batch_size": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, seq + 1), 0,
+                                model.config.vocab_size)
+    data = (tokens[:, :-1], tokens[:, 1:])
+
+    # warmup/compile (float() forces a device->host sync; plain
+    # block_until_ready can return early under the remote-tunnel backend)
+    float(engine.train_batch(data))
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(data)
+    loss = float(loss)  # device->host copy = reliable sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    flops_per_token = model.config.flops_per_token(seq)
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": "gpt2_125m_train_tokens_per_sec" if on_tpu
+                  else "gpt2_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+    print(f"# mfu={mfu:.3f} loss={float(loss):.4f} step_ms={dt / steps * 1e3:.1f}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
