@@ -107,6 +107,13 @@ class MeshConfig(DeepSpeedConfigModel):
     tp: int = 1
 
 
+class SequenceParallelConfig(DeepSpeedConfigModel):
+    """TPU-native SP config: Ulysses all-to-all (reference
+    deepspeed/sequence) or ring attention (context parallelism, not in the
+    reference). 'auto' = ulysses when mesh.sp > 1."""
+    mode: Literal["auto", "ulysses", "ring"] = "auto"
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -219,6 +226,8 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     activation_checkpointing: ActivationCheckpointingConfig = Field(
         default_factory=ActivationCheckpointingConfig)
     mesh: MeshConfig = Field(default_factory=MeshConfig)
+    sequence_parallel: SequenceParallelConfig = Field(
+        default_factory=SequenceParallelConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)

@@ -139,10 +139,12 @@ class DeepSpeedEngine:
         else:
             self.state = init_jit(params_host)
 
+        # --- sequence parallelism (reference: deepspeed/sequence) -------
+        self._loss_fn = self._configure_sequence_parallel()
+
         # --- compiled step ----------------------------------------------
         self._train_step = self._build_train_step()
-        self._eval_loss = jax.jit(
-            lambda params, batch: self.module.loss(params, batch))
+        self._eval_loss = jax.jit(self._loss_fn)
         self._micro_grads_jit = None
         self._apply_grads_jit = None
         self._accum_grads = None
@@ -180,6 +182,28 @@ class DeepSpeedEngine:
             f"ga={self.gradient_accumulation_steps_})")
 
     # ------------------------------------------------------------------
+    def _configure_sequence_parallel(self):
+        """Choose the loss fn, wrapping attention for SP when mesh.sp > 1."""
+        sp = self.topology.sequence_parallel_size
+        if sp <= 1:
+            return self.module.loss
+        import inspect
+        if "attn_fn" not in inspect.signature(self.module.loss).parameters:
+            raise ValueError(
+                "sequence parallelism (mesh.sp > 1) requires the model's "
+                "loss() to accept attn_fn (DecoderLM does)")
+        mode = self.config.sequence_parallel.mode
+        if mode in ("auto", "ulysses"):
+            from ..sequence.layer import ulysses_attention
+            attn = ulysses_attention(self.mesh)
+        elif mode == "ring":
+            from ..sequence.ring import ring_attention
+            attn = ring_attention(self.mesh)
+        else:
+            raise ValueError(f"unknown sequence_parallel.mode {mode!r}")
+        log_dist(f"sequence parallelism: {mode} over sp={sp}")
+        return functools.partial(self.module.loss, attn_fn=attn)
+
     def _flops_per_sample(self):
         if self.model_config is None:
             return None
@@ -216,13 +240,13 @@ class DeepSpeedEngine:
         mesh = self.mesh
         grad_specs = self.plan.grad_specs
         param_specs = self.plan.param_specs
-        model = self.module
+        loss_fn = self._loss_fn
         tx = self.tx
         mixed = self._mixed
         compute_dtype = self.compute_dtype
 
         def micro_loss(params, batch, scale):
-            loss = model.loss(params, batch)
+            loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
@@ -344,12 +368,15 @@ class DeepSpeedEngine:
                if self.fp16_enabled else ""))
 
     def _put_batch(self, batch):
-        sharding = NamedSharding(
-            self.mesh, PartitionSpec(self.topology.batch_axes()))
+        bat = self.topology.batch_axes()
+        sp = self.topology.sequence_parallel_size
 
         def put(x):
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
-            return jax.device_put(x, sharding)
+            # [batch, seq, ...]: shard seq over sp too when active
+            spec = (PartitionSpec(bat, "sp") if sp > 1 and x.ndim >= 2
+                    else PartitionSpec(bat))
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
 
         return jax.tree.map(put, batch)
 
@@ -371,7 +398,7 @@ class DeepSpeedEngine:
         if self._micro_grads_jit is None:
             def micro(params, batch, scale):
                 def f(p):
-                    return self.module.loss(p, batch) * scale
+                    return self._loss_fn(p, batch) * scale
                 g = jax.grad(f)(params)
                 g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
                 return constrain(g, self.mesh, self.plan.grad_specs)

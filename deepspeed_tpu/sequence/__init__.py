@@ -1,0 +1,3 @@
+from .cross_entropy import vocab_parallel_cross_entropy  # noqa: F401
+from .layer import DistributedAttention, ulysses_attention  # noqa: F401
+from .ring import ring_attention  # noqa: F401

@@ -1,0 +1,60 @@
+"""Vocab-parallel cross entropy (reference: deepspeed/sequence/cross_entropy.py).
+
+When the lm head is tensor-parallel (logits sharded over the vocab dim),
+computing the loss must not all-gather the full [B, S, V] logits. This
+shard_map implementation exchanges only per-token scalars (max, sum, true
+logit) over the tp axis — the explicit form of what the reference's
+vocab_parallel_cross_entropy autograd Function does with two all-reduces.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def vocab_parallel_cross_entropy(logits, targets, mesh: Mesh,
+                                 tp_axis: str = "tp",
+                                 batch_axes=("dp", "fsdp"),
+                                 sp_axis: str = "sp",
+                                 ignore_index: int = -100):
+    """Mean cross entropy over tokens; logits [B, S, V] sharded over
+    tp on the vocab dim, targets [B, S] global ids."""
+    tp = mesh.shape.get(tp_axis, 1)
+    if tp <= 1:
+        from ..ops.layers import cross_entropy_loss
+        return cross_entropy_loss(logits, targets, ignore_index=ignore_index)
+
+    bat = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    sp = sp_axis if mesh.shape.get(sp_axis, 1) > 1 else None
+    logit_spec = P(bat or None, sp, tp_axis)
+    tgt_spec = P(bat or None, sp)
+
+    def body(lg, tg):
+        # lg: [b, s, V/tp] fp32; tg: [b, s]
+        lg = lg.astype(jnp.float32)
+        vshard = lg.shape[-1]
+        rank = lax.axis_index(tp_axis)
+        offset = rank * vshard
+        local_max = jnp.max(lg, axis=-1)
+        gmax = lax.pmax(local_max, tp_axis)
+        sumexp = jnp.sum(jnp.exp(lg - gmax[..., None]), axis=-1)
+        gsum = lax.psum(sumexp, tp_axis)
+        lse = gmax + jnp.log(gsum)
+        # true logit: only the owning shard contributes
+        local_idx = jnp.clip(tg - offset, 0, vshard - 1)
+        owned = (tg >= offset) & (tg < offset + vshard)
+        tl = jnp.take_along_axis(lg, local_idx[..., None], axis=-1)[..., 0]
+        true_logit = lax.psum(jnp.where(owned, tl, 0.0), tp_axis)
+        nll = lse - true_logit
+        valid = tg != ignore_index
+        nll = jnp.where(valid, nll, 0.0)
+        # partial sums; mean finalized outside (sp/batch dims still sharded)
+        return nll, valid.astype(jnp.float32)
+
+    nll, valid = shard_map(
+        body, mesh=mesh, in_specs=(logit_spec, tgt_spec),
+        out_specs=(tgt_spec, tgt_spec), check_vma=False)(logits, targets)
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1.0)

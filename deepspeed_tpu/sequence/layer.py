@@ -1,0 +1,107 @@
+"""Ulysses-style sequence parallelism (reference: deepspeed/sequence/layer.py).
+
+``DistributedAttention`` wraps any local attention: the sequence-sharded
+q/k/v ``[B, S/P, H, D]`` are all-to-all'd into head-sharded, full-sequence
+``[B, S, H/P, D]`` (reference ``_SeqAllToAll``/``single_all_to_all``,
+layer.py:153,216), local attention runs, and the output is all-to-all'd
+back. On TPU the all-to-all is a single XLA collective along the ``sp``
+mesh axis inside ``shard_map`` — comm volume O(S/P) per device, riding ICI.
+
+Composes with tensor parallelism: heads may additionally be sharded over
+``tp`` (in/out specs carry both axes); the all-to-all only trades the sp
+axis. GQA kv-heads that don't divide sp are replicated up front (the
+analogue of the reference's uneven-head support, layer.py:43).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.layers import dot_product_attention
+
+
+def _seq_all_to_all(x, axis_name: str, *, scatter_idx: int, gather_idx: int):
+    """single_all_to_all equivalent: scatter `scatter_idx` dim, gather
+    `gather_idx` dim along the sp axis (reference layer.py:153)."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx,
+                          concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """reference: sequence/layer.py:271 DistributedAttention.
+
+    Args mirror the reference: a local attention callable, the sequence
+    "process group" (mesh + sp axis name), and the scatter/gather dims
+    (default: scatter heads=2, gather seq=1 on [B, S, H, D]).
+    """
+
+    def __init__(self, local_attention: Callable | None = None,
+                 mesh: Mesh | None = None, sp_axis: str = "sp",
+                 scatter_idx: int = 2, gather_idx: int = 1,
+                 batch_axes=("dp", "fsdp"), tp_axis: str = "tp"):
+        self.local_attn = local_attention or dot_product_attention
+        self.mesh = mesh
+        self.sp_axis = sp_axis
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+        self.batch_axes = batch_axes
+        self.tp_axis = tp_axis
+
+    def _specs(self):
+        mesh = self.mesh
+        bat = tuple(a for a in self.batch_axes if mesh.shape.get(a, 1) > 1)
+        tp = self.tp_axis if mesh.shape.get(self.tp_axis, 1) > 1 else None
+        return P(bat or None, self.sp_axis, tp, None)
+
+    def __call__(self, q, k, v, *, causal: bool = True, **kw):
+        mesh = self.mesh
+        sp = mesh.shape.get(self.sp_axis, 1)
+        if sp <= 1:
+            return self.local_attn(q, k, v, causal=causal, **kw)
+        spec = self._specs()
+
+        nq, nkv = q.shape[2], k.shape[2]
+        tp = mesh.shape.get(self.tp_axis, 1)
+        local_q = nq // tp
+        if local_q % sp != 0:
+            raise ValueError(
+                f"q heads per tp shard ({local_q}) must divide sp={sp}")
+        if (nkv // tp if nkv % tp == 0 else nkv) % sp != 0:
+            # uneven kv heads: replicate kv up to q heads (reference
+            # supports uneven head counts; replication is the TPU-simple
+            # equivalent for GQA)
+            rep = nq // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        def body(q, k, v):
+            # local in: [B, S/P, H_local, D]; scatter heads, gather seq
+            q = _seq_all_to_all(q, self.sp_axis,
+                                scatter_idx=self.scatter_idx,
+                                gather_idx=self.gather_idx)
+            k = _seq_all_to_all(k, self.sp_axis,
+                                scatter_idx=self.scatter_idx,
+                                gather_idx=self.gather_idx)
+            v = _seq_all_to_all(v, self.sp_axis,
+                                scatter_idx=self.scatter_idx,
+                                gather_idx=self.gather_idx)
+            o = self.local_attn(q, k, v, causal=causal, **kw)
+            # back: scatter seq, gather heads
+            return _seq_all_to_all(o, self.sp_axis,
+                                   scatter_idx=self.gather_idx,
+                                   gather_idx=self.scatter_idx)
+
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def ulysses_attention(mesh: Mesh, local_attention: Callable | None = None,
+                      **kw) -> Callable:
+    """Convenience: an attn_fn for DecoderLM.apply(..., attn_fn=...)."""
+    da = DistributedAttention(local_attention, mesh, **kw)
+    return lambda q, k, v, causal=True: da(q, k, v, causal=causal)

@@ -1,0 +1,103 @@
+"""Ring attention: context parallelism for long sequences.
+
+Not present in the reference snapshot (SURVEY §2.3: "CP/ring attention not
+present — long-context is Ulysses + sparse attention"); this is a
+capability the TPU build adds. Blockwise causal attention with online
+softmax: k/v blocks rotate around the ``sp`` ring via ``ppermute`` while
+each device keeps its query block — comm volume O(S/P) per step over ICI,
+memory O(S/P * S/P) per block instead of O(S^2).
+
+Math follows the blockwise-parallel-attention recipe (flash-attention
+style log-sum-exp accumulation in fp32).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn_update(q, k, v, m, l, acc, *, scale, mask):
+    """One online-softmax update. q:[B,Sq,H,D] k/v:[B,Sk,H,D]
+    m,l:[B,H,Sq] acc:[B,Sq,H,D]; mask broadcastable to [B,H,Sq,Sk]."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # rows with nothing to attend to yet keep m=-inf; guard the exp
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(mesh: Mesh, sp_axis: str = "sp",
+                   batch_axes=("dp", "fsdp"), tp_axis: str = "tp") -> Callable:
+    """Returns an attn_fn(q, k, v, causal=True) running causal ring
+    attention over the sp mesh axis. Sequence blocks are laid out
+    contiguously in rank order (block r holds tokens [r*S/P, (r+1)*S/P))."""
+
+    def attn(q, k, v, *, causal: bool = True, **_kw):
+        sp = mesh.shape.get(sp_axis, 1)
+        if sp <= 1:
+            from ..ops.layers import dot_product_attention
+            return dot_product_attention(q, k, v, causal=causal)
+        if not causal:
+            raise NotImplementedError("ring attention is causal-only")
+        nq, nkv = q.shape[2], k.shape[2]
+        if nq != nkv:  # GQA: replicate kv to q heads for the ring pass
+            rep = nq // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        bat = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+        tp = tp_axis if mesh.shape.get(tp_axis, 1) > 1 else None
+        spec = P(bat or None, sp_axis, tp, None)
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def body(q, k, v):
+            b, s_loc, h, d = q.shape
+            my = lax.axis_index(sp_axis)
+            dtype_in = q.dtype
+            qf = q.astype(jnp.float32)
+            m0 = jnp.full((b, h, s_loc), -jnp.inf, jnp.float32)
+            l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+            a0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+            qi = jnp.arange(s_loc)[:, None]
+            ki = jnp.arange(s_loc)[None, :]
+
+            def step(i, carry):
+                kb, vb, m, l, acc = carry
+                src = (my - i) % sp  # which seq block kb currently holds
+                # block-level causal structure
+                diag = qi >= ki                       # same block
+                full = jnp.ones((s_loc, s_loc), bool)  # earlier block
+                none = jnp.zeros((s_loc, s_loc), bool)  # later block
+                mask = jnp.where(src == my, diag,
+                                 jnp.where(src < my, full, none))
+                mask = mask[None, None]
+                m, l, acc = _block_attn_update(
+                    qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+                    m, l, acc, scale=scale, mask=mask)
+                kb = lax.ppermute(kb, sp_axis, perm)
+                vb = lax.ppermute(vb, sp_axis, perm)
+                return kb, vb, m, l, acc
+
+            _, _, m, l, acc = lax.fori_loop(0, sp, step, (k, v, m0, l0, a0))
+            l = jnp.maximum(l, 1e-20)
+            out = acc / l.transpose(0, 2, 1)[..., None]
+            return out.astype(dtype_in)
+
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+    return attn
