@@ -1,4 +1,5 @@
 from .base import Model, ModelConfig, get_model_class, register_model  # noqa: F401
 from .gpt2 import GPT2, gpt2_config  # noqa: F401
 from .llama import Llama, llama_config  # noqa: F401
+from .mixtral import Mixtral, mixtral_config  # noqa: F401
 from .transformer import DecoderLM  # noqa: F401

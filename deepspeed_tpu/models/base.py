@@ -36,6 +36,12 @@ class ModelConfig:
     activation: str = "gelu"            # gelu | swiglu
     position_embedding: str = "learned"  # learned | rope
     use_bias: bool = True
+    # MoE (0 experts = dense; reference: deepspeed/moe)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    router_aux_loss_coef: float = 0.01
     # numerics
     param_dtype: Any = None   # set to jnp dtype in __post_init__
     remat: bool = True
@@ -61,6 +67,8 @@ class ModelConfig:
         kv = self.num_kv_heads * self.head_dim
         attn = d * nh_d + 2 * d * kv + nh_d * d  # wq, wk, wv, wo
         mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
+        if self.num_experts > 0:
+            mlp = mlp * self.num_experts + d * self.num_experts  # + gate
         per_layer = attn + mlp + 2 * d  # + ln scales
         if self.use_bias:
             per_layer += nh_d + 2 * kv + d  # attn biases

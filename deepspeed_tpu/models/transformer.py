@@ -154,6 +154,13 @@ class DecoderLM:
         x = x + a
 
         h = self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+        m, aux = self._mlp(p, h)
+        return x + m, aux
+
+    def _mlp(self, p: PyTree, h: jax.Array):
+        """Dense FFN. Returns (out, aux_loss) — MoE subclasses override
+        (aux carries the router load-balancing loss)."""
+        c = self.config
         if c.activation == "swiglu":
             gate = h @ p["w_gate"]
             up = h @ p["w_up"]
@@ -169,7 +176,7 @@ class DecoderLM:
         m = m @ p["w_down"]
         if c.use_bias:
             m = m + p["w_down_b"]
-        return x + m
+        return m, jnp.zeros((), jnp.float32)
 
     def unembed(self, params: PyTree, x: jax.Array) -> jax.Array:
         x = self._norm(x, params["final_norm"]["scale"],
@@ -181,24 +188,34 @@ class DecoderLM:
     # ---------------- apply / loss ----------------
     def apply(self, params: PyTree, tokens: jax.Array, *,
               attn_fn: AttnFn | None = None,
-              positions: jax.Array | None = None) -> jax.Array:
+              positions: jax.Array | None = None,
+              return_aux: bool = False):
         c = self.config
         x = self.embed(params, tokens, positions)
 
         def body(carry, layer_params):
-            return self.block(layer_params, carry, attn_fn=attn_fn,
-                              positions=positions), None
+            x, aux = carry
+            x, layer_aux = self.block(layer_params, x, attn_fn=attn_fn,
+                                      positions=positions)
+            return (x, aux + layer_aux), None
 
         if c.remat:
             body = jax.checkpoint(body, prevent_cse=False)
-        x, _ = jax.lax.scan(body, x, params["layers"])
-        return self.unembed(params, x)
+        (x, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+        logits = self.unembed(params, x)
+        return (logits, aux) if return_aux else logits
 
     def loss(self, params: PyTree, batch: Any, *,
              attn_fn: AttnFn | None = None) -> jax.Array:
         tokens, targets = _unpack_batch(batch)
-        logits = self.apply(params, tokens, attn_fn=attn_fn)
-        return L.cross_entropy_loss(logits, targets)
+        logits, aux = self.apply(params, tokens, attn_fn=attn_fn,
+                                 return_aux=True)
+        ce = L.cross_entropy_loss(logits, targets)
+        return ce + self.aux_loss_coef() * aux
+
+    def aux_loss_coef(self) -> float:
+        return getattr(self.config, "router_aux_loss_coef", 0.0)
 
     # ---------------- sharding ----------------
     def partition_rules(self) -> Rules:

@@ -1,0 +1,2 @@
+from .layer import MoE  # noqa: F401
+from .sharded_moe import moe_ffn, top_k_gating  # noqa: F401

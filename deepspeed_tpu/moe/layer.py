@@ -1,0 +1,81 @@
+"""MoE layer API (reference: deepspeed/moe/layer.py MoE).
+
+The reference's ``MoE`` wraps a user expert module and creates expert
+process groups. Here the equivalent object bundles gate + expert params
+with the routing config; expert parallelism is the ``ep`` axis of the
+engine mesh, so no group bookkeeping is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .sharded_moe import moe_ffn, top_k_gating  # noqa: F401
+
+
+class MoE:
+    """Functional MoE FFN factory.
+
+    Example:
+        moe = MoE(hidden_size=512, ffn_dim=2048, num_experts=8, k=2)
+        params = moe.init(rng)
+        y, aux = moe(params, x)
+    """
+
+    def __init__(self, hidden_size: int, ffn_dim: int, num_experts: int,
+                 k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 activation: str = "gelu", use_residual: bool = False):
+        self.hidden_size = hidden_size
+        self.ffn_dim = ffn_dim
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.min_capacity = min_capacity
+        self.activation = activation
+        self.use_residual = use_residual  # PR-MoE residual expert
+
+    def init(self, rng, dtype=jnp.float32):
+        d, f, e = self.hidden_size, self.ffn_dim, self.num_experts
+        ks = jax.random.split(rng, 5)
+        std = 0.02
+        params = {
+            "router": jax.random.normal(ks[0], (d, e)).astype(dtype) * std,
+            "experts": {
+                "w_up": jax.random.normal(ks[1], (e, d, f)).astype(dtype) * std,
+                "w_down": jax.random.normal(ks[2], (e, f, d)).astype(dtype) * std,
+            },
+        }
+        if self.activation == "swiglu":
+            params["experts"]["w_gate"] = \
+                jax.random.normal(ks[3], (e, d, f)).astype(dtype) * std
+        if self.use_residual:
+            params["residual_mlp"] = {
+                "w_up": jax.random.normal(ks[4], (d, f)).astype(dtype) * std,
+                "w_down": jnp.zeros((f, d), dtype),
+                "coef": jnp.zeros((d, 2), dtype),
+            }
+        return params
+
+    def __call__(self, params, x):
+        out, aux = moe_ffn(
+            x, params["router"], params["experts"], k=self.k,
+            capacity_factor=self.capacity_factor,
+            min_capacity=self.min_capacity, activation=self.activation)
+        if self.use_residual:
+            # PR-MoE: dense residual expert mixed by a learned coefficient
+            r = params["residual_mlp"]
+            h = jax.nn.gelu(x @ r["w_up"], approximate=True) @ r["w_down"]
+            coef = jax.nn.softmax(x @ r["coef"], axis=-1)
+            out = out * coef[..., 0:1] + h * coef[..., 1:2]
+        return out, aux
+
+    def partition_rules(self):
+        return [
+            (r"router", P()),
+            (r"experts/(w_up|w_gate)$", P("ep", None, "tp")),
+            (r"experts/w_down$", P("ep", "tp", None)),
+            (r"residual_mlp", P()),
+        ]

@@ -1,0 +1,118 @@
+"""MoE gating + expert-parallel dispatch (reference: deepspeed/moe/sharded_moe.py).
+
+GShard-style static-shape token routing: top-k gate probabilities become a
+dense combine tensor [N, E, C] (token x expert x capacity-slot); dispatch is
+its boolean support. Tokens beyond an expert's capacity are dropped (the
+residual path carries them, as in the reference's capacity semantics,
+sharded_moe.py:161). Everything is einsum over static shapes, so XLA maps
+dispatch/combine onto the MXU and — with the expert dim sharded over the
+``ep`` mesh axis — inserts the all-to-all the reference issues explicitly
+(_AllToAll, sharded_moe.py:96).
+
+Gating variants: top1 (Switch), top2 (GShard, with normalization), general
+top-k — reference top1gating/top2gating/topkgating (sharded_moe.py:183,
+290,374).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def compute_capacity(num_tokens: int, num_experts: int, k: int,
+                     capacity_factor: float, min_capacity: int = 4) -> int:
+    """reference: sharded_moe.py:161 _capacity."""
+    cap = math.ceil(num_tokens * k / num_experts * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def top_k_gating(logits: jax.Array, k: int, capacity_factor: float = 1.0,
+                 min_capacity: int = 4, normalize_topk: bool = True,
+                 drop_tokens: bool = True):
+    """Compute (combine [N,E,C], dispatch [N,E,C], aux_loss, metrics).
+
+    logits: [N, E] router outputs for N tokens.
+    """
+    n, e = logits.shape
+    capacity = compute_capacity(n, e, k, capacity_factor, min_capacity)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    topk_probs, topk_idx = lax.top_k(probs, k)          # [N, k]
+    if normalize_topk and k > 1:
+        topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+    # slot-major positions: all slot-0 assignments get capacity positions
+    # first (matches reference top2gating's second-expert offset logic)
+    masks = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [N, k, E]
+    mask_flat = masks.transpose(1, 0, 2).reshape(k * n, e)
+    positions = jnp.cumsum(mask_flat, axis=0) - mask_flat  # pos of each entry
+    positions = positions.reshape(k, n, e).transpose(1, 0, 2)  # [N, k, E]
+    pos_per_choice = jnp.sum(positions * masks, axis=-1)   # [N, k]
+
+    if drop_tokens:
+        keep = pos_per_choice < capacity
+    else:
+        keep = jnp.ones_like(pos_per_choice, dtype=bool)
+    gate_w = topk_probs * keep
+
+    # combine[n, e, c] = sum_k gate_w[n,k] * [idx==e] * [pos==c]
+    loc_oh = jax.nn.one_hot(jnp.where(keep, pos_per_choice, capacity),
+                            capacity, dtype=jnp.float32)     # [N, k, C]
+    combine = jnp.einsum("nk,nke,nkc->nec", gate_w, masks.astype(jnp.float32),
+                         loc_oh)
+    dispatch = combine > 0
+
+    # load-balance aux loss (reference: l_aux in top1/top2gating)
+    me = jnp.mean(probs, axis=0)                       # mean router prob
+    ce = jnp.mean(masks[:, 0].astype(jnp.float32), axis=0)  # top1 fraction
+    aux = jnp.sum(me * ce) * e
+
+    metrics = {
+        "capacity": capacity,
+        "drop_fraction": 1.0 - jnp.mean(keep.astype(jnp.float32)),
+        "expert_load": ce,
+    }
+    return combine, dispatch, aux, metrics
+
+
+def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: dict, *,
+            k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
+            activation: str = "swiglu",
+            constrain: Callable | None = None):
+    """Full MoE FFN for a [B, S, D] block input.
+
+    experts: {"w_up": [E, D, F], "w_down": [E, F, D], ("w_gate": [E, D, F])}.
+    With the E dim sharded over the ``ep`` mesh axis, the two einsums below
+    become XLA all-to-alls (dispatch/combine) around expert-local GEMMs.
+    Returns (out [B, S, D], aux_loss).
+    """
+    b, s, d = x.shape
+    n = b * s
+    xt = x.reshape(n, d)
+    logits = xt @ gate_w                                  # [N, E]
+    combine, dispatch, aux, _ = top_k_gating(
+        logits, k, capacity_factor, min_capacity)
+    combine = combine.astype(x.dtype)
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt,
+                           preferred_element_type=x.dtype)
+    if constrain is not None:
+        expert_in = constrain(expert_in)
+    if activation == "swiglu":
+        gate = jnp.einsum("ecd,edf->ecf", expert_in, experts["w_gate"])
+        up = jnp.einsum("ecd,edf->ecf", expert_in, experts["w_up"])
+        h = jax.nn.silu(gate) * up
+    else:
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edf->ecf", expert_in, experts["w_up"]),
+            approximate=True)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, experts["w_down"])
+    if constrain is not None:
+        expert_out = constrain(expert_out)
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return out.reshape(b, s, d), aux
