@@ -46,6 +46,10 @@ PyTree = Any
 class DeepSpeedEngine:
     """Compiled-step training engine over a device mesh."""
 
+    _scan_ga = None  # PipelineEngine pins to 1 (microbatching moves into
+    #                  the pipelined forward itself)
+    _is_pipeline = False
+
     def __init__(self, args=None, model=None, optimizer=None,
                  model_parameters=None, training_data=None, lr_scheduler=None,
                  mpu=None, config=None, collate_fn=None, mesh_param=None,
@@ -70,7 +74,7 @@ class DeepSpeedEngine:
             self.config.resolve_batch_sizes(dp)
 
         # --- model ------------------------------------------------------
-        self.module = _as_model(model)
+        self.module = self._wrap_module(_as_model(model))
         self.model_config: ModelConfig | None = getattr(self.module, "config", None)
         self.compute_dtype = self.config.compute_dtype
         self._mixed = self.compute_dtype != jnp.float32
@@ -110,7 +114,8 @@ class DeepSpeedEngine:
             abstract = jax.eval_shape(self.module.init, rng)
         self.plan = ZeroShardingPlan(
             self.zero_stage, self.mesh, rules, abstract,
-            offload_optimizer=zcfg.offload_optimizer.device == "cpu")
+            offload_optimizer=zcfg.offload_optimizer.device == "cpu",
+            pipeline=self._is_pipeline)
         self._build_state_shardings(abstract)
 
         def _init_state(rng_or_params):
@@ -231,8 +236,11 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # the compiled training step
     # ------------------------------------------------------------------
+    def _wrap_module(self, module):
+        return module
+
     def _build_train_step(self):
-        ga = self.gradient_accumulation_steps_
+        ga = self._scan_ga or self.gradient_accumulation_steps_
         clip = self.config.gradient_clipping
         fp16 = self.fp16_enabled
         fp16_cfg = self.config.fp16

@@ -1,0 +1,5 @@
+from .engine import PipelineEngine  # noqa: F401
+from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .schedule import InferenceSchedule, TrainSchedule  # noqa: F401
+from .topology import (PipeDataParallelTopology,  # noqa: F401
+                       PipeModelDataParallelTopology, ProcessTopology)

@@ -1,0 +1,59 @@
+"""PipelineEngine (reference: runtime/pipe/engine.py:61).
+
+Thin subclass of DeepSpeedEngine: wraps the model in ``PipelinedDecoderLM``
+so the compiled train step runs the whole 1F1B-equivalent pipeline; the
+GAS scan collapses to one pass because microbatching happens *inside* the
+pipelined forward (reference ``train_batch`` pulls gradient_accumulation
+micro-batches per step, pipe/engine.py:338 — same semantics here).
+"""
+
+from __future__ import annotations
+
+from ..engine import DeepSpeedEngine
+from .module import PipelineModule
+from .pipelined_model import PipelinedDecoderLM
+
+
+class PipelineEngine(DeepSpeedEngine):
+    _scan_ga = 1
+    _is_pipeline = True
+
+    def __init__(self, model: PipelineModule, optimizer=None, config=None,
+                 training_data=None, lr_scheduler=None, collate_fn=None,
+                 mpu=None, args=None):
+        if not isinstance(model, PipelineModule):
+            raise TypeError("PipelineEngine requires a PipelineModule")
+        self._pipe_module = model
+        super().__init__(args=args, model=model.model, optimizer=optimizer,
+                         config=config, training_data=training_data,
+                         lr_scheduler=lr_scheduler, collate_fn=collate_fn,
+                         mpu=mpu)
+
+    def _wrap_module(self, module):
+        pp = self.topology.pipe_parallel_size
+        stages = self._pipe_module.num_stages or pp
+        if stages != pp:
+            raise ValueError(
+                f"PipelineModule num_stages={stages} but mesh.pp={pp}")
+        if pp <= 1:
+            return module
+        return PipelinedDecoderLM(
+            module, self.mesh, num_stages=pp,
+            num_microbatches=self.gradient_accumulation_steps_)
+
+    @property
+    def num_stages(self) -> int:
+        return self.topology.pipe_parallel_size
+
+    @property
+    def micro_batches(self) -> int:
+        return self.gradient_accumulation_steps_
+
+    def forward(self, batch):
+        raise NotImplementedError(
+            "PipelineEngine executes full pipelined steps; use "
+            "train_batch()/eval_batch() (reference pipe engine also forbids "
+            "forward/backward/step, pipe/engine.py:214)")
+
+    backward = forward
+    step = forward

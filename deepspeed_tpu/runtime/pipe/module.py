@@ -33,14 +33,31 @@ class TiedLayerSpec(LayerSpec):
 
 
 class PipelineModule:
-    """Declares a stage-partitionable model. Real scheduling lives in
-    PipelineEngine (runtime/pipe/engine.py)."""
+    """Declares a stage-partitionable model.
 
-    def __init__(self, layers: Sequence[Any], num_stages: int | None = None,
+    TPU-native path: pass a DecoderLM-family ``model``; its scan-over-layers
+    stack is partitioned uniformly into ``num_stages`` contiguous groups
+    (the analogue of ``_partition_layers`` with method='uniform',
+    reference module.py:391). Execution is compiled by PipelineEngine /
+    PipelinedDecoderLM — there is no eager per-layer build, so LayerSpec
+    lists (torch-module factories in the reference) are accepted only for
+    API-shape compatibility and must be homogeneous stacks.
+    """
+
+    def __init__(self, layers: Sequence[Any] | None = None,
+                 model: Any = None, num_stages: int | None = None,
                  topology=None, loss_fn: Callable | None = None,
-                 partition_method: str = "parameters",
+                 partition_method: str = "uniform",
                  activation_checkpoint_interval: int = 0):
-        self.layers = list(layers)
+        if model is None and layers is None:
+            raise ValueError("PipelineModule needs model= (preferred) or layers=")
+        if model is None:
+            raise NotImplementedError(
+                "LayerSpec-list pipelines are not supported on the TPU "
+                "build; pass model=<DecoderLM-family model> instead "
+                "(stage partitioning happens on its layer stack)")
+        self.model = model
+        self.layers = list(layers or [])
         self.num_stages = num_stages
         self._topology = topology
         self.loss_fn = loss_fn

@@ -1,0 +1,150 @@
+"""SPMD pipeline parallelism over the ``pp`` mesh axis.
+
+The reference implements pipelining as an eager instruction interpreter
+(runtime/pipe/engine.py:1408 _exec_schedule) with NCCL p2p between stage
+processes. The TPU translation compiles the whole pipeline into one XLA
+program: layers are stacked ``[pp, L/pp, ...]`` with the stage dim manual
+over ``pp`` (everything else — dp/fsdp/tp/sp — stays under GSPMD), and a
+``lax.scan`` over ``M + pp - 1`` ticks moves microbatch activations between
+stages with ``ppermute``. Autodiff through the scan produces the reversed
+pipeline for the backward pass; bubble fraction matches GPipe/1F1B,
+(pp-1)/(M+pp-1).
+
+Embedding and the LM head run *outside* the manual region as ordinary
+GSPMD ops (sharded over batch/tp across all devices), so no stage
+redundantly computes the head matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...models.transformer import _unpack_batch
+from ...ops.layers import cross_entropy_loss
+
+PyTree = Any
+
+
+class PipelinedDecoderLM:
+    """Wrap a DecoderLM-family model for pipeline execution.
+
+    Parameters stay in the original ``[L, ...]`` layout (the engine's
+    sharding plan pins dim 0 of layer stacks to ``pp``); apply() reshapes
+    views to ``[pp, L/pp, ...]`` which is a local no-op under that
+    sharding.
+    """
+
+    def __init__(self, model, mesh, num_stages: int, num_microbatches: int):
+        self.inner = model
+        self.config = model.config
+        self.mesh = mesh
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        L = model.config.num_layers
+        if L % num_stages != 0:
+            raise ValueError(
+                f"num_layers {L} must divide into {num_stages} stages")
+
+    # engine hooks
+    def init(self, rng):
+        return self.inner.init(rng)
+
+    def partition_rules(self):
+        return self.inner.partition_rules()
+
+    def apply(self, params, tokens, *, attn_fn=None, return_aux=False):
+        model = self.inner
+        pp = self.num_stages
+        M = self.num_microbatches
+        mesh = self.mesh
+        B, S = tokens.shape
+        if B % M != 0:
+            raise ValueError(f"batch {B} must divide microbatches {M}")
+        mb = B // M
+        L = model.config.num_layers
+        per_stage = L // pp
+
+        x = model.embed(params, tokens)          # global GSPMD op
+        D = x.shape[-1]
+        x_mb = x.reshape(M, mb, S, D)
+
+        stage_params = jax.tree.map(
+            lambda l: l.reshape(pp, per_stage, *l.shape[1:]),
+            params["layers"])
+
+        def stage_fn(stage_p, h):
+            def body(carry, layer_p):
+                h, aux = carry
+                h, a = model.block(layer_p, h, attn_fn=attn_fn)
+                return (h, aux + a), None
+            if model.config.remat:
+                body = jax.checkpoint(body, prevent_cse=False)
+            (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                                   stage_p)
+            return h, aux
+
+        ticks = M + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def pipe_body(stage_p, x_mb):
+            # manual over pp: leading stage dim is squeezed to local
+            stage_p = jax.tree.map(lambda l: l[0], stage_p)
+            x_mb = x_mb[0]
+            stage = lax.axis_index("pp")
+            state0 = jnp.zeros((mb, S, D), x_mb.dtype)
+            out0 = jnp.zeros((M, mb, S, D), x_mb.dtype)
+
+            def tick(carry, t):
+                state, out, aux = carry
+                inject = jnp.clip(t, 0, M - 1)
+                state = jnp.where(stage == 0, x_mb[inject], state)
+                state, a = stage_fn(stage_p, state)
+                # microbatch m is valid at stage s during ticks [s, s+M)
+                valid = (t >= stage) & (t < stage + M)
+                aux = aux + jnp.where(valid, a, 0.0)
+                write = jnp.clip(t - (pp - 1), 0, M - 1)
+                is_out = (stage == pp - 1) & (t >= pp - 1)
+                out = lax.dynamic_update_slice_in_dim(
+                    out, jnp.where(is_out, state, out[write])[None], write,
+                    axis=0)
+                state = lax.ppermute(state, "pp", perm)
+                return (state, out, aux), None
+
+            (state, out, aux), _ = lax.scan(
+                tick, (state0, out0, jnp.zeros((), jnp.float32)),
+                jnp.arange(ticks))
+            # stack per-stage results on a pp-sharded leading dim; the
+            # caller slices stage -1 / sums aux. (A psum here would be the
+            # obvious reduction, but psum-of-masked-select across a
+            # partial-manual axis hits an XLA partitioner crash — "Invalid
+            # binary instruction opcode copy" — in this jaxlib.)
+            return out[None], aux[None]
+
+        # x_mb rides a pp-sharded leading dim (one copy per stage) so its
+        # cotangent is assembled per-stage; a pp-replicated input would
+        # need a psum-of-masked-select inside the manual region, which
+        # crashes this jaxlib's SPMD partitioner (see note above).
+        x_mb_pp = jnp.broadcast_to(x_mb[None], (pp, *x_mb.shape))
+        pipe = jax.shard_map(
+            pipe_body, mesh=mesh, axis_names={"pp"},
+            in_specs=(jax.tree.map(lambda _: P("pp"), stage_params),
+                      P("pp")),
+            out_specs=(P("pp"), P("pp")), check_vma=False)
+        out, aux = pipe(stage_params, x_mb_pp)
+        out = out[-1]          # last stage holds the real activations
+        aux = jnp.sum(aux) / max(M, 1)
+        logits = model.unembed(params, out.reshape(B, S, D))
+        return (logits, aux) if return_aux else logits
+
+    def loss(self, params, batch, *, attn_fn=None):
+        tokens, targets = _unpack_batch(batch)
+        logits, aux = self.apply(params, tokens, attn_fn=attn_fn,
+                                 return_aux=True)
+        ce = cross_entropy_loss(logits, targets)
+        return ce + self.inner.aux_loss_coef() * aux

@@ -1,0 +1,162 @@
+"""Pipeline schedules (reference: runtime/pipe/schedule.py).
+
+The reference executes these instruction streams eagerly per rank
+(_exec_schedule). On TPU the schedule is *compiled* — the tick loop in
+pipelined_model.py realizes the same dataflow — so these classes exist for
+API parity, introspection, and testing the schedule algebra (what would
+run when on which stage), mirroring TrainSchedule (:189) /
+InferenceSchedule (:135) and the instruction taxonomy (:327-487).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        kv = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({kv})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction): ...
+class ReduceGrads(PipeInstruction): ...
+class ReduceTiedGrads(PipeInstruction): ...
+class LoadMicroBatch(PipeInstruction): ...
+class ForwardPass(PipeInstruction): ...
+class BackwardPass(PipeInstruction): ...
+class SendActivation(PipeInstruction): ...
+class RecvActivation(PipeInstruction): ...
+class SendGrad(PipeInstruction): ...
+class RecvGrad(PipeInstruction): ...
+
+
+class PipeSchedule:
+    """reference: schedule.py:12 — iterable of per-step instruction lists."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def steps(self) -> Iterator[list[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-drain (reference: schedule.py:135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+            if 0 <= micro_batch_id < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id % 2))
+                else:
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id % 2))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id % 2))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro_batch_id % 2))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (reference: schedule.py:189): warmup forwards, steady-state
+    alternating fwd/bwd, cooldown backwards, then reduce+step."""
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+            if self._valid_micro_batch(prev_micro_batch_id):
+                prev_buffer = self._buffer_idx(prev_micro_batch_id)
+                if is_forward:
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(buffer_id=prev_buffer))
+                elif not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=prev_buffer))
+            if self._valid_micro_batch(micro_batch_id):
+                curr_buffer = self._buffer_idx(micro_batch_id)
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(buffer_id=curr_buffer))
+                    else:
+                        cmds.append(RecvActivation(buffer_id=curr_buffer))
+                    cmds.append(ForwardPass(buffer_id=curr_buffer))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(buffer_id=curr_buffer))
+                    cmds.append(BackwardPass(buffer_id=curr_buffer))
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            prev_micro_batch_id = micro_batch_id
+            yield cmds
+
+    def _valid_micro_batch(self, mb: int) -> bool:
+        return 0 <= mb < self.micro_batches
+
+    def _step_to_micro_batch(self, step_id: int):
+        # even steps run forwards on even stages (reference parity)
+        if _is_even(step_id) == _is_even(self.stage_id):
+            micro_batch_id = self._even_step_forward_id(step_id) \
+                if _is_even(step_id) else self._odd_step_forward_id(step_id)
+            is_forward = True
+        else:
+            micro_batch_id = self._even_step_backward_id(step_id) \
+                if _is_even(step_id) else self._odd_step_backward_id(step_id)
+            is_forward = False
+        return micro_batch_id, is_forward
+
+    def _even_step_forward_id(self, step_id):
+        return step_id // 2 - self.stage_id // 2
+
+    def _odd_step_forward_id(self, step_id):
+        return (step_id - 1) // 2 - self.stage_id // 2
+
+    def _even_step_backward_id(self, step_id):
+        return step_id // 2 - self.stages + 1 + self.stage_id // 2
+
+    def _odd_step_backward_id(self, step_id):
+        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def num_pipe_buffers(self) -> int:
+        return max(2, self.stages - self.stage_id)
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0

@@ -1,0 +1,81 @@
+"""Process-grid topology (reference: runtime/pipe/topology.py).
+
+The reference maps ranks to (pipe, data, model) coordinates for NCCL group
+construction. On TPU the mesh IS the topology; these classes provide the
+same coordinate algebra for code that reasons about stage/data coordinates
+(axes order matches ProcessTopology semantics).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import NamedTuple
+
+
+class ProcessTopology:
+    """reference: topology.py ProcessTopology — named-axis rank grid."""
+
+    def __init__(self, axes: list[str], dims: list[int]):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims must align")
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self._coord = NamedTuple("Coord", [(a, int) for a in axes])
+        self.mapping = {}
+        for rank, coord in enumerate(itertools.product(
+                *[range(d) for d in dims])):
+            self.mapping[self._coord(*coord)] = rank
+
+    def get_rank(self, **coords) -> int:
+        key = self._coord(**coords)
+        return self.mapping[key]
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)]
+
+    def world_size(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def get_axis_comm_lists(self, axis: str) -> list[list[int]]:
+        """Rank groups that vary only along `axis` (the reference uses
+        these to build process groups; here they are mesh-axis slices)."""
+        if axis not in self.axes:
+            return []
+        idx = self.axes.index(axis)
+        lists = []
+        other_dims = [range(d) for i, d in enumerate(self.dims) if i != idx]
+        for other in itertools.product(*other_dims):
+            group = []
+            for a in range(self.dims[idx]):
+                coord = list(other)
+                coord.insert(idx, a)
+                group.append(self.mapping[self._coord(*coord)])
+            lists.append(group)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> list[int]:
+        return sorted(
+            rank for coord, rank in self.mapping.items()
+            if all(getattr(coord, k) == v for k, v in filter_kwargs.items()))
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """reference: topology.py PipeDataParallelTopology."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "model", "data"],
+                         dims=[num_pp, num_mp, num_dp])

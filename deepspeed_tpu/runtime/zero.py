@@ -60,25 +60,62 @@ def overlay_axis(spec_tree: PyTree, tree: PyTree, mesh: Mesh,
                         is_leaf=lambda x: isinstance(x, PartitionSpec))
 
 
+def pin_pipeline_axis(spec_tree: PyTree, tree: PyTree, mesh: Mesh,
+                      path_regex: str = r"(^|/)layers/",
+                      axis: str = "pp") -> PyTree:
+    """Put the ``pp`` axis on dim 0 of per-layer stacks (``[L, ...]``), so
+    the pipeline engine's ``[pp, L/pp, ...]`` reshape is shard-local.
+    Applies to any tree whose leaf paths embed the layer path (params,
+    grads, optimizer moments)."""
+    import re
+
+    import jax
+
+    from ..parallel.partition import _path_str
+
+    n = mesh.shape.get(axis, 1)
+    if n <= 1:
+        return spec_tree
+
+    def fix(path, spec, leaf):
+        shape = np.shape(leaf)
+        if (not re.search(path_regex, _path_str(path))
+                or len(shape) == 0 or shape[0] % n != 0):
+            return spec
+        spec_l = list(spec) + [None] * (len(shape) - len(spec))
+        if spec_l[0] is not None:
+            raise ValueError(
+                f"layer-stack dim 0 of {_path_str(path)} already sharded by "
+                f"{spec_l[0]}; cannot pin pipeline axis")
+        spec_l[0] = axis
+        return PartitionSpec(*spec_l)
+
+    return jax.tree_util.tree_map_with_path(
+        fix, spec_tree, tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
 class ZeroShardingPlan:
     """Spec trees for params / grads / master+optimizer state.
 
     ``rules`` are the model's TP partition rules; they are also applied to
     the optimizer-state tree (optax moment paths embed the parameter path,
-    so the same regexes match).
+    so the same regexes match). When the mesh has a pipeline axis, layer
+    stacks are pinned to it first (dim 0), then ZeRO overlays fsdp on the
+    remaining dims.
     """
 
     def __init__(self, stage: int, mesh: Mesh, rules, params: PyTree,
-                 offload_optimizer: bool = False):
+                 offload_optimizer: bool = False, pipeline: bool = False):
         if stage not in (0, 1, 2, 3):
             raise ValueError(f"ZeRO stage must be 0-3, got {stage}")
         self.stage = stage
         self.mesh = mesh
         self.rules = rules
         self.offload_optimizer = offload_optimizer
+        self.pipeline = pipeline and mesh.shape.get("pp", 1) > 1
 
-        base = filter_spec_for_mesh(
-            match_rules(rules, params), mesh, params)
+        base = self._base_specs(params)
         self.param_specs = (overlay_axis(base, params, mesh)
                             if stage >= 3 else base)
         self.grad_specs = (overlay_axis(base, params, mesh)
@@ -86,10 +123,16 @@ class ZeroShardingPlan:
         self.master_specs = (overlay_axis(base, params, mesh)
                              if stage >= 1 else self.param_specs)
 
+    def _base_specs(self, tree: PyTree) -> PyTree:
+        base = filter_spec_for_mesh(match_rules(self.rules, tree), self.mesh, tree)
+        if self.pipeline:
+            base = pin_pipeline_axis(base, tree, self.mesh)
+        return base
+
     def spec_for_tree(self, tree: PyTree, sharded: bool) -> PyTree:
         """Specs for an arbitrary tree (e.g. optax state) whose leaf paths
         embed parameter paths."""
-        base = filter_spec_for_mesh(match_rules(self.rules, tree), self.mesh, tree)
+        base = self._base_specs(tree)
         return overlay_axis(base, tree, self.mesh) if sharded else base
 
     def opt_specs(self, opt_state: PyTree) -> PyTree:
