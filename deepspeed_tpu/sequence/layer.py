@@ -19,7 +19,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.layers import dot_product_attention
@@ -30,6 +30,20 @@ def _seq_all_to_all(x, axis_name: str, *, scatter_idx: int, gather_idx: int):
     `gather_idx` dim along the sp axis (reference layer.py:153)."""
     return lax.all_to_all(x, axis_name, split_axis=scatter_idx,
                           concat_axis=gather_idx, tiled=True)
+
+
+def _shard_map_sp(body, mesh, sp_axis, n_args):
+    """Partial-manual shard_map over just the sp axis. Batch/tp/fsdp
+    sharding stays under GSPMD, which also makes the wrapper nestable
+    inside other manual regions (e.g. the compiled pipeline): when an
+    abstract mesh is already active (inside jit), it is used instead of the
+    concrete one so nested shard_maps agree."""
+    active = jax.sharding.get_abstract_mesh()
+    use = active if (active is not None and active.shape) else mesh
+    spec = P(*([None] * 1), sp_axis)  # [B, S(sp), H, D]: dim1 manual
+    specs = tuple([spec] * n_args)
+    return jax.shard_map(body, mesh=use, axis_names={sp_axis},
+                         in_specs=specs, out_specs=spec, check_vma=False)
 
 
 class DistributedAttention:
@@ -52,18 +66,11 @@ class DistributedAttention:
         self.batch_axes = batch_axes
         self.tp_axis = tp_axis
 
-    def _specs(self):
-        mesh = self.mesh
-        bat = tuple(a for a in self.batch_axes if mesh.shape.get(a, 1) > 1)
-        tp = self.tp_axis if mesh.shape.get(self.tp_axis, 1) > 1 else None
-        return P(bat or None, self.sp_axis, tp, None)
-
     def __call__(self, q, k, v, *, causal: bool = True, **kw):
         mesh = self.mesh
         sp = mesh.shape.get(self.sp_axis, 1)
         if sp <= 1:
             return self.local_attn(q, k, v, causal=causal, **kw)
-        spec = self._specs()
 
         nq, nkv = q.shape[2], k.shape[2]
         tp = mesh.shape.get(self.tp_axis, 1)
@@ -96,8 +103,7 @@ class DistributedAttention:
                                    scatter_idx=self.gather_idx,
                                    gather_idx=self.scatter_idx)
 
-        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+        return _shard_map_sp(body, mesh, self.sp_axis, 3)(q, k, v)
 
 
 def ulysses_attention(mesh: Mesh, local_attention: Callable | None = None,

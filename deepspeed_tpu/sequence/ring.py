@@ -18,7 +18,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -58,9 +58,6 @@ def ring_attention(mesh: Mesh, sp_axis: str = "sp",
             rep = nq // nkv
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        bat = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
-        tp = tp_axis if mesh.shape.get(tp_axis, 1) > 1 else None
-        spec = P(bat or None, sp_axis, tp, None)
         scale = 1.0 / np.sqrt(q.shape[-1])
         perm = [(i, (i + 1) % sp) for i in range(sp)]
 
@@ -97,7 +94,7 @@ def ring_attention(mesh: Mesh, sp_axis: str = "sp",
             out = acc / l.transpose(0, 2, 1)[..., None]
             return out.astype(dtype_in)
 
-        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+        from .layer import _shard_map_sp
+        return _shard_map_sp(body, mesh, sp_axis, 3)(q, k, v)
 
     return attn
