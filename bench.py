@@ -37,13 +37,14 @@ def main():
 
     on_tpu = jax.devices()[0].platform != "cpu"
     seq = 1024 if on_tpu else 128
-    batch = 32 if on_tpu else 2
+    batch = 24 if on_tpu else 2
     size = "125m" if on_tpu else "tiny"
 
     # vocab padded to a multiple of 128 lanes: GPT-2's 50257 fragments the
     # MXU tiling on the logits matmul (worth ~2x step time at 125M)
-    model = (GPT2(size=size, vocab_size=50304) if on_tpu
-             else GPT2(size=size, max_seq_len=seq))
+    model = (GPT2(size=size, vocab_size=50304,
+                  remat_policy="dots_with_no_batch_dims_saveable")
+             if on_tpu else GPT2(size=size, max_seq_len=seq))
     config = {
         "train_batch_size": batch,
         "gradient_accumulation_steps": 1,

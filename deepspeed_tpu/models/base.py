@@ -45,6 +45,8 @@ class ModelConfig:
     # numerics
     param_dtype: Any = None   # set to jnp dtype in __post_init__
     remat: bool = True
+    # jax.checkpoint_policies name; "nothing_saveable" = full recompute
+    remat_policy: str = "nothing_saveable"
     attn_impl: str = "reference"  # reference | flash
 
     def __post_init__(self):

@@ -130,7 +130,12 @@ class DecoderLM:
         (no leading L dim)."""
         c = self.config
         p = layer_params
-        attn_fn = attn_fn or L.dot_product_attention
+        if attn_fn is None:
+            if c.attn_impl == "flash":
+                from ..ops.pallas.flash_attention import flash_attention
+                attn_fn = flash_attention
+            else:
+                attn_fn = L.dot_product_attention
         b, s, d = x.shape
         nh, nkv, hd = c.num_heads, c.num_kv_heads, c.head_dim
 
@@ -200,7 +205,9 @@ class DecoderLM:
             return (x, aux + layer_aux), None
 
         if c.remat:
-            body = jax.checkpoint(body, prevent_cse=False)
+            policy = (None if c.remat_policy == "nothing_saveable"
+                      else getattr(jax.checkpoint_policies, c.remat_policy))
+            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
         (x, aux), _ = jax.lax.scan(
             body, (x, jnp.zeros((), jnp.float32)), params["layers"])
         logits = self.unembed(params, x)

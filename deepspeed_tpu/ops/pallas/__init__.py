@@ -1,0 +1,5 @@
+from .flash_attention import flash_attention  # noqa: F401
+from .fused_optimizers import fused_adam, fused_lion  # noqa: F401
+from .norms import layer_norm, rms_norm  # noqa: F401
+from .quantization import (dequantize_int8, quantize_int8,  # noqa: F401
+                           quantized_all_gather)

@@ -68,6 +68,16 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
     p.pop("torch_adam", None)
     p.pop("fused", None)
     p.pop("amsgrad", None)
+    fused_kernel = p.pop("fused_kernel", False)
+
+    if fused_kernel and name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
+        from ..ops.pallas.fused_optimizers import fused_adam
+        return fused_adam(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
+                          weight_decay=wd)
+    if fused_kernel and name == LION_OPTIMIZER:
+        from ..ops.pallas.fused_optimizers import fused_lion
+        b1, b2 = (betas[0], betas[1]) if betas else (0.9, 0.99)
+        return fused_lion(lr_schedule, b1=b1, b2=b2, weight_decay=wd)
 
     if name == ADAM_OPTIMIZER:
         # reference FusedAdam defaults to adam_w_mode=True; plain adam with

@@ -1,0 +1,130 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.layers import dot_product_attention
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+
+@pytest.mark.parametrize("s,hq,hkv,d", [(128, 4, 4, 32), (256, 4, 2, 64)])
+def test_flash_forward_matches_reference(s, hq, hkv, d):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (2, s, hq, d))
+    k = jax.random.normal(k2, (2, s, hkv, d))
+    v = jax.random.normal(k3, (2, s, hkv, d))
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_non_causal():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (1, 128, 2, 32))
+    k = jax.random.normal(k2, (1, 128, 2, 32))
+    v = jax.random.normal(k3, (1, 128, 2, 32))
+    ref = dot_product_attention(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_grads_match_reference():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(k1, (1, 128, 2, 32))
+    k = jax.random.normal(k2, (1, 128, 2, 32))
+    v = jax.random.normal(k3, (1, 128, 2, 32))
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_flash_bf16():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(k1, (1, 128, 2, 32), jnp.bfloat16)
+    k = jax.random.normal(k2, (1, 128, 2, 32), jnp.bfloat16)
+    v = jax.random.normal(k3, (1, 128, 2, 32), jnp.bfloat16)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_fused_adam_matches_optax():
+    import optax
+    from deepspeed_tpu.ops.pallas.fused_optimizers import fused_adam
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (70, 33)),
+              "b": jnp.zeros((5,))}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(1), (70, 33)),
+             "b": jnp.ones((5,))}
+    tx_ref = optax.adamw(1e-2, weight_decay=0.01)
+    tx_fused = fused_adam(1e-2, weight_decay=0.01)
+    s_ref = tx_ref.init(params)
+    s_f = tx_fused.init(params)
+    p_ref, p_f = params, params
+    for _ in range(3):
+        u_ref, s_ref = tx_ref.update(grads, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, u_ref)
+        u_f, s_f = tx_fused.update(grads, s_f, p_f)
+        p_f = optax.apply_updates(p_f, u_f)
+    for kk in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(p_f[kk]), np.asarray(p_ref[kk]),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_fused_lion_matches_optax():
+    import optax
+    from deepspeed_tpu.ops.pallas.fused_optimizers import fused_lion
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (40, 17))}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(1), (40, 17))}
+    tx_ref = optax.lion(1e-2, weight_decay=0.05)
+    tx_fused = fused_lion(1e-2, weight_decay=0.05)
+    s_ref, s_f = tx_ref.init(params), tx_fused.init(params)
+    p_ref, p_f = params, params
+    for _ in range(3):
+        u_ref, s_ref = tx_ref.update(grads, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, u_ref)
+        u_f, s_f = tx_fused.update(grads, s_f, p_f)
+        p_f = optax.apply_updates(p_f, u_f)
+    np.testing.assert_allclose(np.asarray(p_f["w"]), np.asarray(p_ref["w"]),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_int8_quant_roundtrip():
+    from deepspeed_tpu.ops.pallas.quantization import (dequantize_int8,
+                                                       quantize_int8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (300, 70)) * 3.0
+    q, s, meta = quantize_int8(x)
+    back = dequantize_int8(q, s, meta)
+    assert back.shape == x.shape
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    amax = float(jnp.max(jnp.abs(x)))
+    assert err.max() <= amax / 127.0 + 1e-6
+
+
+def test_pallas_norms_match_reference():
+    from deepspeed_tpu.ops import layers as L
+    from deepspeed_tpu.ops.pallas import norms
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 128))
+    s = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
+    b = jax.random.normal(jax.random.PRNGKey(2), (128,))
+    np.testing.assert_allclose(np.asarray(norms.rms_norm(x, s)),
+                               np.asarray(L.rms_norm(x, s)), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(norms.layer_norm(x, s, b)),
+                               np.asarray(L.layer_norm(x, s, b)), atol=1e-6)
+    # grads flow through the custom vjp
+    g = jax.grad(lambda x: jnp.sum(norms.rms_norm(x, s) ** 2))(x)
+    g_ref = jax.grad(lambda x: jnp.sum(L.rms_norm(x, s) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5)
