@@ -1,0 +1,214 @@
+"""Universal checkpoint: canonical per-param fp32 fragments that load at any
+parallelism degree (reference: deepspeed/checkpoint/ds_to_universal.py —
+extract_zero_shards:112 / merge_tp_slices:232 — and
+universal_checkpoint.py:22 load_hp_checkpoint_state).
+
+On TPU the sharded→canonical merge is far simpler than the reference's:
+orbax checkpoints are already logically-global arrays, so "extract + merge"
+degenerates to: restore as numpy, split the state tree into named per-param
+directories. The value of the format is the same as the reference's —
+an engine with a *different* mesh/topology/optimizer layout can ingest it,
+and external tools can read plain ``.npy`` files.
+
+Layout (mirrors the reference's ``<out>/zero/<param_name>/fp32.pt``):
+
+    <out>/ds_universal_meta.json
+    <out>/zero/<param/name>/fp32.npy
+    <out>/zero/<param/name>/exp_avg.npy      # first param-shaped moment
+    <out>/zero/<param/name>/exp_avg_sq.npy   # second, if present
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+PyTree = Any
+META_FILE = "ds_universal_meta.json"
+ZERO_DIR = "zero"
+MOMENT_NAMES = ["exp_avg", "exp_avg_sq", "exp_moment_3", "exp_moment_4"]
+
+
+def _path_name(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def flatten_with_names(tree: PyTree) -> list[tuple[str, Any]]:
+    """Flatten a pytree to (slash-joined-name, leaf) pairs."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(_path_name(p), v) for p, v in leaves]
+
+
+def _match_moments(opt_state: PyTree, param_names: list[str],
+                   param_shapes: dict[str, tuple]) -> dict[str, list]:
+    """Find optimizer-state leaves that are per-param moments.
+
+    An opt-state leaf whose path *ends with* a param's path and whose shape
+    matches is a moment of that param (optax moment trees mirror the param
+    tree: e.g. ScaleByAdamState.mu/<param path>). Order of appearance
+    determines exp_avg vs exp_avg_sq — same convention the reference uses
+    when mapping fragments (ds_to_universal.py:112).
+    """
+    moments: dict[str, list] = {n: [] for n in param_names}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+        name = _path_name(path)
+        for pname in param_names:
+            if (name == pname or name.endswith("/" + pname)) \
+                    and tuple(np.shape(leaf)) == param_shapes[pname]:
+                moments[pname].append((name, leaf))
+                break
+    return moments
+
+
+def ds_to_universal(checkpoint_dir: str, output_dir: str,
+                    tag: Optional[str] = None) -> str:
+    """Convert a saved engine checkpoint to universal format
+    (reference: ds_to_universal.py main)."""
+    from .zero_to_fp32 import _find_tag, _restore_numpy
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    tag = _find_tag(checkpoint_dir, tag)
+    state = _restore_numpy(os.path.join(checkpoint_dir, tag, "state"))
+
+    hp = state.get("master") or state["params"]  # fp32 source of truth
+    named = flatten_with_names(hp)
+    names = [n for n, _ in named]
+    shapes = {n: tuple(np.shape(v)) for n, v in named}
+    moments = _match_moments(state.get("opt_state", {}), names, shapes)
+
+    zdir = os.path.join(os.path.abspath(output_dir), ZERO_DIR)
+    for name, leaf in named:
+        pdir = os.path.join(zdir, name)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(leaf, dtype=np.float32))
+        for i, (_, m) in enumerate(moments[name]):
+            mname = MOMENT_NAMES[i] if i < len(MOMENT_NAMES) else f"moment_{i}"
+            np.save(os.path.join(pdir, f"{mname}.npy"),
+                    np.asarray(m, dtype=np.float32))
+
+    meta = {
+        "tag": tag,
+        "step": int(np.asarray(state.get("step", 0))),
+        "param_names": names,
+        "n_moments": {n: len(m) for n, m in moments.items()},
+    }
+    src_meta = os.path.join(checkpoint_dir, tag, "ds_meta.json")
+    if os.path.exists(src_meta):
+        with open(src_meta) as f:
+            meta["ds_meta"] = json.load(f)
+    with open(os.path.join(os.path.abspath(output_dir), META_FILE), "w") as f:
+        json.dump(meta, f)
+    log_dist(f"universal checkpoint written to {output_dir} "
+             f"({len(names)} params)")
+    return output_dir
+
+
+def _iter_param_files(universal_dir: str) -> Iterator[tuple[str, str]]:
+    zdir = os.path.join(universal_dir, ZERO_DIR)
+    for root, _dirs, files in os.walk(zdir):
+        if "fp32.npy" in files:
+            yield os.path.relpath(root, zdir), root
+
+
+def load_universal_checkpoint(engine, universal_dir: str) -> dict:
+    """Load universal fragments into a live engine at its *current* mesh —
+    the reference's load_universal_checkpoint path
+    (universal_checkpoint.py:22). Re-sharding is free: fragments are
+    logically-global arrays; jax.device_put applies the engine's shardings.
+    Returns the client_state persisted at save time.
+    """
+    universal_dir = os.path.abspath(universal_dir)
+    if not os.path.exists(os.path.join(universal_dir, META_FILE)):
+        # allow pointing at the parent of the converted dir
+        raise FileNotFoundError(
+            f"{universal_dir} is not a universal checkpoint "
+            f"(missing {META_FILE})")
+    with open(os.path.join(universal_dir, META_FILE)) as f:
+        meta = json.load(f)
+
+    fp32 = {}
+    moments: dict[str, list[np.ndarray]] = {}
+    for name, pdir in _iter_param_files(universal_dir):
+        fp32[name] = np.load(os.path.join(pdir, "fp32.npy"))
+        moments[name] = []
+        for mname in MOMENT_NAMES:
+            mpath = os.path.join(pdir, f"{mname}.npy")
+            if os.path.exists(mpath):
+                moments[name].append(np.load(mpath))
+
+    # --- params / master ------------------------------------------------
+    def put(tree, shardings, cast_dtype=None):
+        named = flatten_with_names(tree)
+        shards = dict(flatten_with_names(shardings))
+        treedef = jax.tree_util.tree_structure(tree)
+        new_leaves = []
+        for name, old in named:
+            if name not in fp32:
+                logger.warning(f"universal ckpt missing param {name}; "
+                               "keeping current value")
+                new_leaves.append(old)
+                continue
+            arr = fp32[name].astype(cast_dtype or old.dtype)
+            new_leaves.append(jax.device_put(arr, shards[name]))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    engine.state["params"] = put(
+        engine.state["params"], engine.state_shardings["params"])
+    if engine.state.get("master") is not None:
+        engine.state["master"] = put(
+            engine.state["master"], engine.state_shardings["master"],
+            cast_dtype=np.float32)
+
+    # --- optimizer moments ---------------------------------------------
+    names = list(fp32)
+    shapes = {n: tuple(v.shape) for n, v in fp32.items()}
+    opt = engine.state["opt_state"]
+    opt_shards = engine.state_shardings["opt_state"]
+    slot_map = _match_moments(opt, names, shapes)  # pname -> [(leafname, _)]
+    leaf_to_new = {}
+    for pname, slots in slot_map.items():
+        for i, (leafname, _) in enumerate(slots):
+            if pname in moments and i < len(moments[pname]):
+                leaf_to_new[leafname] = moments[pname][i]
+    if leaf_to_new:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt)
+        shard_flat = [s for _, s in
+                      jax.tree_util.tree_flatten_with_path(opt_shards)[0]]
+        new_leaves = []
+        for (path, leaf), shard in zip(flat, shard_flat):
+            name = _path_name(path)
+            if name in leaf_to_new:
+                arr = leaf_to_new[name].astype(leaf.dtype)
+                new_leaves.append(jax.device_put(arr, shard))
+            else:
+                new_leaves.append(leaf)
+        engine.state["opt_state"] = jax.tree_util.tree_unflatten(
+            treedef, new_leaves)
+
+    step = int(meta.get("step", 0))
+    engine.state["step"] = jax.device_put(
+        np.asarray(step, dtype=np.int32),
+        engine.state_shardings["step"])
+    ds_meta = meta.get("ds_meta", {})
+    engine.global_steps = int(ds_meta.get("global_steps", step))
+    engine.global_samples = int(ds_meta.get("global_samples", 0))
+    engine.skipped_steps = int(ds_meta.get("skipped_steps", 0))
+    log_dist(f"loaded universal checkpoint from {universal_dir} "
+             f"({len(fp32)} params, step={step})")
+    return ds_meta.get("client_state", {})

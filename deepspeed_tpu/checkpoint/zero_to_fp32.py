@@ -1,0 +1,87 @@
+"""Offline fp32 consolidation of a sharded checkpoint (reference:
+deepspeed/utils/zero_to_fp32.py — get_fp32_state_dict_from_zero_checkpoint /
+convert_zero_checkpoint_to_fp32_state_dict).
+
+The reference stitches per-rank flat-buffer shards back into full tensors;
+here orbax already stores logically-global arrays, so consolidation is a
+numpy restore + export. Output is a plain ``.npz`` any framework can read.
+
+CLI:  python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out.npz>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+LATEST_FILE = "latest"
+
+
+def _find_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
+    if tag is not None:
+        return tag
+    latest = os.path.join(checkpoint_dir, LATEST_FILE)
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return f.read().strip()
+    raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag")
+
+
+def _restore_numpy(path: str):
+    """Restore an orbax checkpoint as host numpy arrays (no shardings)."""
+    import jax
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    # restore_args molded on the saved structure force plain-numpy leaves,
+    # so consolidation works on any host (no accelerator, any device count)
+    meta = ckptr.metadata(path).item_metadata
+    restore_args = jax.tree.map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta)
+    return ckptr.restore(path, restore_args=restore_args)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> dict[str, np.ndarray]:
+    """Return {param_name: fp32 numpy array} from a saved checkpoint
+    (reference: zero_to_fp32.py same-named function)."""
+    from .universal import flatten_with_names
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    tag = _find_tag(checkpoint_dir, tag)
+    state = _restore_numpy(os.path.join(checkpoint_dir, tag, "state"))
+    hp = state.get("master") or state["params"]
+    return {name: np.asarray(leaf, dtype=np.float32)
+            for name, leaf in flatten_with_names(hp)}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str,
+        tag: Optional[str] = None) -> str:
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)) or ".",
+                exist_ok=True)
+    np.savez(output_file, **sd)
+    log_dist(f"consolidated {len(sd)} fp32 params to {output_file}")
+    return output_file
+
+
+def main():
+    # offline host-side tool: never needs an accelerator backend
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    p = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint to one fp32 .npz")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("-t", "--tag", default=None)
+    args = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+// Async tensor I/O for NVMe offload (reference: csrc/aio/ — DeepNVMe.
+// deepspeed_py_aio_handle.cpp exposes an `aio_handle` with async
+// pread/pwrite of pinned buffers against NVMe files, backed by a thread
+// pool + libaio io_submit; used by runtime/swap_tensor/*).
+//
+// TPU build: C ABI handle (ctypes-loaded) with the same operation set —
+// async pread/pwrite, blocked into `block_size` chunks spread over
+// `num_threads` workers, plus a synchronous path. Uses plain
+// pread/pwrite syscalls (portable; O_DIRECT is an open flag away and the
+// thread pool already gives queue-depth parallelism an io_uring backend
+// would).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Task {
+    std::function<void()> fn;
+};
+
+class AioHandle {
+   public:
+    AioHandle(int64_t block_size, int num_threads)
+        : block_size_(block_size > 0 ? block_size : (1 << 20)),
+          stop_(false),
+          pending_(0),
+          errors_(0) {
+        int n = num_threads > 0 ? num_threads : 1;
+        for (int i = 0; i < n; ++i) {
+            workers_.emplace_back([this] { this->worker(); });
+        }
+    }
+
+    ~AioHandle() {
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        for (auto& t : workers_) t.join();
+    }
+
+    // Split [buf, buf+n) into block-sized chunks; each chunk is one task.
+    void submit_io(const std::string& path, char* buf, int64_t n,
+                   int64_t file_offset, bool is_read, bool create) {
+        int flags = is_read ? O_RDONLY : (O_WRONLY | (create ? O_CREAT : 0));
+        for (int64_t off = 0; off < n; off += block_size_) {
+            int64_t len = std::min(block_size_, n - off);
+            char* p = buf + off;
+            int64_t foff = file_offset + off;
+            enqueue([this, path, p, len, foff, flags, is_read] {
+                int fd = ::open(path.c_str(), flags, 0644);
+                if (fd < 0) {
+                    errors_.fetch_add(1);
+                    return;
+                }
+                int64_t done = 0;
+                while (done < len) {
+                    ssize_t r = is_read
+                                    ? ::pread(fd, p + done, len - done,
+                                              foff + done)
+                                    : ::pwrite(fd, p + done, len - done,
+                                               foff + done);
+                    if (r <= 0) {
+                        errors_.fetch_add(1);
+                        break;
+                    }
+                    done += r;
+                }
+                ::close(fd);
+            });
+        }
+    }
+
+    // Block until every queued op completes; returns -errors.
+    int synchronize() {
+        std::unique_lock<std::mutex> lk(mu_);
+        done_cv_.wait(lk, [this] { return pending_ == 0; });
+        return -(int)errors_.exchange(0);
+    }
+
+    int64_t block_size() const { return block_size_; }
+    int num_threads() const { return (int)workers_.size(); }
+
+   private:
+    void enqueue(std::function<void()> fn) {
+        {
+            std::unique_lock<std::mutex> lk(mu_);
+            tasks_.push_back({std::move(fn)});
+            ++pending_;
+        }
+        cv_.notify_one();
+    }
+
+    void worker() {
+        for (;;) {
+            Task t;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !tasks_.empty(); });
+                if (stop_ && tasks_.empty()) return;
+                t = std::move(tasks_.front());
+                tasks_.pop_front();
+            }
+            t.fn();
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                if (--pending_ == 0) done_cv_.notify_all();
+            }
+        }
+    }
+
+    int64_t block_size_;
+    bool stop_;
+    int64_t pending_;
+    std::atomic<int64_t> errors_;
+    std::deque<Task> tasks_;
+    std::vector<std::thread> workers_;
+    std::mutex mu_;
+    std::condition_variable cv_, done_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_handle_new(int64_t block_size, int num_threads) {
+    return new AioHandle(block_size, num_threads);
+}
+
+void ds_aio_handle_free(void* h) { delete static_cast<AioHandle*>(h); }
+
+// Async: returns immediately; pair with ds_aio_synchronize.
+void ds_aio_pread(void* h, const char* path, void* buf, int64_t n,
+                  int64_t file_offset) {
+    static_cast<AioHandle*>(h)->submit_io(path, static_cast<char*>(buf), n,
+                                          file_offset, /*is_read=*/true,
+                                          /*create=*/false);
+}
+
+void ds_aio_pwrite(void* h, const char* path, const void* buf, int64_t n,
+                   int64_t file_offset) {
+    static_cast<AioHandle*>(h)->submit_io(
+        path, const_cast<char*>(static_cast<const char*>(buf)), n,
+        file_offset, /*is_read=*/false, /*create=*/true);
+}
+
+// Blocking variants (reference: aio_handle.sync_pread/sync_pwrite).
+int ds_aio_sync_pread(void* h, const char* path, void* buf, int64_t n,
+                      int64_t file_offset) {
+    auto* handle = static_cast<AioHandle*>(h);
+    handle->submit_io(path, static_cast<char*>(buf), n, file_offset, true,
+                      false);
+    return handle->synchronize();
+}
+
+int ds_aio_sync_pwrite(void* h, const char* path, const void* buf, int64_t n,
+                       int64_t file_offset) {
+    auto* handle = static_cast<AioHandle*>(h);
+    handle->submit_io(path,
+                      const_cast<char*>(static_cast<const char*>(buf)), n,
+                      file_offset, false, true);
+    return handle->synchronize();
+}
+
+int ds_aio_synchronize(void* h) {
+    return static_cast<AioHandle*>(h)->synchronize();
+}
+
+int64_t ds_aio_block_size(void* h) {
+    return static_cast<AioHandle*>(h)->block_size();
+}
+
+int ds_aio_num_threads(void* h) {
+    return static_cast<AioHandle*>(h)->num_threads();
+}
+
+}  // extern "C"

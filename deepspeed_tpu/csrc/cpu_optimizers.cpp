@@ -1,0 +1,171 @@
+// Host-side vectorized optimizers for offloaded optimizer states
+// (reference: csrc/adam/cpu_adam_impl.cpp, csrc/adagrad/cpu_adagrad.cpp,
+// csrc/lion/cpu_lion_impl.cpp, csrc/lamb/ — AVX-vectorized, OMP-parallel
+// steps over host-resident master params/moments; the compute engine of
+// ZeRO-Offload's CPU optimizer path).
+//
+// TPU build: plain C ABI over contiguous float buffers (loaded via ctypes,
+// no pybind11). SIMD comes from `#pragma omp simd` + -O3 -march=native,
+// parallelism from OMP — same performance recipe as the reference without
+// hand-written intrinsics (the compiler emits AVX2/AVX512 on x86 hosts).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Adam / AdamW (reference: cpu_adam_impl.cpp Adam_Optimizer::Step).
+// adamw_mode=1 decouples weight decay (AdamW); bias correction always on.
+void ds_cpu_adam_step(float* params,
+                      const float* grads,
+                      float* exp_avg,
+                      float* exp_avg_sq,
+                      int64_t n,
+                      float lr,
+                      float beta1,
+                      float beta2,
+                      float eps,
+                      float weight_decay,
+                      int step,
+                      int adamw_mode) {
+    const float bc1 = 1.0f - std::pow(beta1, (float)step);
+    const float bc2 = 1.0f - std::pow(beta2, (float)step);
+    const float step_size = lr / bc1;
+    const float sqrt_bc2 = std::sqrt(bc2);
+    const float decay = (adamw_mode && weight_decay > 0.0f)
+                            ? (1.0f - lr * weight_decay)
+                            : 1.0f;
+    const float l2 = (!adamw_mode && weight_decay > 0.0f) ? weight_decay : 0.0f;
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i] + l2 * params[i];
+        float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+        float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        float denom = std::sqrt(v) / sqrt_bc2 + eps;
+        params[i] = params[i] * decay - step_size * (m / denom);
+    }
+}
+
+// Adagrad (reference: csrc/adagrad/cpu_adagrad.cpp).
+void ds_cpu_adagrad_step(float* params,
+                         const float* grads,
+                         float* accum,
+                         int64_t n,
+                         float lr,
+                         float eps,
+                         float weight_decay) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i] + weight_decay * params[i];
+        float a = accum[i] + g * g;
+        accum[i] = a;
+        params[i] -= lr * g / (std::sqrt(a) + eps);
+    }
+}
+
+// Lion (reference: csrc/lion/cpu_lion_impl.cpp).
+void ds_cpu_lion_step(float* params,
+                      const float* grads,
+                      float* exp_avg,
+                      int64_t n,
+                      float lr,
+                      float beta1,
+                      float beta2,
+                      float weight_decay) {
+    const float decay = 1.0f - lr * weight_decay;
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i];
+        float m = exp_avg[i];
+        float c = beta1 * m + (1.0f - beta1) * g;
+        float upd = (c > 0.0f) ? 1.0f : ((c < 0.0f) ? -1.0f : 0.0f);
+        params[i] = params[i] * decay - lr * upd;
+        exp_avg[i] = beta2 * m + (1.0f - beta2) * g;
+    }
+}
+
+// LAMB phase 1: Adam-style update direction + squared norms
+// (reference: csrc/lamb/fused_lamb_cuda_kernel.cu two-phase reduction).
+// Writes the raw update into `update_out`; returns norms via out params.
+void ds_cpu_lamb_phase1(const float* params,
+                        const float* grads,
+                        float* exp_avg,
+                        float* exp_avg_sq,
+                        float* update_out,
+                        int64_t n,
+                        float beta1,
+                        float beta2,
+                        float eps,
+                        float weight_decay,
+                        int step,
+                        float* param_norm_sq,
+                        float* update_norm_sq) {
+    const float bc1 = 1.0f - std::pow(beta1, (float)step);
+    const float bc2 = 1.0f - std::pow(beta2, (float)step);
+    const float sqrt_bc2 = std::sqrt(bc2);
+    double pn = 0.0, un = 0.0;
+#pragma omp parallel for schedule(static) reduction(+ : pn, un)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i];
+        float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+        float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        float u = (m / bc1) / (std::sqrt(v) / sqrt_bc2 + eps)
+                  + weight_decay * params[i];
+        update_out[i] = u;
+        pn += (double)params[i] * params[i];
+        un += (double)u * u;
+    }
+    *param_norm_sq = (float)pn;
+    *update_norm_sq = (float)un;
+}
+
+// LAMB phase 2: apply trust-ratio-scaled update.
+void ds_cpu_lamb_phase2(float* params,
+                        const float* update,
+                        int64_t n,
+                        float lr,
+                        float trust_ratio) {
+    const float s = lr * trust_ratio;
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        params[i] -= s * update[i];
+    }
+}
+
+// Momentum SGD on host (completes the offload-optimizer family).
+void ds_cpu_sgd_step(float* params,
+                     const float* grads,
+                     float* momentum_buf,
+                     int64_t n,
+                     float lr,
+                     float momentum,
+                     float weight_decay) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i] + weight_decay * params[i];
+        float m = momentum * momentum_buf[i] + g;
+        momentum_buf[i] = m;
+        params[i] -= lr * m;
+    }
+}
+
+int ds_cpu_optimizer_num_threads() {
+#if defined(_OPENMP)
+    return omp_get_max_threads();
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"

@@ -1,0 +1,193 @@
+"""Host (CPU) optimizers over the native C++ op (reference:
+deepspeed/ops/adam/cpu_adam.py DeepSpeedCPUAdam:13, ops/adagrad/,
+ops/lion/, ops/lamb/ — torch.optim.Optimizer wrappers around the
+AVX/OMP-vectorized csrc kernels, used for ZeRO-Offload optimizer steps).
+
+TPU build: the same shape without torch — each optimizer owns numpy moment
+buffers and applies in-place steps to fp32 master arrays living in host
+memory (the offload engine streams grads to host / params back to device
+around this call). Compute is the JIT-built cpu_optimizers.so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Sequence
+
+import numpy as np
+
+from .op_builder import CPUOptimizerBuilder
+
+
+def _ptr(a: np.ndarray):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"], (
+        a.dtype, a.flags)
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class _CPUOptimizerBase:
+    def __init__(self):
+        self._lib = CPUOptimizerBuilder().load()
+        self._state: dict[int, dict[str, np.ndarray]] = {}
+        self._step = 0
+
+    def state_buffers(self, idx: int) -> dict[str, np.ndarray]:
+        return self._state.get(idx, {})
+
+    def _buf(self, idx: int, name: str, like: np.ndarray) -> np.ndarray:
+        st = self._state.setdefault(idx, {})
+        if name not in st:
+            st[name] = np.zeros_like(like)
+        return st[name]
+
+
+class DeepSpeedCPUAdam(_CPUOptimizerBase):
+    """reference: ops/adam/cpu_adam.py:13"""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True):
+        super().__init__()
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+
+    def step(self, params: Sequence[np.ndarray],
+             grads: Sequence[np.ndarray], lr: float | None = None) -> int:
+        """In-place Adam step over host arrays. Returns the step count."""
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m = self._buf(i, "exp_avg", p)
+            v = self._buf(i, "exp_avg_sq", p)
+            self._lib.ds_cpu_adam_step(
+                _ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                lr, self.betas[0], self.betas[1], self.eps,
+                self.weight_decay, self._step, int(self.adamw_mode))
+        return self._step
+
+
+class DeepSpeedCPUAdagrad(_CPUOptimizerBase):
+    """reference: ops/adagrad/cpu_adagrad.py"""
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        super().__init__()
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def step(self, params, grads, lr=None):
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            acc = self._buf(i, "accum", p)
+            self._lib.ds_cpu_adagrad_step(
+                _ptr(p), _ptr(g), _ptr(acc), p.size, lr, self.eps,
+                self.weight_decay)
+        return self._step
+
+
+class DeepSpeedCPULion(_CPUOptimizerBase):
+    """reference: ops/lion/cpu_lion.py"""
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        super().__init__()
+        self.lr = lr
+        self.betas = betas
+        self.weight_decay = weight_decay
+
+    def step(self, params, grads, lr=None):
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m = self._buf(i, "exp_avg", p)
+            self._lib.ds_cpu_lion_step(
+                _ptr(p), _ptr(g), _ptr(m), p.size, lr,
+                self.betas[0], self.betas[1], self.weight_decay)
+        return self._step
+
+
+class DeepSpeedCPULamb(_CPUOptimizerBase):
+    """reference: ops/lamb/fused_lamb.py (LAMB trust-ratio scaling; the
+    two-phase norm reduction mirrors fused_lamb_cuda_kernel.cu)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
+                 weight_decay=0.0, min_trust=0.01, max_trust=10.0):
+        super().__init__()
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.min_trust = min_trust
+        self.max_trust = max_trust
+
+    def step(self, params, grads, lr=None):
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        pn = ctypes.c_float()
+        un = ctypes.c_float()
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m = self._buf(i, "exp_avg", p)
+            v = self._buf(i, "exp_avg_sq", p)
+            upd = self._buf(i, "update", p)
+            self._lib.ds_cpu_lamb_phase1(
+                _ptr(p), _ptr(g), _ptr(m), _ptr(v), _ptr(upd), p.size,
+                self.betas[0], self.betas[1], self.eps, self.weight_decay,
+                self._step, ctypes.byref(pn), ctypes.byref(un))
+            p_norm = float(np.sqrt(pn.value))
+            u_norm = float(np.sqrt(un.value))
+            if p_norm > 0 and u_norm > 0:
+                trust = np.clip(p_norm / u_norm, self.min_trust,
+                                self.max_trust)
+            else:
+                trust = 1.0
+            self._lib.ds_cpu_lamb_phase2(_ptr(p), _ptr(upd), p.size, lr,
+                                         trust)
+        return self._step
+
+
+class DeepSpeedCPUSGD(_CPUOptimizerBase):
+    def __init__(self, lr=1e-2, momentum=0.0, weight_decay=0.0):
+        super().__init__()
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+
+    def step(self, params, grads, lr=None):
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m = self._buf(i, "momentum", p)
+            self._lib.ds_cpu_sgd_step(
+                _ptr(p), _ptr(g), _ptr(m), p.size, lr, self.momentum,
+                self.weight_decay)
+        return self._step
+
+
+def build_cpu_optimizer(opt_type: str, params: dict):
+    """Factory by reference config name (used by the offload engine)."""
+    name = opt_type.lower().replace("_", "")
+    lr = params.get("lr", 1e-3)
+    betas = tuple(params.get("betas", (0.9, 0.999)))
+    eps = params.get("eps", 1e-8)
+    wd = params.get("weight_decay", 0.0)
+    if name in ("adam", "adamw", "cpuadam", "deepspeedcpuadam", "fusedadam",
+                "fusedadamw", "onebitadam", "zerooneadam"):
+        return DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=wd,
+                                adamw_mode=(name != "adam"
+                                            or params.get("adam_w_mode",
+                                                          True)))
+    if name in ("adagrad", "cpuadagrad"):
+        return DeepSpeedCPUAdagrad(lr=lr, eps=params.get("eps", 1e-10),
+                                   weight_decay=wd)
+    if name in ("lion", "cpulion", "fusedlion"):
+        return DeepSpeedCPULion(lr=lr, betas=tuple(params.get(
+            "betas", (0.9, 0.99))), weight_decay=wd)
+    if name in ("lamb", "fusedlamb", "onebitlamb"):
+        return DeepSpeedCPULamb(lr=lr, betas=betas,
+                                eps=params.get("eps", 1e-6), weight_decay=wd)
+    if name == "sgd":
+        return DeepSpeedCPUSGD(lr=lr, momentum=params.get("momentum", 0.0),
+                               weight_decay=wd)
+    raise ValueError(f"no CPU optimizer for type {opt_type!r}")

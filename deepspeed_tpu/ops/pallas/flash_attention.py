@@ -253,6 +253,12 @@ def flash_attention(q, k, v, *, causal: bool = True, **_kw):
     """
     b, s, hq, d = q.shape
     hkv = k.shape[2]
+    if s > 128 and s % 128 != 0:
+        # the blocked kernels require 128-aligned sequence lengths; an
+        # unaligned tail would be silently dropped by the grid floor
+        # division — use the exact (unfused) path instead
+        from ..layers import dot_product_attention
+        return dot_product_attention(q, k, v, causal=causal)
     if hq != hkv:
         rep = hq // hkv
         k = jnp.repeat(k, rep, axis=2)

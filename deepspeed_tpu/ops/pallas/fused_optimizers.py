@@ -45,7 +45,7 @@ def _unpad(x2d, n, shape, dtype):
 
 
 def _adam_kernel(p_ref, g_ref, m_ref, v_ref, hp_ref, p_out, m_out, v_out,
-                 *, wd):
+                 *, wd, adamw_mode):
     lr = hp_ref[0]
     b1 = hp_ref[1]
     b2 = hp_ref[2]
@@ -54,11 +54,13 @@ def _adam_kernel(p_ref, g_ref, m_ref, v_ref, hp_ref, p_out, m_out, v_out,
     c2 = hp_ref[5]   # 1/(1-b2^t)
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
+    if wd and not adamw_mode:
+        g = g + wd * p  # classic L2: decay enters the moments
     m = b1 * m_ref[:] + (1 - b1) * g
     v = b2 * v_ref[:] + (1 - b2) * g * g
     update = (m * c1) / (jnp.sqrt(v * c2) + eps)
-    if wd:
-        update = update + wd * p
+    if wd and adamw_mode:
+        update = update + wd * p  # AdamW: decoupled decay
     p_out[:] = p - lr * update
     m_out[:] = m
     v_out[:] = v
@@ -71,7 +73,8 @@ class FusedAdamState(NamedTuple):
 
 
 def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
-               weight_decay=0.0) -> optax.GradientTransformation:
+               weight_decay=0.0,
+               adamw_mode=True) -> optax.GradientTransformation:
     """AdamW with the update applied by one Pallas kernel per tensor.
 
     Returned `updates` are deltas (new_p - p) so it composes like any optax
@@ -87,9 +90,12 @@ def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
     def update(grads, state, params):
         if params is None:
             raise ValueError("fused_adam requires params")
-        count = state.count + 1
-        lr = (learning_rate(count) if callable(learning_rate)
+        # lr evaluated at the pre-increment count (optax scale_by_schedule
+        # convention: first step uses lr(0)); bias correction at t=count+1
+        # (optax scale_by_adam convention)
+        lr = (learning_rate(state.count) if callable(learning_rate)
               else learning_rate)
+        count = state.count + 1
         t = count.astype(jnp.float32)
         hp = jnp.stack([
             jnp.asarray(lr, jnp.float32),
@@ -111,7 +117,8 @@ def fused_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
             spec = pl.BlockSpec((blk, 128), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)
             new_p, new_m, new_v = pl.pallas_call(
-                functools.partial(_adam_kernel, wd=weight_decay),
+                functools.partial(_adam_kernel, wd=weight_decay,
+                                  adamw_mode=adamw_mode),
                 grid=grid,
                 in_specs=[spec, spec, spec, spec,
                           pl.BlockSpec(memory_space=pltpu.SMEM)],
@@ -165,9 +172,9 @@ def fused_lion(learning_rate, b1=0.9, b2=0.99,
             jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
 
     def update(grads, state, params):
-        count = state.count + 1
-        lr = (learning_rate(count) if callable(learning_rate)
+        lr = (learning_rate(state.count) if callable(learning_rate)
               else learning_rate)
+        count = state.count + 1
         hp = jnp.stack([jnp.asarray(lr, jnp.float32),
                         jnp.asarray(b1, jnp.float32),
                         jnp.asarray(b2, jnp.float32)])

@@ -1,0 +1,133 @@
+"""Pluggable checkpoint engines (reference:
+runtime/checkpoint_engine/checkpoint_engine.py:9 — CheckpointEngine ABC with
+create/save/load/commit; TorchCheckpointEngine and the async
+NebulaCheckpointEngine).
+
+TPU-native: both engines are orbax-backed. ``OrbaxCheckpointEngine`` saves
+synchronously (the TorchCheckpointEngine analogue); ``AsyncCheckpointEngine``
+returns as soon as device arrays are snapshotted to host and serializes in a
+background thread (the Nebula analogue — ``commit()`` blocks until durable).
+Both write sharded: every process stores only its addressable shards, the
+analogue of the reference's per-rank ``*_model_states.pt`` files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+from ..utils.logging import log_dist
+
+
+class CheckpointEngine:
+    """reference: runtime/checkpoint_engine/checkpoint_engine.py:9"""
+
+    def __init__(self, config_params=None):
+        self.config = config_params
+        self._pending_latest: Optional[tuple[str, str]] = None
+
+    def create(self, tag: str) -> None:
+        """Log the start of a new checkpoint (reference: create)."""
+        log_dist(f"[ckpt] saving checkpoint {tag}")
+
+    def register_latest(self, save_dir: str, tag: str) -> None:
+        """Point ``<save_dir>/latest`` at `tag`. Sync engines write
+        immediately (the save is already durable); async engines defer to
+        commit()/the next save so `latest` never names a partial
+        checkpoint."""
+        self._write_latest(save_dir, tag)
+
+    def _write_latest(self, save_dir: str, tag: str) -> None:
+        import jax
+        if jax.process_index() == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+
+    def _flush_latest(self) -> None:
+        if self._pending_latest is not None:
+            self._write_latest(*self._pending_latest)
+            self._pending_latest = None
+
+    def save(self, state_dict: Any, path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, abstract_state: Any = None) -> Any:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Mark the checkpoint durable; blocks for async engines."""
+        return True
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Synchronous sharded save/restore (TorchCheckpointEngine analogue)."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, state_dict: Any, path: str) -> None:
+        self._ckptr.save(path, state_dict, force=True)
+        self._ckptr.wait_until_finished()
+
+    def load(self, path: str, abstract_state: Any = None) -> Any:
+        if abstract_state is None:
+            return self._ckptr.restore(path)
+        return self._ckptr.restore(path, abstract_state)
+
+    def commit(self, tag: str) -> bool:
+        self._ckptr.wait_until_finished()
+        return True
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-serialized save (NebulaCheckpointEngine analogue,
+    reference runtime/checkpoint_engine/nebula_checkpoint_engine.py).
+
+    ``save`` returns once device buffers are copied to host; the write to
+    storage happens on orbax's background thread. ``commit`` (or the next
+    save) waits for durability.
+    """
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+    def save(self, state_dict: Any, path: str) -> None:
+        # wait for any in-flight save first: orbax requires serialized
+        # saves — which also makes the previous checkpoint durable, so its
+        # deferred latest pointer can be written now
+        self._ckptr.wait_until_finished()
+        self._flush_latest()
+        self._ckptr.save(path, args=ocp.args.StandardSave(state_dict),
+                         force=True)
+
+    def register_latest(self, save_dir: str, tag: str) -> None:
+        self._pending_latest = (save_dir, tag)
+
+    def load(self, path: str, abstract_state: Any = None) -> Any:
+        self._ckptr.wait_until_finished()
+        if abstract_state is None:
+            return self._ckptr.restore(path)
+        return self._ckptr.restore(
+            path, args=ocp.args.StandardRestore(abstract_state))
+
+    def commit(self, tag: str) -> bool:
+        self._ckptr.wait_until_finished()
+        self._flush_latest()
+        log_dist(f"[ckpt] checkpoint {tag} committed")
+        return True
+
+
+def build_checkpoint_engine(config) -> CheckpointEngine:
+    """Select the engine from config (reference: engine.py
+    _configure_checkpointing:975 — Nebula if enabled, else Torch)."""
+    ckpt_cfg = getattr(config, "checkpoint", None)
+    if ckpt_cfg is not None and getattr(ckpt_cfg, "async_save", False):
+        return AsyncCheckpointEngine(config)
+    return OrbaxCheckpointEngine(config)

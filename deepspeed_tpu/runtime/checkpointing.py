@@ -17,9 +17,9 @@ from typing import Any, Optional
 
 import jax
 import numpy as np
-import orbax.checkpoint as ocp
 
 from ..utils.logging import log_dist, logger
+from .checkpoint_engine import build_checkpoint_engine
 
 LATEST_FILE = "latest"
 
@@ -28,17 +28,26 @@ def _tag(engine, tag: Optional[str]) -> str:
     return tag if tag is not None else f"global_step{engine.global_steps}"
 
 
+def _ckpt_engine(engine):
+    ce = getattr(engine, "checkpoint_engine", None)
+    if ce is None:
+        ce = build_checkpoint_engine(engine.config)
+        engine.checkpoint_engine = ce
+    return ce
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
                     save_latest: bool = True) -> bool:
     tag = _tag(engine, tag)
+    _validate_tag(engine, tag)
     path = os.path.join(os.path.abspath(save_dir), tag)
-    ckptr = ocp.StandardCheckpointer()
+    ce = _ckpt_engine(engine)
+    ce.create(tag)
     state = dict(engine.state)
     if state.get("master") is None:
         state.pop("master", None)
-    ckptr.save(os.path.join(path, "state"), state, force=True)
-    ckptr.wait_until_finished()
+    ce.save(state, os.path.join(path, "state"))
     meta = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -48,14 +57,35 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "client_state": client_state or {},
     }
     if jax.process_index() == 0:
+        os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "ds_meta.json"), "w") as f:
             json.dump(meta, f)
-        if save_latest:
-            with open(os.path.join(os.path.abspath(save_dir), LATEST_FILE),
-                      "w") as f:
-                f.write(tag)
+    if save_latest:
+        # the latest pointer must only name durable checkpoints: sync
+        # engines write it now, async engines defer to commit()/next save
+        ce.register_latest(os.path.abspath(save_dir), tag)
     log_dist(f"saved checkpoint {tag} to {save_dir}")
     return True
+
+
+def _validate_tag(engine, tag: str):
+    """reference: engine.py _checkpoint_tag_validation — all ranks must
+    agree on the tag. Under SPMD one process per host, compare via comm."""
+    mode = engine.config.checkpoint.tag_validation
+    if mode == "Ignore" or jax.process_count() == 1:
+        return
+    # cheap agreement check: digest must match across processes (crc32 is
+    # deterministic across interpreters, unlike salted str hash())
+    import zlib
+    from .. import comm as dist
+    h = zlib.crc32(tag.encode())
+    hi = dist.host_all_reduce(h, op=dist.ReduceOp.MAX)
+    lo = dist.host_all_reduce(h, op=dist.ReduceOp.MIN)
+    if int(hi) != int(lo):
+        msg = f"checkpoint tag {tag!r} differs across processes"
+        if mode == "Fail":
+            raise ValueError(msg)
+        logger.warning(msg)
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
@@ -70,8 +100,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         with open(latest) as f:
             tag = f.read().strip()
     path = os.path.join(load_dir, tag)
-    ckptr = ocp.StandardCheckpointer()
 
+    if engine.config.checkpoint.load_universal:
+        from ..checkpoint.universal import load_universal_checkpoint
+        client_state = load_universal_checkpoint(engine, path)
+        return path, client_state
+
+    ce = _ckpt_engine(engine)
     # Restore with the engine's current shardings — orbax reshards on read,
     # so restoring on a different mesh/world size "just works" (the role of
     # the reference's universal checkpoint loader, universal_checkpoint.py:22).
@@ -81,7 +116,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     abstract = dict(abstract)
     if engine.state.get("master") is None:
         abstract.pop("master", None)
-    restored = ckptr.restore(os.path.join(path, "state"), abstract)
+    restored = ce.load(os.path.join(path, "state"), abstract)
     if "master" not in restored:
         restored["master"] = None
     if load_module_only:
@@ -103,3 +138,35 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         client_state = meta.get("client_state", {})
     log_dist(f"loaded checkpoint {tag} from {load_dir}")
     return path, client_state
+
+
+def save_16bit_model(engine, save_dir: str,
+                     checkpoint_name: str = "model_weights.npz") -> bool:
+    """Consolidated 16-bit weights export (reference: engine.py
+    save_16bit_model:3638 / _zero3_consolidated_16bit_state_dict:3569).
+
+    Gathers every (possibly fsdp-sharded) param to host and writes one
+    ``.npz`` of name->array. bfloat16 is upcast losslessly to float32
+    (numpy's npz format cannot represent it); float16 is stored natively.
+    Multi-host: all processes participate in the gather; process 0 writes.
+    """
+    from ..checkpoint.universal import flatten_with_names
+    os.makedirs(save_dir, exist_ok=True)
+    multihost = jax.process_count() > 1
+    if multihost:
+        from jax.experimental import multihost_utils
+    out = {}
+    for name, leaf in flatten_with_names(engine.state["params"]):
+        if multihost:
+            arr = np.asarray(multihost_utils.process_allgather(
+                leaf, tiled=True))
+        else:
+            arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype not in (np.float16, np.float32, np.float64,
+                             np.int32, np.int64):
+            arr = arr.astype(np.float32)
+        out[name] = arr
+    if jax.process_index() == 0:
+        np.savez(os.path.join(save_dir, checkpoint_name), **out)
+    log_dist(f"saved 16-bit model weights to {save_dir}/{checkpoint_name}")
+    return True

@@ -193,6 +193,15 @@ class AIOConfig(DeepSpeedConfigModel):
     overlap_events: bool = True
 
 
+class CheckpointConfig(DeepSpeedConfigModel):
+    """reference: runtime/config.py checkpoint_config + nebula config.
+    ``async_save`` selects the background-serialized engine (the Nebula
+    analogue)."""
+    tag_validation: Literal["Ignore", "Warn", "Fail"] = "Warn"
+    load_universal: bool = False
+    async_save: bool = False
+
+
 class ElasticityConfig(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -239,6 +248,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         default_factory=CurriculumLearningConfig)
     compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
     aio: AIOConfig = Field(default_factory=AIOConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
 
     @classmethod

@@ -542,6 +542,10 @@ class DeepSpeedEngine:
                                load_optimizer_states=load_optimizer_states,
                                load_module_only=load_module_only)
 
+    def save_16bit_model(self, save_dir, checkpoint_name="model_weights.npz"):
+        from .checkpointing import save_16bit_model
+        return save_16bit_model(self, save_dir, checkpoint_name)
+
 
 class _OptimizerShim:
     """Stands in for the wrapped optimizer object the reference returns

@@ -64,7 +64,7 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
     eps = p.pop("eps", 1e-8)
     wd = p.pop("weight_decay", 0.0)
     p.pop("bias_correction", None)  # optax adam always bias-corrects
-    p.pop("adam_w_mode", None)
+    adam_w_mode = p.pop("adam_w_mode", True)
     p.pop("torch_adam", None)
     p.pop("fused", None)
     p.pop("amsgrad", None)
@@ -73,7 +73,9 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
     if fused_kernel and name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
         from ..ops.pallas.fused_optimizers import fused_adam
         return fused_adam(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
-                          weight_decay=wd)
+                          weight_decay=wd,
+                          adamw_mode=(name == ADAMW_OPTIMIZER
+                                      or adam_w_mode))
     if fused_kernel and name == LION_OPTIMIZER:
         from ..ops.pallas.fused_optimizers import fused_lion
         b1, b2 = (betas[0], betas[1]) if betas else (0.9, 0.99)
@@ -82,7 +84,7 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
     if name == ADAM_OPTIMIZER:
         # reference FusedAdam defaults to adam_w_mode=True; plain adam with
         # L2-style weight decay if the config said adam_w_mode false
-        if params.get("adam_w_mode", True):
+        if adam_w_mode:
             return optax.adamw(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
                                weight_decay=wd)
         tx = optax.adam(lr_schedule, b1=betas[0], b2=betas[1], eps=eps)

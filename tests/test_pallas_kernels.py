@@ -62,6 +62,59 @@ def test_flash_bf16():
                                atol=3e-2, rtol=3e-2)
 
 
+def test_flash_unaligned_seq_falls_back_exact():
+    """s=192 (not a multiple of 128) must not silently truncate the tail."""
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(k1, (1, 192, 2, 32))
+    k = jax.random.normal(k2, (1, 192, 2, 32))
+    v = jax.random.normal(k3, (1, 192, 2, 32))
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_adam_with_schedule_matches_optax():
+    """lr schedule must be evaluated at the same step index as optax
+    (first update uses lr(0))."""
+    import optax
+    from deepspeed_tpu.ops.pallas.fused_optimizers import fused_adam
+    sched = optax.linear_schedule(0.0, 1e-2, 5)
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (13, 7))}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(1), (13, 7))}
+    tx_ref = optax.adamw(sched, weight_decay=0.01)
+    tx_f = fused_adam(sched, weight_decay=0.01)
+    s_ref, s_f = tx_ref.init(params), tx_f.init(params)
+    p_ref, p_f = params, params
+    for _ in range(3):
+        u_ref, s_ref = tx_ref.update(grads, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, u_ref)
+        u_f, s_f = tx_f.update(grads, s_f, p_f)
+        p_f = optax.apply_updates(p_f, u_f)
+    np.testing.assert_allclose(np.asarray(p_f["w"]), np.asarray(p_ref["w"]),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_fused_adam_l2_mode_matches_optax():
+    """adam_w_mode=False must reproduce optax.adam + add_decayed_weights."""
+    import optax
+    from deepspeed_tpu.ops.pallas.fused_optimizers import fused_adam
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (11, 9))}
+    grads = {"w": jax.random.normal(jax.random.PRNGKey(1), (11, 9))}
+    tx_ref = optax.chain(optax.add_decayed_weights(0.05),
+                         optax.adam(1e-2))
+    tx_f = fused_adam(1e-2, weight_decay=0.05, adamw_mode=False)
+    s_ref, s_f = tx_ref.init(params), tx_f.init(params)
+    p_ref, p_f = params, params
+    for _ in range(3):
+        u_ref, s_ref = tx_ref.update(grads, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, u_ref)
+        u_f, s_f = tx_f.update(grads, s_f, p_f)
+        p_f = optax.apply_updates(p_f, u_f)
+    np.testing.assert_allclose(np.asarray(p_f["w"]), np.asarray(p_ref["w"]),
+                               atol=1e-6, rtol=1e-5)
+
+
 def test_fused_adam_matches_optax():
     import optax
     from deepspeed_tpu.ops.pallas.fused_optimizers import fused_adam
