@@ -91,6 +91,34 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str,
     shapes = {n: tuple(np.shape(v)) for n, v in named}
     moments = _match_moments(state.get("opt_state", {}), names, shapes)
 
+    # NVMe-offload checkpoints keep master + moments in per-rank host
+    # files instead of the device state (runtime/offload.py state_dict)
+    host_file = os.path.join(checkpoint_dir, tag, "host_opt_rank0.npz")
+    if state.get("master") is None and os.path.exists(host_file):
+        import glob
+        rank_files = sorted(glob.glob(os.path.join(
+            checkpoint_dir, tag, "host_opt_rank*.npz")))
+        hsd: dict[str, np.ndarray] = {}
+        for f in rank_files:
+            data = dict(np.load(f))
+            for k, v in data.items():
+                if k.startswith("__"):
+                    continue
+                # rank files are full-shaped with only the local shards
+                # filled; the ownership mask makes the merge replicated-
+                # safe (overlay, not sum)
+                mask = data.get(f"__mask__::{k.split('::', 1)[1]}")
+                if k not in hsd:
+                    hsd[k] = v.copy()
+                elif mask is not None:
+                    hsd[k][mask] = v[mask]
+                else:  # legacy file without masks: overlay everything
+                    hsd[k] = v
+        named = [(n, hsd.get(f"master::{n}", v)) for n, v in named]
+        moments = {n: [(f"{m}::{n}", hsd[f"{m}::{n}"])
+                       for m in MOMENT_NAMES if f"{m}::{n}" in hsd]
+                   for n in names}
+
     zdir = os.path.join(os.path.abspath(output_dir), ZERO_DIR)
     for name, leaf in named:
         pdir = os.path.join(zdir, name)

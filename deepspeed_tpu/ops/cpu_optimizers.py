@@ -3,10 +3,18 @@ deepspeed/ops/adam/cpu_adam.py DeepSpeedCPUAdam:13, ops/adagrad/,
 ops/lion/, ops/lamb/ — torch.optim.Optimizer wrappers around the
 AVX/OMP-vectorized csrc kernels, used for ZeRO-Offload optimizer steps).
 
-TPU build: the same shape without torch — each optimizer owns numpy moment
-buffers and applies in-place steps to fp32 master arrays living in host
-memory (the offload engine streams grads to host / params back to device
-around this call). Compute is the JIT-built cpu_optimizers.so.
+TPU build: the same shape without torch — each optimizer applies in-place
+steps to fp32 master arrays living in host memory (the offload engine
+streams grads to host / params back to device around this call). Compute
+is the JIT-built cpu_optimizers.so.
+
+Two APIs:
+- ``step(params, grads)`` — stateful convenience: the optimizer owns one
+  moment buffer set per list position (reference DeepSpeedCPUAdam.step).
+- ``step_raw(p, g, bufs, lr, step)`` — caller-owned moment buffers; the
+  NVMe swapper uses this so only the in-flight shard's moments occupy RAM
+  (reference: PartitionedOptimizerSwapper hands swapped-in buffers to the
+  optimizer the same way).
 """
 
 from __future__ import annotations
@@ -26,143 +34,137 @@ def _ptr(a: np.ndarray):
 
 
 class _CPUOptimizerBase:
-    def __init__(self):
+    MOMENTS: tuple[str, ...] = ()
+
+    def __init__(self, lr: float):
         self._lib = CPUOptimizerBuilder().load()
         self._state: dict[int, dict[str, np.ndarray]] = {}
         self._step = 0
+        self.lr = lr
+
+    def moment_names(self) -> tuple[str, ...]:
+        return self.MOMENTS
+
+    def alloc_moments(self, like: np.ndarray) -> dict[str, np.ndarray]:
+        return {m: np.zeros_like(like) for m in self.MOMENTS}
 
     def state_buffers(self, idx: int) -> dict[str, np.ndarray]:
         return self._state.get(idx, {})
 
-    def _buf(self, idx: int, name: str, like: np.ndarray) -> np.ndarray:
-        st = self._state.setdefault(idx, {})
-        if name not in st:
-            st[name] = np.zeros_like(like)
-        return st[name]
+    def step(self, params: Sequence[np.ndarray],
+             grads: Sequence[np.ndarray], lr: float | None = None) -> int:
+        """In-place step over host arrays; moments owned per position."""
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        for i, (p, g) in enumerate(zip(params, grads)):
+            bufs = self._state.setdefault(i, self.alloc_moments(p))
+            self.step_raw(p, g, bufs, lr, self._step)
+        return self._step
+
+    def step_raw(self, p: np.ndarray, g: np.ndarray,
+                 bufs: dict[str, np.ndarray], lr: float, step: int) -> None:
+        raise NotImplementedError
 
 
 class DeepSpeedCPUAdam(_CPUOptimizerBase):
     """reference: ops/adam/cpu_adam.py:13"""
 
+    MOMENTS = ("exp_avg", "exp_avg_sq")
+
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, adamw_mode=True):
-        super().__init__()
-        self.lr = lr
+        super().__init__(lr)
         self.betas = betas
         self.eps = eps
         self.weight_decay = weight_decay
         self.adamw_mode = adamw_mode
 
-    def step(self, params: Sequence[np.ndarray],
-             grads: Sequence[np.ndarray], lr: float | None = None) -> int:
-        """In-place Adam step over host arrays. Returns the step count."""
-        self._step += 1
-        lr = self.lr if lr is None else lr
-        for i, (p, g) in enumerate(zip(params, grads)):
-            m = self._buf(i, "exp_avg", p)
-            v = self._buf(i, "exp_avg_sq", p)
-            self._lib.ds_cpu_adam_step(
-                _ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
-                lr, self.betas[0], self.betas[1], self.eps,
-                self.weight_decay, self._step, int(self.adamw_mode))
-        return self._step
+    def step_raw(self, p, g, bufs, lr, step):
+        self._lib.ds_cpu_adam_step(
+            _ptr(p), _ptr(g), _ptr(bufs["exp_avg"]),
+            _ptr(bufs["exp_avg_sq"]), p.size,
+            lr, self.betas[0], self.betas[1], self.eps,
+            self.weight_decay, step, int(self.adamw_mode))
 
 
 class DeepSpeedCPUAdagrad(_CPUOptimizerBase):
     """reference: ops/adagrad/cpu_adagrad.py"""
 
+    MOMENTS = ("exp_avg_sq",)
+
     def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
-        super().__init__()
-        self.lr = lr
+        super().__init__(lr)
         self.eps = eps
         self.weight_decay = weight_decay
 
-    def step(self, params, grads, lr=None):
-        self._step += 1
-        lr = self.lr if lr is None else lr
-        for i, (p, g) in enumerate(zip(params, grads)):
-            acc = self._buf(i, "accum", p)
-            self._lib.ds_cpu_adagrad_step(
-                _ptr(p), _ptr(g), _ptr(acc), p.size, lr, self.eps,
-                self.weight_decay)
-        return self._step
+    def step_raw(self, p, g, bufs, lr, step):
+        self._lib.ds_cpu_adagrad_step(
+            _ptr(p), _ptr(g), _ptr(bufs["exp_avg_sq"]), p.size, lr,
+            self.eps, self.weight_decay)
 
 
 class DeepSpeedCPULion(_CPUOptimizerBase):
     """reference: ops/lion/cpu_lion.py"""
 
+    MOMENTS = ("exp_avg",)
+
     def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
-        super().__init__()
-        self.lr = lr
+        super().__init__(lr)
         self.betas = betas
         self.weight_decay = weight_decay
 
-    def step(self, params, grads, lr=None):
-        self._step += 1
-        lr = self.lr if lr is None else lr
-        for i, (p, g) in enumerate(zip(params, grads)):
-            m = self._buf(i, "exp_avg", p)
-            self._lib.ds_cpu_lion_step(
-                _ptr(p), _ptr(g), _ptr(m), p.size, lr,
-                self.betas[0], self.betas[1], self.weight_decay)
-        return self._step
+    def step_raw(self, p, g, bufs, lr, step):
+        self._lib.ds_cpu_lion_step(
+            _ptr(p), _ptr(g), _ptr(bufs["exp_avg"]), p.size, lr,
+            self.betas[0], self.betas[1], self.weight_decay)
 
 
 class DeepSpeedCPULamb(_CPUOptimizerBase):
     """reference: ops/lamb/fused_lamb.py (LAMB trust-ratio scaling; the
     two-phase norm reduction mirrors fused_lamb_cuda_kernel.cu)."""
 
+    MOMENTS = ("exp_avg", "exp_avg_sq")
+
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
                  weight_decay=0.0, min_trust=0.01, max_trust=10.0):
-        super().__init__()
-        self.lr = lr
+        super().__init__(lr)
         self.betas = betas
         self.eps = eps
         self.weight_decay = weight_decay
         self.min_trust = min_trust
         self.max_trust = max_trust
 
-    def step(self, params, grads, lr=None):
-        self._step += 1
-        lr = self.lr if lr is None else lr
+    def step_raw(self, p, g, bufs, lr, step):
+        upd = np.empty_like(p)
         pn = ctypes.c_float()
         un = ctypes.c_float()
-        for i, (p, g) in enumerate(zip(params, grads)):
-            m = self._buf(i, "exp_avg", p)
-            v = self._buf(i, "exp_avg_sq", p)
-            upd = self._buf(i, "update", p)
-            self._lib.ds_cpu_lamb_phase1(
-                _ptr(p), _ptr(g), _ptr(m), _ptr(v), _ptr(upd), p.size,
-                self.betas[0], self.betas[1], self.eps, self.weight_decay,
-                self._step, ctypes.byref(pn), ctypes.byref(un))
-            p_norm = float(np.sqrt(pn.value))
-            u_norm = float(np.sqrt(un.value))
-            if p_norm > 0 and u_norm > 0:
-                trust = np.clip(p_norm / u_norm, self.min_trust,
-                                self.max_trust)
-            else:
-                trust = 1.0
-            self._lib.ds_cpu_lamb_phase2(_ptr(p), _ptr(upd), p.size, lr,
-                                         trust)
-        return self._step
+        self._lib.ds_cpu_lamb_phase1(
+            _ptr(p), _ptr(g), _ptr(bufs["exp_avg"]),
+            _ptr(bufs["exp_avg_sq"]), _ptr(upd), p.size,
+            self.betas[0], self.betas[1], self.eps, self.weight_decay,
+            step, ctypes.byref(pn), ctypes.byref(un))
+        p_norm = float(np.sqrt(pn.value))
+        u_norm = float(np.sqrt(un.value))
+        if p_norm > 0 and u_norm > 0:
+            trust = float(np.clip(p_norm / u_norm, self.min_trust,
+                                  self.max_trust))
+        else:
+            trust = 1.0
+        self._lib.ds_cpu_lamb_phase2(_ptr(p), _ptr(upd), p.size, lr, trust)
 
 
 class DeepSpeedCPUSGD(_CPUOptimizerBase):
+    MOMENTS = ("momentum",)
+
     def __init__(self, lr=1e-2, momentum=0.0, weight_decay=0.0):
-        super().__init__()
-        self.lr = lr
+        super().__init__(lr)
         self.momentum = momentum
         self.weight_decay = weight_decay
 
-    def step(self, params, grads, lr=None):
-        self._step += 1
-        lr = self.lr if lr is None else lr
-        for i, (p, g) in enumerate(zip(params, grads)):
-            m = self._buf(i, "momentum", p)
-            self._lib.ds_cpu_sgd_step(
-                _ptr(p), _ptr(g), _ptr(m), p.size, lr, self.momentum,
-                self.weight_decay)
-        return self._step
+    def step_raw(self, p, g, bufs, lr, step):
+        self._lib.ds_cpu_sgd_step(
+            _ptr(p), _ptr(g), _ptr(bufs["momentum"]), p.size, lr,
+            self.momentum, self.weight_decay)
 
 
 def build_cpu_optimizer(opt_type: str, params: dict):

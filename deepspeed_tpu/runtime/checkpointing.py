@@ -47,7 +47,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     state = dict(engine.state)
     if state.get("master") is None:
         state.pop("master", None)
+    if state.get("opt_state") in ((), {}, None):
+        state.pop("opt_state", None)
     ce.save(state, os.path.join(path, "state"))
+    if getattr(engine, "_offload_opt", None) is not None:
+        # host-side master/moments (NVMe tier): per-rank files, the
+        # analogue of per-DP-rank *_optim_states.pt
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(
+            path, f"host_opt_rank{jax.process_index()}.npz"),
+            **engine._offload_opt.state_dict())
     meta = {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
@@ -116,9 +125,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     abstract = dict(abstract)
     if engine.state.get("master") is None:
         abstract.pop("master", None)
+    if engine.state.get("opt_state") in ((), {}, None):
+        abstract.pop("opt_state", None)
     restored = ce.load(os.path.join(path, "state"), abstract)
     if "master" not in restored:
         restored["master"] = None
+    if "opt_state" not in restored:
+        restored["opt_state"] = engine.state.get("opt_state", ())
     if load_module_only:
         engine.state["params"] = restored["params"]
     elif not load_optimizer_states:
@@ -126,6 +139,16 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             engine.state[k] = restored[k]
     else:
         engine.state = restored
+
+    if (getattr(engine, "_offload_opt", None) is not None
+            and not load_module_only):
+        host_file = os.path.join(
+            path, f"host_opt_rank{jax.process_index()}.npz")
+        if os.path.exists(host_file):
+            engine._offload_opt.load_state_dict(dict(np.load(host_file)))
+            # host master is the fp32 source of truth; refresh device
+            # params from it (after the state assignment above)
+            engine.state["params"] = engine._offload_opt.updated_params()
 
     meta_path = os.path.join(path, "ds_meta.json")
     client_state = {}

@@ -43,6 +43,17 @@ from .zero import ZeroShardingPlan
 PyTree = Any
 
 
+def fetch_to_device(tree: PyTree, tree_shardings: PyTree) -> PyTree:
+    """Stream pinned_host-resident leaves into device memory (the compiled
+    analogue of the reference's offload H2D copies, stage_1_and_2.py:1186);
+    no-op for device-resident leaves. Usable inside and outside jit."""
+    return jax.tree.map(
+        lambda x, s: (jax.device_put(x, NamedSharding(s.mesh, s.spec))
+                      if getattr(s, "memory_kind", None) == "pinned_host"
+                      else x),
+        tree, tree_shardings)
+
+
 class DeepSpeedEngine:
     """Compiled-step training engine over a device mesh."""
 
@@ -118,6 +129,12 @@ class DeepSpeedEngine:
             pipeline=self._is_pipeline)
         self._build_state_shardings(abstract)
 
+        # NVMe tier keeps master+moments off-device entirely (host RAM /
+        # disk via the native AIO op); cpu tier keeps them as pinned_host
+        # arrays inside the compiled step (see runtime/offload.py)
+        self._nvme_offload = zcfg.offload_optimizer.device == "nvme"
+        self._offload_opt = None
+
         def _init_state(rng_or_params):
             if model_parameters is None:
                 params32 = self.module.init(rng_or_params)
@@ -126,8 +143,10 @@ class DeepSpeedEngine:
             params32 = jax.tree.map(lambda x: x.astype(jnp.float32), params32)
             params = jax.tree.map(
                 lambda x: x.astype(self.compute_dtype), params32)
-            master = params32 if self._mixed else None
-            opt_state = self.tx.init(params32)
+            master = (params32 if self._mixed and not self._nvme_offload
+                      else None)
+            opt_state = (() if self._nvme_offload
+                         else self.tx.init(params32))
             return {"step": jnp.zeros((), jnp.int32),
                     "params": params,
                     "master": master,
@@ -138,18 +157,39 @@ class DeepSpeedEngine:
         abstract_state = jax.eval_shape(
             _init_state, rng if model_parameters is None else params_host)
         self.state_shardings = self._state_sharding_tree(abstract_state)
-        init_jit = jax.jit(_init_state, out_shardings=self.state_shardings)
-        if model_parameters is None:
-            self.state = init_jit(rng)
-        else:
-            self.state = init_jit(params_host)
+        # init in default (device) memory — XLA's SPMD partitioner can't
+        # annotate host placement on constants — then move offloaded trees
+        # to pinned_host with an explicit transfer
+        init_shardings = jax.tree.map(
+            lambda s: (NamedSharding(s.mesh, s.spec)
+                       if s.memory_kind == "pinned_host" else s),
+            self.state_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        init_jit = jax.jit(_init_state, out_shardings=init_shardings)
+        self.state = init_jit(rng if model_parameters is None
+                              else params_host)
+        if self._uses_host_memory:
+            self.state = jax.device_put(self.state, self.state_shardings)
 
         # --- sequence parallelism (reference: deepspeed/sequence) -------
         self._loss_fn = self._configure_sequence_parallel()
 
         # --- compiled step ----------------------------------------------
-        self._train_step = self._build_train_step()
-        self._eval_loss = jax.jit(self._loss_fn)
+        def _loss_on_device(params, batch):
+            return self._loss_fn(self._params_to_device(params), batch)
+
+        self._loss_fn_dev = _loss_on_device
+        if self._nvme_offload:
+            if self._is_pipeline:
+                raise ValueError(
+                    "offload_optimizer device=nvme is not supported with "
+                    "pipeline parallelism")
+            from .offload import NVMeOffloadOptimizer
+            self._offload_opt = NVMeOffloadOptimizer(self)
+            self._train_step = self._build_grads_step()
+        else:
+            self._train_step = self._build_train_step()
+        self._eval_loss = jax.jit(self._loss_fn_dev)
         self._micro_grads_jit = None
         self._apply_grads_jit = None
         self._accum_grads = None
@@ -221,14 +261,35 @@ class DeepSpeedEngine:
 
     def _state_sharding_tree(self, abstract_state):
         rep = NamedSharding(self.mesh, PartitionSpec())
-        master_specs = (self.plan.master_specs if self._mixed else None)
+        zcfg = self.config.zero_optimization
+        have_master = self._mixed and not self._nvme_offload
+
+        def with_host(shardings, offloaded: bool):
+            """ZeRO-Offload cpu tier: pinned_host placement — XLA streams
+            these through HBM inside the compiled step (the role of the
+            reference's pinned-buffer CPU offload path,
+            stage_1_and_2.py:1186)."""
+            if not offloaded:
+                return shardings
+            return jax.tree.map(
+                lambda s: NamedSharding(s.mesh, s.spec,
+                                        memory_kind="pinned_host"),
+                shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+
+        opt_off = zcfg.offload_optimizer.device == "cpu"
+        param_off = zcfg.offload_param.device == "cpu"
+        self._uses_host_memory = opt_off or param_off
         return {
             "step": rep,
-            "params": named_shardings(self.mesh, self.plan.param_specs),
-            "master": (named_shardings(self.mesh, master_specs)
-                       if self._mixed else None),
-            "opt_state": named_shardings(
+            "params": with_host(
+                named_shardings(self.mesh, self.plan.param_specs), param_off),
+            "master": (with_host(
+                named_shardings(self.mesh, self.plan.master_specs), opt_off)
+                if have_master else None),
+            "opt_state": with_host(named_shardings(
                 self.mesh, self.plan.opt_specs(abstract_state["opt_state"])),
+                opt_off),
             "loss_scale": jax.tree.map(lambda _: rep,
                                        abstract_state["loss_scale"]),
         }
@@ -238,6 +299,32 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _wrap_module(self, module):
         return module
+
+    def _disable_host_memory(self, err):
+        """pinned_host compute placement isn't supported by every backend's
+        SPMD partitioner (CPU emulation in particular). Fall back to device
+        memory: numerics are identical, only the HBM savings are lost."""
+        logger.warning(
+            "host-memory offload placement unsupported on backend "
+            f"{jax.default_backend()!r} ({str(err).splitlines()[0][:120]}); "
+            "keeping optimizer state in device memory")
+        self.state_shardings = jax.tree.map(
+            lambda s: (NamedSharding(s.mesh, s.spec)
+                       if getattr(s, "memory_kind", None) == "pinned_host"
+                       else s),
+            self.state_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        self.state = jax.device_put(self.state, self.state_shardings)
+        self._uses_host_memory = False
+        self._train_step = self._build_train_step()
+        self._eval_loss = jax.jit(self._loss_fn_dev)
+        self._micro_grads_jit = None
+        self._apply_grads_jit = None
+
+    def _params_to_device(self, params):
+        """In-jit transfer of pinned_host params to device memory (no-op
+        unless offload_param device=cpu)."""
+        return fetch_to_device(params, self.state_shardings["params"])
 
     def _build_train_step(self):
         ga = self._scan_ga or self.gradient_accumulation_steps_
@@ -252,6 +339,8 @@ class DeepSpeedEngine:
         tx = self.tx
         mixed = self._mixed
         compute_dtype = self.compute_dtype
+        shardings = self.state_shardings
+        fetch = fetch_to_device
 
         def micro_loss(params, batch, scale):
             loss = loss_fn(params, batch)
@@ -260,7 +349,7 @@ class DeepSpeedEngine:
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
 
         def train_step(state, batch):
-            params = state["params"]
+            params = fetch(state["params"], shardings["params"])
             scale = state["loss_scale"].scale
 
             def body(acc, micro):
@@ -296,8 +385,10 @@ class DeepSpeedEngine:
                 coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
 
-            master = state["master"] if mixed else state["params"]
-            updates, new_opt = tx.update(grads, state["opt_state"], master)
+            master = (fetch(state["master"], shardings["master"])
+                      if mixed else params)
+            opt_state = fetch(state["opt_state"], shardings["opt_state"])
+            updates, new_opt = tx.update(grads, opt_state, master)
             new_master = jax.tree.map(jnp.add, master, updates)
 
             if fp16:
@@ -305,7 +396,7 @@ class DeepSpeedEngine:
                 sel = lambda new, old: jax.tree.map(  # noqa: E731
                     lambda n, o: jnp.where(finite, n, o), new, old)
                 new_master = sel(new_master, master)
-                new_opt = sel(new_opt, state["opt_state"])
+                new_opt = sel(new_opt, opt_state)
             new_params = jax.tree.map(
                 lambda m: m.astype(compute_dtype), new_master)
             new_params = constrain(new_params, mesh, param_specs)
@@ -335,7 +426,87 @@ class DeepSpeedEngine:
             return new_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0,),
+                       in_shardings=(self.state_shardings, None),
                        out_shardings=(self.state_shardings, None))
+
+    def _build_grads_step(self):
+        """Compiled half of the NVMe-offload step: grads + norm + overflow
+        on device; the optimizer math runs on host (runtime/offload.py)."""
+        ga = self.gradient_accumulation_steps_
+        fp16 = self.fp16_enabled
+        fp16_cfg = self.config.fp16
+        dynamic = fp16 and fp16_cfg.loss_scale == 0
+        mesh = self.mesh
+        grad_specs = self.plan.grad_specs
+        loss_fn = self._loss_fn
+
+        def micro_loss(params, batch, scale):
+            loss = loss_fn(params, batch)
+            return loss * scale.astype(loss.dtype), loss
+
+        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+        def grads_step(state, batch):
+            params = state["params"]
+            scale = state["loss_scale"].scale
+
+            def body(acc, micro):
+                (_, loss), grads = grad_fn(params, micro, scale)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                grads = constrain(grads, mesh, grad_specs)
+                return jax.tree.map(jnp.add, acc, grads), loss
+
+            micro_batches = jax.tree.map(
+                lambda x: x.reshape(ga, x.shape[0] // ga, *x.shape[1:]),
+                batch)
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            zeros = constrain(zeros, mesh, grad_specs)
+            grads, losses = jax.lax.scan(body, zeros, micro_batches)
+            inv = 1.0 / (scale * ga)
+            grads = jax.tree.map(lambda g: g * inv, grads)
+
+            finite = jnp.array(True)
+            if fp16:
+                leaves = jax.tree.leaves(
+                    jax.tree.map(lambda g: jnp.isfinite(g).all(), grads))
+                finite = functools.reduce(jnp.logical_and, leaves)
+            sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+            grad_norm = jnp.sqrt(sq)
+
+            ls = state["loss_scale"]
+            if fp16:
+                ls = update_loss_scale(
+                    ls, ~finite, dynamic=dynamic,
+                    scale_window=fp16_cfg.loss_scale_window,
+                    min_scale=fp16_cfg.min_loss_scale,
+                    hysteresis=fp16_cfg.hysteresis)
+            metrics = {"loss": jnp.mean(losses), "grad_norm": grad_norm,
+                       "loss_scale": ls.scale, "overflow": ~finite}
+            return grads, ls, metrics
+
+        return jax.jit(grads_step,
+                       out_shardings=(named_shardings(mesh, grad_specs),
+                                      None, None))
+
+    def _train_batch_offload(self, batch):
+        """NVMe tier: device grads -> native CPU optimizer over host master
+        shards (moments pipelined through the AIO op) -> params back."""
+        grads, ls, metrics = self._train_step(self.state, batch)
+        self.state["loss_scale"] = ls
+        if not bool(metrics["overflow"]):
+            step_before = int(self.state["step"])
+            lr = float(self.lr_schedule(step_before))
+            clip = self.config.gradient_clipping
+            coef = 1.0
+            if clip > 0:
+                coef = min(1.0, clip / (float(metrics["grad_norm"]) + 1e-6))
+            self._offload_opt.step(grads, lr=lr, grad_scale=coef)
+            self.state["params"] = self._offload_opt.updated_params()
+            self.state["step"] = jax.device_put(
+                np.asarray(step_before + 1, np.int32),
+                self.state_shardings["step"])
+        return metrics
 
     # ------------------------------------------------------------------
     # public API (reference parity)
@@ -353,7 +524,18 @@ class DeepSpeedEngine:
             batch = next(data_iter)
         batch = self._put_batch(batch)
         self.tput_timer.start()
-        self.state, metrics = self._train_step(self.state, batch)
+        if self._offload_opt is not None:
+            metrics = self._train_batch_offload(batch)
+        else:
+            try:
+                self.state, metrics = self._train_step(self.state, batch)
+            except jax.errors.JaxRuntimeError as e:
+                if not (self._uses_host_memory
+                        and ("annotate_device_placement" in str(e)
+                             or "Side-effect" in str(e))):
+                    raise
+                self._disable_host_memory(e)
+                self.state, metrics = self._train_step(self.state, batch)
         self.global_steps += 1
         self.global_samples += self.train_batch_size_
         if self.global_steps % self.config.steps_per_print == 0:
@@ -405,6 +587,8 @@ class DeepSpeedEngine:
         parity; gradients are recomputed functionally."""
         if self._micro_grads_jit is None:
             def micro(params, batch, scale):
+                params = self._params_to_device(params)
+
                 def f(p):
                     return self._loss_fn(p, batch) * scale
                 g = jax.grad(f)(params)
@@ -428,6 +612,41 @@ class DeepSpeedEngine:
         """Apply the optimizer update from accumulated grads (reference:
         engine.step:2204). No-op until the GAS boundary."""
         if not self.is_gradient_accumulation_boundary():
+            return
+        if self._offload_opt is not None:
+            import math
+            scale = float(self.state["loss_scale"].scale)
+            inv = 1.0 / (scale * self.gradient_accumulation_steps_)
+            leaves = jax.tree.leaves(self._accum_grads)
+            finite = all(bool(jnp.isfinite(g).all()) for g in leaves) \
+                if self.fp16_enabled else True
+            if self.fp16_enabled:
+                fp16_cfg = self.config.fp16
+                self.state["loss_scale"] = update_loss_scale(
+                    self.state["loss_scale"], jnp.asarray(not finite),
+                    dynamic=fp16_cfg.loss_scale == 0,
+                    scale_window=fp16_cfg.loss_scale_window,
+                    min_scale=fp16_cfg.min_loss_scale,
+                    hysteresis=fp16_cfg.hysteresis)
+            if finite:
+                sq = sum(float(jnp.sum(jnp.square(g))) for g in leaves)
+                norm = math.sqrt(sq) * inv
+                clip = self.config.gradient_clipping
+                coef = min(1.0, clip / (norm + 1e-6)) if clip > 0 else 1.0
+                step_before = int(self.state["step"])
+                lr = float(self.lr_schedule(step_before))
+                self._offload_opt.step(self._accum_grads, lr=lr,
+                                       grad_scale=inv * coef)
+                self.state["params"] = self._offload_opt.updated_params()
+                self.state["step"] = jax.device_put(
+                    np.asarray(step_before + 1, np.int32),
+                    self.state_shardings["step"])
+            else:
+                self.skipped_steps += 1
+            self._accum_grads = None
+            self._micro_count = 0
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size_
             return
         if self._apply_grads_jit is None:
             self._apply_grads_jit = self._build_apply_grads()
