@@ -69,8 +69,9 @@ def init_inference(model=None, config=None, **kwargs):
         raise NotImplementedError(
             f"deepspeed_tpu.init_inference requires {e.name}, which is not "
             "built yet in this checkout") from e
+    params = kwargs.pop("params", None)
     cfg = DeepSpeedInferenceConfig.from_any(config, **kwargs)
-    return InferenceEngine(model, cfg)
+    return InferenceEngine(model, cfg, params=params)
 
 
 def add_config_arguments(parser):

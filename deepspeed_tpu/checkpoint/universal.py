@@ -98,25 +98,34 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str,
         import glob
         rank_files = sorted(glob.glob(os.path.join(
             checkpoint_dir, tag, "host_opt_rank*.npz")))
-        hsd: dict[str, np.ndarray] = {}
+        # rank files hold per-shard slices (shard::<field>::<name>::<idx>)
+        # — disjoint or identically replicated, so overlay-assembly is
+        # exact regardless of rank count
+        from ..runtime.offload import _parse_index_key
+        pieces: dict[tuple[str, str], dict[str, np.ndarray]] = {}
         for f in rank_files:
-            data = dict(np.load(f))
-            for k, v in data.items():
-                if k.startswith("__"):
+            for k, v in np.load(f).items():
+                if not k.startswith("shard::"):
                     continue
-                # rank files are full-shaped with only the local shards
-                # filled; the ownership mask makes the merge replicated-
-                # safe (overlay, not sum)
-                mask = data.get(f"__mask__::{k.split('::', 1)[1]}")
-                if k not in hsd:
-                    hsd[k] = v.copy()
-                elif mask is not None:
-                    hsd[k][mask] = v[mask]
-                else:  # legacy file without masks: overlay everything
-                    hsd[k] = v
-        named = [(n, hsd.get(f"master::{n}", v)) for n, v in named]
-        moments = {n: [(f"{m}::{n}", hsd[f"{m}::{n}"])
-                       for m in MOMENT_NAMES if f"{m}::{n}" in hsd]
+                _, field, name, ik = k.split("::", 3)
+                pieces.setdefault((field, name), {})[ik] = v
+
+        def assemble(field: str, name: str, shape):
+            entry = pieces.get((field, name))
+            if not entry:
+                return None
+            full = np.zeros(shape, np.float32)
+            for ik, data in entry.items():
+                full[_parse_index_key(ik)] = data
+            return full
+
+        merged = []
+        for n, v in named:
+            arr = assemble("master", n, np.shape(v))
+            merged.append((n, arr if arr is not None else v))
+        named = merged
+        moments = {n: [(f"{m}::{n}", arr) for m in MOMENT_NAMES
+                       if (arr := assemble(m, n, shapes[n])) is not None]
                    for n in names}
 
     zdir = os.path.join(os.path.abspath(output_dir), ZERO_DIR)

@@ -1,0 +1,4 @@
+"""Inference package (reference: deepspeed/inference/)."""
+
+from .config import DeepSpeedInferenceConfig  # noqa: F401
+from .engine import InferenceEngine  # noqa: F401

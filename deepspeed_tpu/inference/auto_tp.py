@@ -1,0 +1,70 @@
+"""Automatic tensor parallelism (reference: module_inject/auto_tp.py —
+AutoTP.tp_parser:273 walks the module graph classifying each Linear as
+row- or column-parallel; ReplaceWithTensorSlicing shards the weights and
+an allreduce is placed at each row-parallel output).
+
+TPU build: models that follow the Model protocol carry explicit
+partition_rules() (the parsed form the reference derives). For foreign
+parameter trees, `auto_tp_rules` infers Megatron-style rules from names
+and shapes — name patterns mirror the reference's policy tables
+(module_inject/replace_policy.py): q/k/v/up/gate project out
+(column-parallel, shard last dim), o/down/out project back
+(row-parallel, shard first of the matmul dims). The allreduce the
+reference inserts after row-parallel layers is emitted by XLA from the
+shardings — no hook needed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+PyTree = Any
+
+COLUMN_PAT = re.compile(
+    r"(wq|wk|wv|w_up|w_gate|q_proj|k_proj|v_proj|up_proj|gate_proj|"
+    r"query|key|value|fc_in|c_fc|w1|w3|in_proj|qkv)", re.I)
+ROW_PAT = re.compile(
+    r"(wo|w_down|o_proj|down_proj|dense_4h_to_h|out_proj|c_proj|fc_out|"
+    r"w2|proj_out)", re.I)
+
+
+def auto_tp_rules(params: PyTree, tp_axis: str = "tp") -> list:
+    """Infer (regex, PartitionSpec) rules for an arbitrary param tree."""
+    import jax
+
+    rules: list[tuple[str, P]] = []
+    seen: set[str] = set()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        parts = []
+        for k in path:
+            parts.append(str(getattr(k, "key", getattr(k, "name",
+                                                       getattr(k, "idx", k)))))
+        name = "/".join(parts)
+        shape = np.shape(leaf)
+        if len(shape) < 2:
+            continue
+        pat = None
+        if COLUMN_PAT.search(name):
+            spec = [None] * len(shape)
+            spec[-1] = tp_axis          # column-parallel: shard out dim
+            pat = (re.escape(name) + "$", P(*spec))
+        elif ROW_PAT.search(name):
+            spec = [None] * len(shape)
+            spec[-2] = tp_axis          # row-parallel: shard in dim
+            pat = (re.escape(name) + "$", P(*spec))
+        if pat and pat[0] not in seen:
+            seen.add(pat[0])
+            rules.append(pat)
+    return rules
+
+
+def get_tp_rules(model, params: PyTree, tp_axis: str = "tp") -> list:
+    """Model-provided rules when available, inferred otherwise
+    (reference: policy classes vs AutoTP fallback)."""
+    if hasattr(model, "partition_rules"):
+        return model.partition_rules()
+    return auto_tp_rules(params, tp_axis)

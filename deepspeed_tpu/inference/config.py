@@ -1,0 +1,62 @@
+"""Inference config (reference: deepspeed/inference/config.py
+DeepSpeedInferenceConfig — dtype, tensor_parallel, max_out_tokens,
+kernel-injection and cuda-graph knobs)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """reference: inference/config.py DeepSpeedTPConfig"""
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Field names follow the reference so configs port unchanged."""
+    dtype: str = "bfloat16"          # reference default fp16; bf16 on TPU
+    tensor_parallel: DeepSpeedTPConfig = Field(
+        default_factory=DeepSpeedTPConfig, alias="tp")
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 1024
+    checkpoint: Optional[str] = None
+    # accepted for API parity; kernel injection == the pallas/XLA path
+    replace_with_kernel_inject: bool = False
+    replace_method: str = "auto"
+    enable_cuda_graph: bool = False   # XLA compiles the whole graph anyway
+    triangular_masking: bool = True
+    return_tuple: bool = True
+    seed: int = 0
+
+    @classmethod
+    def from_any(cls, config=None, **kwargs) -> "DeepSpeedInferenceConfig":
+        import json
+        if isinstance(config, cls):
+            if kwargs:
+                merged = config.model_dump()
+                merged.update(kwargs)
+                return cls(**merged)
+            return config
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        config = dict(config or {})
+        # reference accepts tp via kwargs (tensor_parallel={"tp_size": N})
+        config.update(kwargs)
+        return cls(**config)
+
+    @property
+    def jax_dtype(self):
+        import jax.numpy as jnp
+        return {"float32": jnp.float32, "fp32": jnp.float32,
+                "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+                "int8": jnp.int8}[str(self.dtype).replace("torch.", "")]

@@ -1,0 +1,97 @@
+"""Paged KV attention + paged model forward (reference:
+inference/v2/kernels/ragged_ops/ — blocked_flash is a paged FlashAttention
+over the block table; linear_blocked_kv_rotary writes rotary-embedded k/v
+into KV blocks; logits_gather picks each sequence's last-token logits).
+
+TPU translation: one function computes a layer's qkv, scatters k/v into
+the block pool (XLA scatter with mode='drop' for padded slots), gathers
+the sequence's pages, and runs masked attention. On TPU with aligned
+shapes the decode path can dispatch to the production paged-attention
+Pallas kernel; the jnp gather path below is the portable reference and
+handles prefill chunks (q_len > 1) everywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = dict
+
+
+def scatter_kv(pool: jax.Array, kv: jax.Array, block_table: jax.Array,
+               pos0: jax.Array, true_len: jax.Array):
+    """Write kv [B, S, H, D] for positions [pos0, pos0+S) into the pool
+    [num_blocks, bs, H, D] through block_table [B, max_blocks]; pos0 and
+    true_len are [B]. Slots beyond true_len are dropped (their block id is
+    forced out of bounds). (reference: ragged_ops/linear_blocked_kv_copy)"""
+    nb, bs = pool.shape[0], pool.shape[1]
+    b, s = kv.shape[:2]
+    positions = pos0[:, None] + jnp.arange(s)[None, :]        # [B, S]
+    blk = jnp.take_along_axis(block_table, positions // bs, axis=1)
+    off = positions % bs
+    # invalid slots (i >= true_len) -> OOB block id so the write drops
+    valid = jnp.arange(s)[None, :] < true_len[:, None]
+    blk = jnp.where(valid, blk, nb)
+    return pool.at[blk, off].set(kv.astype(pool.dtype), mode="drop")
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    block_tables: jax.Array, pos0: jax.Array):
+    """q: [B, S_new, H, D]; pools [num_blocks, bs, H_kv, D]; block_tables
+    [B, max_blocks]; pos0 [B] tokens already cached before this chunk.
+    Causal over absolute positions. (reference: blocked_flash)"""
+    b, sq, hq, d = q.shape
+    bs = k_pool.shape[1]
+    hkv = k_pool.shape[2]
+    max_blocks = block_tables.shape[1]
+    smax = max_blocks * bs
+
+    # gather pages -> contiguous [B, smax, hkv, d] (clamp OOB table slots)
+    safe = jnp.minimum(block_tables, k_pool.shape[0] - 1)
+    k = k_pool[safe].reshape(b, smax, hkv, d)
+    v = v_pool[safe].reshape(b, smax, hkv, d)
+    if hq != hkv:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    qpos = pos0[:, None] + jnp.arange(sq)[None, :]            # [B, S]
+    kpos = jnp.arange(smax)[None, :]
+    mask = kpos[:, None, :] <= qpos[:, :, None]               # [B, S, smax]
+    logits = jnp.where(mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
+                  pos0: jax.Array, block_tables: jax.Array,
+                  true_len: jax.Array):
+    """Full model pass over a (padded) chunk of new tokens with paged KV.
+
+    tokens [B, S]; pos0 [B]; block_tables [B, max_blocks]; true_len [B]
+    actual new-token counts (padding beyond is masked). Returns
+    (logits [B, S, V], new_pools).
+    """
+    b, s = tokens.shape
+    positions = pos0[:, None] + jnp.arange(s)[None, :]
+    x = model.embed(params, tokens, positions=positions)
+
+    def body(x, xs):
+        p, k_pool, v_pool = xs
+        h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
+        q, k, v = model._qkv(p, h, positions)
+        k_pool = scatter_kv(k_pool, k, block_tables, pos0, true_len)
+        v_pool = scatter_kv(v_pool, v, block_tables, pos0, true_len)
+        a = paged_attention(q, k_pool, v_pool, block_tables, pos0)
+        x = x + model._attn_out(p, a)
+        x, _ = model._mlp_residual(p, x)
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pools["k"], pools["v"]))
+    logits = model.unembed(params, x)
+    return logits, {"k": new_k, "v": new_v}

@@ -1,0 +1,112 @@
+"""Blocked (paged) KV cache + ragged batch bookkeeping (reference:
+inference/v2/ragged/ — DSStateManager (ragged_manager.py:19) owns a pool
+of fixed-size KV blocks and per-sequence page tables; RaggedBatchWrapper
+(ragged_wrapper.py:31) packs every scheduled sequence's tokens into one
+flat batch; the blocked allocator gates admission (engine_v2.py
+query/can_schedule:158/:184)).
+
+TPU translation: the pool is one device array per k/v with layout
+``[L, num_blocks, block_size, H_kv, D]``; page tables and sequence
+descriptors are host-side numpy (they change every step — keeping them off
+the compiled path avoids recompiles); attention reads the pool through the
+page table (paged.py). Shapes entering XLA are bucketed, not ragged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class SequenceDescriptor:
+    """reference: ragged/sequence_descriptor.py"""
+    uid: int
+    tokens: list[int]                    # full token history (prompt+gen)
+    seen: int = 0                        # tokens already in the KV cache
+    blocks: list[int] = field(default_factory=list)
+    done: bool = False
+
+    @property
+    def pending(self) -> int:
+        return len(self.tokens) - self.seen
+
+
+class BlockedAllocator:
+    """Fixed-pool block allocator (reference:
+    ragged/blocked_allocator.py — free-list over num_blocks)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, -1, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: want {n} blocks, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, blocks: list[int]) -> None:
+        self._free.extend(blocks)
+
+
+class DSStateManager:
+    """Sequence tracking + block accounting (reference:
+    ragged/ragged_manager.py:19)."""
+
+    def __init__(self, block_size: int, num_blocks: int,
+                 max_blocks_per_seq: int):
+        self.block_size = block_size
+        self.allocator = BlockedAllocator(num_blocks)
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.seqs: dict[int, SequenceDescriptor] = {}
+
+    def get_or_create(self, uid: int) -> SequenceDescriptor:
+        if uid not in self.seqs:
+            self.seqs[uid] = SequenceDescriptor(uid=uid, tokens=[])
+        return self.seqs[uid]
+
+    def blocks_needed(self, seq: SequenceDescriptor, new_tokens: int) -> int:
+        total = len(seq.tokens) + new_tokens
+        need = -(-total // self.block_size)  # ceil
+        return max(0, need - len(seq.blocks))
+
+    def can_schedule(self, uid: int, new_tokens: int) -> bool:
+        """reference: engine_v2.can_schedule:184"""
+        seq = self.seqs.get(uid) or SequenceDescriptor(uid=uid, tokens=[])
+        need = self.blocks_needed(seq, new_tokens)
+        total_blocks = len(seq.blocks) + need
+        return (need <= self.allocator.free_blocks
+                and total_blocks <= self.max_blocks_per_seq)
+
+    def extend(self, uid: int, tokens: list[int]) -> SequenceDescriptor:
+        """Append tokens to a sequence, allocating blocks to cover them."""
+        seq = self.get_or_create(uid)
+        need = self.blocks_needed(seq, len(tokens))
+        if len(seq.blocks) + need > self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"sequence {uid} exceeds max length "
+                f"({self.max_blocks_per_seq * self.block_size} tokens)")
+        seq.blocks.extend(self.allocator.allocate(need))
+        seq.tokens.extend(int(t) for t in tokens)
+        return seq
+
+    def flush(self, uid: int) -> None:
+        """Release a finished sequence (reference: engine_v2.flush:242)."""
+        seq = self.seqs.pop(uid, None)
+        if seq is not None:
+            self.allocator.free(seq.blocks)
+
+    def block_table(self, seq: SequenceDescriptor) -> np.ndarray:
+        """Padded [max_blocks_per_seq] table; unused entries point past the
+        pool (scatter mode='drop' discards writes through them)."""
+        t = np.full((self.max_blocks_per_seq,),
+                    self.allocator.num_blocks, np.int32)
+        t[:len(seq.blocks)] = seq.blocks
+        return t

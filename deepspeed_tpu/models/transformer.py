@@ -123,6 +123,38 @@ class DecoderLM:
             x = x + jnp.take(params["embed"]["positions"], positions, axis=0)
         return x
 
+    def _qkv(self, p: PyTree, h: jax.Array,
+             positions: jax.Array | None = None):
+        """Shared q/k/v projection (+bias, head reshape, rope)."""
+        c = self.config
+        b, s, _ = h.shape
+        nh, nkv, hd = c.num_heads, c.num_kv_heads, c.head_dim
+        q = h @ p["wq"]
+        k = h @ p["wk"]
+        v = h @ p["wv"]
+        if c.use_bias:
+            q, k, v = q + p["wq_b"], k + p["wk_b"], v + p["wv_b"]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nkv, hd)
+        v = v.reshape(b, s, nkv, hd)
+        if self._rope is not None:
+            cos, sin = self._rope
+            q = L.apply_rotary(q, cos, sin, positions)
+            k = L.apply_rotary(k, cos, sin, positions)
+        return q, k, v
+
+    def _attn_out(self, p: PyTree, a: jax.Array) -> jax.Array:
+        b, s = a.shape[:2]
+        out = a.reshape(b, s, -1) @ p["wo"]
+        if self.config.use_bias:
+            out = out + p["wo_b"]
+        return out
+
+    def _mlp_residual(self, p: PyTree, x: jax.Array):
+        h = self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+        m, aux = self._mlp(p, h)
+        return x + m, aux
+
     def block(self, layer_params: PyTree, x: jax.Array, *,
               attn_fn: AttnFn | None = None,
               positions: jax.Array | None = None) -> jax.Array:
@@ -136,31 +168,12 @@ class DecoderLM:
                 attn_fn = flash_attention
             else:
                 attn_fn = L.dot_product_attention
-        b, s, d = x.shape
-        nh, nkv, hd = c.num_heads, c.num_kv_heads, c.head_dim
 
         h = self._norm(x, p["ln1_scale"], p.get("ln1_bias"))
-        q = h @ p["wq"]
-        k = h @ p["wk"]
-        v = h @ p["wv"]
-        if c.use_bias:
-            q, k, v = q + p["wq_b"], k + p["wk_b"], v + p["wv_b"]
-        q = q.reshape(b, s, nh, hd)
-        k = k.reshape(b, s, nkv, hd)
-        v = v.reshape(b, s, nkv, hd)
-        if self._rope is not None:
-            cos, sin = self._rope
-            q = L.apply_rotary(q, cos, sin, positions)
-            k = L.apply_rotary(k, cos, sin, positions)
+        q, k, v = self._qkv(p, h, positions)
         a = attn_fn(q, k, v, causal=True)
-        a = a.reshape(b, s, nh * hd) @ p["wo"]
-        if c.use_bias:
-            a = a + p["wo_b"]
-        x = x + a
-
-        h = self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
-        m, aux = self._mlp(p, h)
-        return x + m, aux
+        x = x + self._attn_out(p, a)
+        return self._mlp_residual(p, x)
 
     def _mlp(self, p: PyTree, h: jax.Array):
         """Dense FFN. Returns (out, aux_loss) — MoE subclasses override
@@ -182,6 +195,58 @@ class DecoderLM:
         if c.use_bias:
             m = m + p["w_down_b"]
         return m, jnp.zeros((), jnp.float32)
+
+    # ---------------- KV-cache decode (inference engine) -----------------
+    def init_cache(self, batch_size: int, max_len: int,
+                   dtype=None) -> PyTree:
+        """Static-shape KV cache (reference: inference_context.h KV buffer
+        allocation). [L, B, S_max, H_kv, D] per k/v."""
+        c = self.config
+        dt = dtype or c.param_dtype
+        shape = (c.num_layers, batch_size, max_len, c.num_kv_heads,
+                 c.head_dim)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
+                "index": jnp.zeros((), jnp.int32)}
+
+    def block_decode(self, layer_params: PyTree, x: jax.Array,
+                     k_cache: jax.Array, v_cache: jax.Array,
+                     index: jax.Array):
+        """One block over new tokens with cache read/write. x: [B, S_new,
+        D]; caches [B, S_max, H_kv, D]. Returns (x, new_k, new_v)."""
+        p = layer_params
+        b, s, _ = x.shape
+        positions = (index + jnp.arange(s))[None, :].repeat(b, axis=0)
+
+        h = self._norm(x, p["ln1_scale"], p.get("ln1_bias"))
+        q, k, v = self._qkv(p, h, positions)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), index, axis=1)
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), index, axis=1)
+        a = L.cached_attention(q, k_cache, v_cache, index)
+        x = x + self._attn_out(p, a)
+        x, _ = self._mlp_residual(p, x)
+        return x, k_cache, v_cache
+
+    def decode(self, params: PyTree, tokens: jax.Array, cache: PyTree):
+        """Prefill or incremental decode: run `tokens` (appended at
+        cache["index"]) through all layers, updating the cache. Returns
+        (logits [B, S_new, V], new_cache)."""
+        index = cache["index"]
+        b, s = tokens.shape
+        positions = (index + jnp.arange(s))[None, :].repeat(b, axis=0)
+        x = self.embed(params, tokens, positions=positions)
+
+        def body(x, xs):
+            layer_params, k_l, v_l = xs
+            x, new_k, new_v = self.block_decode(layer_params, x, k_l, v_l,
+                                                index)
+            return x, (new_k, new_v)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        logits = self.unembed(params, x)
+        return logits, {"k": new_k, "v": new_v, "index": index + s}
 
     def unembed(self, params: PyTree, x: jax.Array) -> jax.Array:
         x = self._norm(x, params["final_norm"]["scale"],

@@ -176,10 +176,12 @@ def build_cpu_optimizer(opt_type: str, params: dict):
     wd = params.get("weight_decay", 0.0)
     if name in ("adam", "adamw", "cpuadam", "deepspeedcpuadam", "fusedadam",
                 "fusedadamw", "onebitadam", "zerooneadam"):
+        # adamw/fusedadamw are always decoupled; the Adam family honors
+        # adam_w_mode (default True) — matches runtime/optimizers.py
+        adamw_mode = (True if name in ("adamw", "fusedadamw")
+                      else bool(params.get("adam_w_mode", True)))
         return DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=wd,
-                                adamw_mode=(name != "adam"
-                                            or params.get("adam_w_mode",
-                                                          True)))
+                                adamw_mode=adamw_mode)
     if name in ("adagrad", "cpuadagrad"):
         return DeepSpeedCPUAdagrad(lr=lr, eps=params.get("eps", 1e-10),
                                    weight_decay=wd)

@@ -110,6 +110,34 @@ def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
     return out.astype(q.dtype)
 
 
+def cached_attention(q, k_cache, v_cache, index):
+    """Decode-time attention against a static KV cache (reference:
+    csrc/transformer/inference softmax + attention over the
+    inference_context.h KV buffers).
+
+    q: [B, S_new, H, D] (the tokens being decoded); k/v_cache:
+    [B, S_max, H_kv, D] with positions [0, index + S_new) valid (the new
+    tokens' k/v already written at [index, index + S_new)). `index` is a
+    traced scalar — the mask keeps shapes static for XLA.
+    """
+    b, sq, hq, d = q.shape
+    _, smax, hkv, _ = k_cache.shape
+    if hq != hkv:
+        rep = hq // hkv
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    qpos = index + jnp.arange(sq)[:, None]        # absolute q positions
+    kpos = jnp.arange(smax)[None, :]
+    mask = (kpos <= qpos)[None, None]             # causal over the cache
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache)
+    return out.astype(q.dtype)
+
+
 def cross_entropy_loss(logits, targets, *, ignore_index: int = -100,
                        z_loss: float = 0.0):
     """Mean token cross-entropy in fp32 with optional z-loss.

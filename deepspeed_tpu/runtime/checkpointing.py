@@ -140,11 +140,14 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     else:
         engine.state = restored
 
-    if (getattr(engine, "_offload_opt", None) is not None
-            and not load_module_only):
+    if getattr(engine, "_offload_opt", None) is not None:
         host_file = os.path.join(
             path, f"host_opt_rank{jax.process_index()}.npz")
-        if os.path.exists(host_file):
+        if load_module_only or not load_optimizer_states:
+            # fresh optimizer: re-seed the host master from the restored
+            # params (else the first step would resurrect stale weights)
+            engine._offload_opt.reset_from_params(engine.state["params"])
+        elif os.path.exists(host_file):
             engine._offload_opt.load_state_dict(dict(np.load(host_file)))
             # host master is the fp32 source of truth; refresh device
             # params from it (after the state assignment above)

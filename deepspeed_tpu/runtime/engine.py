@@ -506,6 +506,8 @@ class DeepSpeedEngine:
             self.state["step"] = jax.device_put(
                 np.asarray(step_before + 1, np.int32),
                 self.state_shardings["step"])
+        else:
+            self.skipped_steps += 1
         return metrics
 
     # ------------------------------------------------------------------

@@ -47,6 +47,27 @@ def _sorted_shards(leaf):
     return sorted(leaf.addressable_shards, key=lambda s: s.device.id)
 
 
+def _parse_index_key(ik: str) -> tuple:
+    """Inverse of _index_key: 'a:b,c:d' -> (slice(a, b), slice(c, d))."""
+    out = []
+    if ik:
+        for part in ik.split(","):
+            if ":" in part:
+                a, b = part.split(":")
+                out.append(slice(int(a), int(b)))
+            else:
+                out.append(int(part))
+    return tuple(out)
+
+
+def _assemble(slices: dict[str, np.ndarray], shape) -> np.ndarray:
+    """Reassemble a full array from {idxkey: shard_data} pieces."""
+    full = np.zeros(shape, np.float32)
+    for ik, data in slices.items():
+        full[_parse_index_key(ik)] = data
+    return full
+
+
 def _index_key(index, shape) -> str:
     """Canonical string for a global-slice index (normalizes slice(None)
     against explicit bounds so keys from Shard.index and
@@ -213,23 +234,27 @@ class NVMeOffloadOptimizer:
         tree = jax.tree_util.tree_unflatten(treedef, new_leaves)
         return self._reshard_jit(tree)
 
+    def reset_from_params(self, params: PyTree) -> None:
+        """Re-seed the host master from device params with fresh optimizer
+        state (load_module_only / load_optimizer_states=False semantics)."""
+        self._shards = []
+        self._build_shards(jax.device_put(params, self._update_shardings))
+        self._step = 0
+        self._have_moments = False
+
     # ---------------------------------------------------------------
     # checkpoint interop (per-rank host state, like the reference's
-    # per-DP-rank *_optim_states.pt). Arrays are full-leaf-shaped with this
-    # process's shards filled in — rank files merge by overlay, and the
-    # universal converter can read rank0 directly on single-host setups.
+    # per-DP-rank *_optim_states.pt). Storage is per-shard — keyed by
+    # leaf name + the global slice the shard covers — so checkpointing
+    # never materializes full-shape fp32 arrays (the tier exists because
+    # those don't fit) and rank files merge without double counting.
+    #   shard::<field>::<name>::<idxkey>   e.g. shard::exp_avg::layers/wq::0:8,0:64
     def state_dict(self) -> dict[str, np.ndarray]:
         out: dict[str, np.ndarray] = {
             "__step__": np.asarray(self._step, dtype=np.int64)}
         for rec in self._shards:
-            f = out.setdefault(f"master::{rec.name}",
-                               np.zeros(rec.shape, np.float32))
-            f[rec.index] = rec.master
-            # ownership mask: which elements this process actually wrote
-            # (merging rank files must not sum replicated regions)
-            m = out.setdefault(f"__mask__::{rec.name}",
-                               np.zeros(rec.shape, bool))
-            m[rec.index] = True
+            ik = _index_key(rec.index, rec.shape)
+            out[f"shard::master::{rec.name}::{ik}"] = rec.master
             if self._have_moments:
                 bufs = self._opt.alloc_moments(rec.master)
                 for mname, buf in bufs.items():
@@ -237,25 +262,39 @@ class NVMeOffloadOptimizer:
                                           self._moment_path(rec.key, mname))
                 self._aio.synchronize()
                 for mname, buf in bufs.items():
-                    mf = out.setdefault(f"{mname}::{rec.name}",
-                                        np.zeros(rec.shape, np.float32))
-                    mf[rec.index] = buf
+                    out[f"shard::{mname}::{rec.name}::{ik}"] = buf
         return out
 
     def load_state_dict(self, sd: dict[str, np.ndarray]) -> None:
         self._step = int(sd.get("__step__", 0))
+        # index shard entries: (field, name) -> {idxkey: array}
+        table: dict[tuple[str, str], dict[str, np.ndarray]] = {}
+        for k, v in sd.items():
+            if not k.startswith("shard::"):
+                continue
+            _, field, name, ik = k.split("::", 3)
+            table.setdefault((field, name), {})[ik] = v
         wrote = False
         for rec in self._shards:
-            k = f"master::{rec.name}"
-            if k in sd:
-                np.copyto(rec.master,
-                          np.ascontiguousarray(sd[k][rec.index]))
+            ik = _index_key(rec.index, rec.shape)
+            m = table.get(("master", rec.name), {}).get(ik)
+            if m is not None:
+                np.copyto(rec.master, m)
+            else:
+                m_any = table.get(("master", rec.name))
+                if m_any:
+                    # layout changed (different mesh at load): reassemble
+                    # this shard from the saved slices
+                    full = _assemble(m_any, rec.shape)
+                    np.copyto(rec.master, full[rec.index])
             bufs = {}
             for mname in self._opt.moment_names():
-                mk = f"{mname}::{rec.name}"
-                if mk in sd:
+                entry = table.get((mname, rec.name), {})
+                if ik in entry:
+                    bufs[mname] = np.ascontiguousarray(entry[ik])
+                elif entry:
                     bufs[mname] = np.ascontiguousarray(
-                        np.asarray(sd[mk], np.float32)[rec.index])
+                        _assemble(entry, rec.shape)[rec.index])
             if bufs:
                 for mname, buf in bufs.items():
                     self._aio.async_pwrite(buf,

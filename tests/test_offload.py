@@ -116,9 +116,14 @@ def test_nvme_offload_universal_conversion(tmp_path, devices8):
     ds_to_universal(str(tmp_path / "ckpt"), str(tmp_path / "uni"))
 
     import os
+    from deepspeed_tpu.runtime.offload import _parse_index_key
     pdir = tmp_path / "uni" / "zero" / "embed" / "tokens"
     fp32 = np.load(pdir / "fp32.npy")
-    # master (not the bf16 params) was exported
-    host = e1._offload_opt.state_dict()["master::embed/tokens"]
+    # master (not the bf16 params) was exported: reassemble the host
+    # shards and compare
+    host = np.zeros(fp32.shape, np.float32)
+    for k, v in e1._offload_opt.state_dict().items():
+        if k.startswith("shard::master::embed/tokens::"):
+            host[_parse_index_key(k.split("::", 3)[3])] = v
     np.testing.assert_allclose(fp32, host, rtol=1e-6)
     assert os.path.exists(pdir / "exp_avg.npy")
