@@ -1,0 +1,4 @@
+"""FastGen-equivalent inference (reference: deepspeed/inference/v2/)."""
+
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig  # noqa: F401
+from .ragged import BlockedAllocator, DSStateManager  # noqa: F401
