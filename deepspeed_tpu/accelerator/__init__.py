@@ -1,0 +1,4 @@
+from .real_accelerator import get_accelerator, set_accelerator, is_current_accelerator_supported  # noqa: F401
+from .abstract_accelerator import DeepSpeedAccelerator  # noqa: F401
+from .tpu_accelerator import TPU_Accelerator  # noqa: F401
+from .cpu_accelerator import CPU_Accelerator  # noqa: F401

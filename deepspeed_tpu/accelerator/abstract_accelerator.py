@@ -1,0 +1,167 @@
+"""Accelerator abstraction (reference: accelerator/abstract_accelerator.py:10).
+
+The reference defines a ~75-method ABC because eager torch needs explicit
+streams, events, allocator stats, and per-vendor op builders. Under JAX the
+runtime already virtualises devices, and XLA owns scheduling — so the TPU
+ABC keeps the *queryable* surface (device identity/count, memory stats,
+RNG, dtype support, op-builder dispatch, synchronization) and drops the
+stream/event machinery that has no XLA analogue (graph execution replaces
+hand-scheduled streams; see SURVEY §7 "XLA semantics").
+
+Every subsystem that needs a device fact goes through ``get_accelerator()``
+just like the reference, which is what makes the test suite run unmodified
+on the CPU backend (reference parallel: tests are accelerator-portable by
+construction, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Queryable device facts + op dispatch for one platform."""
+
+    def __init__(self):
+        self._name: str = ""
+        self.communication_backend: str = ""
+
+    # --- device identity --------------------------------------------------
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None) -> Any:
+        """The jax.Device for local index ``device_index`` (default 0)."""
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        """Local (this-process) device count."""
+        ...
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int:
+        ...
+
+    def current_device(self) -> int:
+        return 0
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    def communication_backend_name(self) -> str:
+        return self.communication_backend
+
+    # --- execution --------------------------------------------------------
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Block until all queued work on the device is complete (the
+        reference's stream synchronize; here: drain the XLA async queue)."""
+        ...
+
+    # --- RNG (reference: ABC RNG APIs; JAX RNG is explicit keys) ----------
+    def manual_seed(self, seed: int):
+        import jax
+        return jax.random.PRNGKey(seed)
+
+    def initial_seed(self) -> int:
+        return 0
+
+    # --- memory -----------------------------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> dict:
+        ...
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("peak_bytes_in_use", 0))
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        stats = self.memory_stats(device_index)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    def empty_cache(self) -> None:
+        pass
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    # --- dtype support (reference: is_bf16_supported etc.) ----------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self) -> list:
+        import jax.numpy as jnp
+        out = [jnp.float32]
+        if self.is_fp16_supported():
+            out.append(jnp.float16)
+        if self.is_bf16_supported():
+            out.append(jnp.bfloat16)
+        return out
+
+    def preferred_dtype(self):
+        """bf16 on TPU (MXU-native), fp32 fallback — the analogue of the
+        reference test helper preferred_dtype() (tests/unit/common.py:503)."""
+        import jax.numpy as jnp
+        return jnp.bfloat16 if self.is_bf16_supported() else jnp.float32
+
+    # --- peak FLOPS (TPU addition: MFU accounting needs it) ---------------
+    @abc.abstractmethod
+    def peak_flops(self, dtype: Any = None, device_index: Optional[int] = None) -> float:
+        ...
+
+    # --- profiler ranges (reference: range_push/pop → nvtx) ---------------
+    def range_push(self, msg: str):
+        import jax
+        tc = jax.profiler.TraceAnnotation(msg)
+        tc.__enter__()
+        self._ranges = getattr(self, "_ranges", [])
+        self._ranges.append(tc)
+
+    def range_pop(self):
+        ranges = getattr(self, "_ranges", [])
+        if ranges:
+            ranges.pop().__exit__(None, None, None)
+
+    # --- op builder dispatch (reference: op_builder_dir selection) --------
+    def create_op_builder(self, class_name: str):
+        builder = self.get_op_builder(class_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, class_name: str):
+        from ..ops import op_builder
+        return getattr(op_builder, class_name, None)
+
+    # --- host pinned memory ------------------------------------------------
+    def pin_memory(self, array, align_bytes: int = 1):
+        """Place a host array into the pinned_host memory space so device
+        DMA doesn't bounce through pageable memory (reference: torch
+        .pin_memory(); here: jax memory_kind transfer)."""
+        import jax
+        try:
+            sharding = jax.sharding.SingleDeviceSharding(
+                self.device(), memory_kind="pinned_host")
+            return jax.device_put(array, sharding)
+        except Exception:
+            return array
+
+    def is_pinned(self, array) -> bool:
+        try:
+            return array.sharding.memory_kind == "pinned_host"
+        except AttributeError:
+            return False

@@ -1,0 +1,56 @@
+"""Accelerator auto-detection (reference: accelerator/real_accelerator.py:51).
+
+Resolution order mirrors the reference: explicit ``set_accelerator()`` >
+``DS_ACCELERATOR`` env var (:59) > probe for an attached TPU > CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+SUPPORTED_ACCELERATOR_LIST = ("tpu", "cpu")
+
+_accelerator: Optional[DeepSpeedAccelerator] = None
+
+
+def _make(name: str) -> DeepSpeedAccelerator:
+    if name == "tpu":
+        from .tpu_accelerator import TPU_Accelerator
+        return TPU_Accelerator()
+    if name == "cpu":
+        from .cpu_accelerator import CPU_Accelerator
+        return CPU_Accelerator()
+    raise ValueError(
+        f"DS_ACCELERATOR={name!r} not in {SUPPORTED_ACCELERATOR_LIST}")
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _accelerator
+    if _accelerator is not None:
+        return _accelerator
+
+    name = os.environ.get("DS_ACCELERATOR")
+    if name is not None:
+        _accelerator = _make(name)
+        return _accelerator
+
+    from .tpu_accelerator import TPU_Accelerator
+    tpu = TPU_Accelerator()
+    if tpu.is_available():
+        _accelerator = tpu
+    else:
+        from .cpu_accelerator import CPU_Accelerator
+        _accelerator = CPU_Accelerator()
+    return _accelerator
+
+
+def set_accelerator(accel: DeepSpeedAccelerator) -> None:
+    global _accelerator
+    _accelerator = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator()._name in SUPPORTED_ACCELERATOR_LIST

@@ -1,0 +1,12 @@
+from .elasticity import (  # noqa: F401
+    compute_elastic_config,
+    elasticity_enabled,
+    get_compatible_gpus_v01,
+    get_compatible_gpus_v02,
+    ensure_immutable_elastic_config,
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+)
+from .config import ElasticityConfig  # noqa: F401
+from .elastic_agent import ElasticTrainingAgent  # noqa: F401

@@ -1,0 +1,45 @@
+"""Elasticity config (reference: deepspeed/elasticity/config.py).
+
+JSON shape follows the reference's ``elasticity`` block:
+
+  "elasticity": {
+    "enabled": true,
+    "max_train_batch_size": 2000,
+    "micro_batch_sizes": [2, 4, 6],
+    "min_gpus": 1, "max_gpus": 10000,
+    "min_time": 20,
+    "prefer_larger_batch": true,
+    "ignore_non_elastic_batch_info": false,
+    "version": 0.2,
+    "model_parallel_size": 1,
+    "num_gpus_per_node": 4
+  }
+
+On TPU "gpus" reads as "chips"; the field names are kept verbatim so
+reference configs parse unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = Field(2000, alias="max_acceptable_batch_size")
+    micro_batch_sizes: List[int] = [2, 4, 6]
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = LATEST_ELASTICITY_VERSION
+    prefer_larger_batch: bool = Field(True, alias="prefer_larger_batch_size")
+    ignore_non_elastic_batch_info: bool = False
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1

@@ -1,0 +1,90 @@
+"""Elastic restart agent (reference: deepspeed/elasticity/elastic_agent.py:32).
+
+The reference extends torch-elastic's LocalElasticAgent: on worker-group
+membership change it restarts workers with a new WORLD_SIZE. The TPU
+equivalent is slice-granular: when hosts join or leave, the job restarts
+with a re-shaped ``jax.sharding.Mesh`` and resumes from a universal
+checkpoint (which re-shards to any DP/TP/PP degree — SURVEY §5
+checkpoint/resume). This agent packages that loop:
+
+  agent = ElasticTrainingAgent(ds_config, ckpt_dir, build_fn)
+  agent.run()   # build_fn(n_devices, micro_batch, gas) -> train loop
+
+``build_fn`` is invoked once per membership epoch; if it raises
+``WorldSizeChanged`` (or the device count observably changes between
+epochs) the agent recomputes the elastic batch plan and re-invokes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+_RESTART_COUNT_ENV = "DS_TPU_ELASTIC_RESTARTS"
+
+import jax
+
+from ..utils.logging import logger
+from .elasticity import (compute_elastic_config,
+                         ElasticityIncompatibleWorldSize)
+
+
+class WorldSizeChanged(Exception):
+    """Raised by training code when it detects a membership change
+    (the analogue of torch-elastic's worker-failure signal)."""
+
+
+class ElasticTrainingAgent:
+
+    def __init__(self, ds_config: dict,
+                 checkpoint_dir: Optional[str] = None,
+                 max_restarts: int = 100,
+                 restart_backoff_s: float = 5.0):
+        self.ds_config = ds_config
+        self.checkpoint_dir = checkpoint_dir
+        self.max_restarts = max_restarts
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_count = 0
+
+    def current_world_size(self) -> int:
+        return jax.device_count()
+
+    def plan_for(self, world_size: int):
+        """(final_batch, micro_batch, gas) for this membership epoch."""
+        final_batch, _, micro, gas = compute_elastic_config(
+            self.ds_config, world_size=world_size, return_microbatch=True)
+        return final_batch, micro, gas
+
+    def run(self, build_fn: Callable[[int, int, int], None]) -> None:
+        """Run ``build_fn(world_size, micro_batch, gas)`` once for this
+        process's membership epoch (reference: elastic_agent.py:127
+        _invoke_run). On ``WorldSizeChanged`` the process RE-EXECS itself:
+        jax's device topology is fixed once the backend initializes, so a
+        new membership epoch requires a fresh process — the same model as
+        torch-elastic restarting its worker group. Restart count rides an
+        env var across the exec. Training state must come back via
+        checkpoint (universal checkpoints reshard to the new world)."""
+        self.restart_count = int(os.environ.get(_RESTART_COUNT_ENV, "0"))
+        world = self.current_world_size()
+        try:
+            batch, micro, gas = self.plan_for(world)
+        except ElasticityIncompatibleWorldSize:
+            raise RuntimeError(
+                f"device count {world} is outside the elastic "
+                "schedule; restart the job on a valid slice shape")
+        logger.info(
+            f"elastic epoch: world={world} batch={batch} "
+            f"micro={micro} gas={gas} (restart {self.restart_count})")
+        try:
+            build_fn(world, micro, gas)
+        except WorldSizeChanged:
+            if self.restart_count + 1 > self.max_restarts:
+                raise RuntimeError(
+                    f"exceeded max_restarts={self.max_restarts}")
+            logger.warning(
+                "membership change: re-exec for a fresh device topology")
+            time.sleep(self.restart_backoff_s)
+            os.environ[_RESTART_COUNT_ENV] = str(self.restart_count + 1)
+            os.execv(sys.executable, [sys.executable] + sys.argv)

@@ -1,0 +1,192 @@
+"""Elastic batch-size math (reference: deepspeed/elasticity/elasticity.py).
+
+Given a set of candidate micro-batch sizes and an acceptable total-batch
+ceiling, enumerate the (total_batch, micro_batch, GAS) combinations that
+stay valid across a whole range of chip counts — so training can restart
+on a different slice shape without changing the effective batch size.
+
+v0.1 (reference :83): chip counts compatible with one chosen batch size.
+v0.2 (reference :126): adds model-parallel size and chips-per-node
+divisibility constraints (a TPU pod slice analogue: world size must be a
+multiple of chips-per-host when hosts come and go whole).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from .config import ElasticityConfig, LATEST_ELASTICITY_VERSION
+
+
+class ElasticityError(Exception):
+    """Base error for elasticity (reference: constants + exceptions)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def _lcm(a: int, b: int) -> int:
+    from math import gcd
+    return a * b // gcd(a, b)
+
+
+def get_candidate_batch_sizes(base_list: Iterable[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """All LCM-combinations of the micro-batch candidates, capped at the
+    ceiling (reference: elasticity.py:40 get_candidate_batch_sizes)."""
+    base_list = sorted(set(base_list))
+    # Closure of the list under LCM, capped at the ceiling. Equivalent to
+    # enumerating all subset-LCMs but O(n * distinct_lcms) instead of 2^n.
+    lcms: set[int] = set()
+    for b in base_list:
+        if b > max_acceptable_batch_size:
+            continue
+        new = {b}
+        for x in lcms:
+            v = _lcm(x, b)
+            if v <= max_acceptable_batch_size:
+                new.add(v)
+        lcms |= new
+    return sorted(lcms)
+
+
+def get_compatible_gpus_v01(micro_batches: Iterable[int],
+                            max_acceptable_batch_size: int,
+                            min_gpus: int = 1,
+                            max_gpus: int = 10000,
+                            prefer_larger: bool = True
+                            ) -> Tuple[int, List[int], dict]:
+    """reference: elasticity.py:83 — pick final_batch_size and the chip
+    counts it can run on. Returns (final_batch, valid_gpus,
+    {gpus: (micro_batch, gas)})."""
+    micro_batches = list(micro_batches)
+    candidates = get_candidate_batch_sizes(micro_batches,
+                                           max_acceptable_batch_size)
+    if not candidates:
+        raise ElasticityConfigError(
+            f"No valid batch size <= {max_acceptable_batch_size} from "
+            f"micro batches {list(micro_batches)}")
+
+    best = None  # (num_valid, batch, valid_gpus, plan)
+    for batch in candidates:
+        valid_gpus = []
+        plan = {}
+        for n in range(min_gpus, min(max_gpus, batch) + 1):
+            if batch % n != 0:
+                continue
+            per_gpu = batch // n
+            # pick the largest micro batch that divides the per-chip share
+            mbs = [m for m in micro_batches if per_gpu % m == 0]
+            if not mbs:
+                continue
+            micro = max(mbs)
+            valid_gpus.append(n)
+            plan[n] = (micro, per_gpu // micro)
+        if not valid_gpus:
+            continue
+        key = (len(valid_gpus), batch if prefer_larger else -batch)
+        if best is None or key > best[0]:
+            best = (key, batch, valid_gpus, plan)
+    if best is None:
+        raise ElasticityConfigError(
+            "No batch size is runnable on any chip count in "
+            f"[{min_gpus}, {max_gpus}]")
+    _, batch, valid_gpus, plan = best
+    return batch, valid_gpus, plan
+
+
+def get_compatible_gpus_v02(micro_batches: Iterable[int],
+                            max_acceptable_batch_size: int,
+                            min_gpus: int = 1,
+                            max_gpus: int = 10000,
+                            prefer_larger: bool = True,
+                            num_gpus_per_node: int = 1,
+                            model_parallel_size: int = 1
+                            ) -> Tuple[int, List[int], dict]:
+    """reference: elasticity.py:126 — v0.2 adds model-parallelism and
+    whole-node granularity: the DP degree is world/(mp), and world must be
+    a multiple of chips-per-node (hosts join/leave whole)."""
+    if model_parallel_size > 1:
+        if num_gpus_per_node % model_parallel_size != 0 and \
+                model_parallel_size % num_gpus_per_node != 0:
+            raise ElasticityConfigError(
+                f"model_parallel_size {model_parallel_size} incompatible "
+                f"with num_gpus_per_node {num_gpus_per_node}")
+    dp_min = max(1, min_gpus // model_parallel_size)
+    dp_max = max(1, max_gpus // model_parallel_size)
+    batch, valid_dp, plan = get_compatible_gpus_v01(
+        micro_batches, max_acceptable_batch_size,
+        min_gpus=dp_min, max_gpus=dp_max, prefer_larger=prefer_larger)
+
+    valid_gpus, out_plan = [], {}
+    for dp in valid_dp:
+        world = dp * model_parallel_size
+        if world % num_gpus_per_node != 0:
+            continue
+        valid_gpus.append(world)
+        out_plan[world] = plan[dp]
+    if not valid_gpus:
+        raise ElasticityConfigError(
+            "No world size satisfies whole-node + model-parallel "
+            "divisibility")
+    return batch, valid_gpus, out_plan
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict,
+                                    stored_elastic_config_dict: dict) -> None:
+    """reference: elasticity.py:196 — a resumed job must not silently
+    change the elastic schedule (that would break batch-size continuity)."""
+    for key in ("max_train_batch_size", "micro_batch_sizes", "version"):
+        a = runtime_elastic_config_dict.get(key)
+        b = stored_elastic_config_dict.get(key)
+        if a != b:
+            raise ElasticityConfigError(
+                f"Elastic config field {key!r} changed across restart: "
+                f"{b!r} -> {a!r}. Elastic schedules are immutable.")
+
+
+def compute_elastic_config(ds_config: dict,
+                           target_deepspeed_version: str = "",
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """reference: elasticity.py:233. Returns (final_batch_size,
+    valid_gpus[, micro_batch, gas when world_size>0])."""
+    cfg = ElasticityConfig(**ds_config.get("elasticity", {}))
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity is not enabled in config")
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"Unsupported elasticity version {cfg.version}")
+
+    if cfg.version >= 0.2:
+        final_batch, valid_gpus, plan = get_compatible_gpus_v02(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size,
+            min_gpus=cfg.min_gpus, max_gpus=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch,
+            num_gpus_per_node=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        final_batch, valid_gpus, plan = get_compatible_gpus_v01(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size,
+            min_gpus=cfg.min_gpus, max_gpus=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch)
+
+    if world_size > 0:
+        if world_size not in plan:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid set {valid_gpus} "
+                f"for elastic batch {final_batch}")
+        micro, gas = plan[world_size]
+        if return_microbatch:
+            return final_batch, valid_gpus, micro, gas
+        return final_batch, valid_gpus, micro
+    return final_batch, valid_gpus

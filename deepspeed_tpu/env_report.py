@@ -1,0 +1,63 @@
+"""Environment report CLI (reference: deepspeed/env_report.py — ds_report).
+
+Prints framework/runtime versions, attached devices, and native-op
+compatibility, so bug reports carry the facts."""
+
+from __future__ import annotations
+
+import shutil
+import sys
+
+
+def get_report_lines() -> list[str]:
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    lines = [
+        "-" * 60,
+        "DeepSpeed-TPU environment report",
+        "-" * 60,
+        f"deepspeed_tpu version ....... {deepspeed_tpu.__version__}",
+        f"jax version ................. {jax.__version__}",
+        f"python ...................... {sys.version.split()[0]}",
+    ]
+    accel = get_accelerator()
+    lines.append(f"accelerator ................. {accel._name}")
+    lines.append(f"local devices ............... {accel.device_count()}")
+    lines.append(f"global devices .............. {accel.global_device_count()}")
+    try:
+        kinds = sorted({d.device_kind for d in jax.local_devices()})
+        lines.append(f"device kind(s) .............. {', '.join(kinds)}")
+    except Exception:
+        pass
+    lines.append("-" * 60)
+    lines.append("native op toolchain:")
+    for tool in ("g++", "cmake", "ninja", "make"):
+        ok = "yes" if shutil.which(tool) else "NO"
+        lines.append(f"  {tool:<10} ................ {ok}")
+    try:
+        from deepspeed_tpu.ops import op_builder
+        builders = [getattr(op_builder, n) for n in dir(op_builder)
+                    if n.endswith("Builder") and n != "OpBuilder"]
+        lines.append("op builders:")
+        for b in builders:
+            try:
+                compatible = b().is_compatible()
+            except Exception:
+                compatible = False
+            lines.append(f"  {b.NAME or b.__name__:<22} compatible: "
+                         f"{'yes' if compatible else 'no'}")
+    except Exception as e:
+        lines.append(f"op builder probe failed: {e}")
+    lines.append("-" * 60)
+    return lines
+
+
+def cli_main() -> int:
+    print("\n".join(get_report_lines()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(cli_main())

@@ -1,0 +1,1 @@
+from .runner import main as runner_main, parse_args, fetch_hostfile, parse_resource_filter  # noqa: F401

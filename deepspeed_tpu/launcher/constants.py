@@ -1,0 +1,20 @@
+"""Launcher constants (reference: deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+PDSH_MAX_FAN_OUT = 1024
+
+OPENMPI_LAUNCHER = "openmpi"
+MPICH_LAUNCHER = "mpich"
+IMPI_LAUNCHER = "impi"
+SLURM_LAUNCHER = "slurm"
+MVAPICH_LAUNCHER = "mvapich"
+SSH_LAUNCHER = "ssh"
+
+ELASTIC_TRAINING_ID_DEFAULT = "123456789"
+
+# Rendezvous env the node-local launcher exports (the analogue of the
+# reference's MASTER_ADDR/MASTER_PORT + RANK/WORLD_SIZE; JAX multi-host
+# uses a coordinator address + process ids).
+COORDINATOR_ADDR_ENV = "DS_TPU_COORDINATOR"
+PROCESS_ID_ENV = "DS_TPU_PROCESS_ID"
+NUM_PROCESSES_ENV = "DS_TPU_NUM_PROCESSES"

@@ -1,0 +1,130 @@
+"""Node-local launcher (reference: deepspeed/launcher/launch.py:133).
+
+The reference forks one process per local GPU and sets
+RANK/LOCAL_RANK/WORLD_SIZE. On TPU one process per HOST owns all local
+chips, so this module's job is to resolve the host's process id
+(explicit --node_rank, MPI/SLURM env, or hostname lookup in --hosts),
+call ``jax.distributed.initialize`` against the coordinator, then run the
+user script in-process. Signal handling mirrors the reference: SIGTERM
+fans out to the child's process group (terminate_process_tree, :119).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import signal
+import socket
+import sys
+
+from ..utils.logging import logger
+from . import constants
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.launch")
+    parser.add_argument("--node_rank", type=int, default=-1)
+    parser.add_argument("--nnodes", type=int, default=-1)
+    parser.add_argument("--hosts", type=str, default="",
+                        help="colon-separated ordered host list (pdsh path)")
+    parser.add_argument("--slots", type=str, default="",
+                        help="per-rank chip index lists, colon-separated "
+                             "(e.g. '0,2:0,1,2,3'); sets TPU_VISIBLE_CHIPS")
+    parser.add_argument("--master_addr", type=str, default="localhost")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--from_mpi", action="store_true")
+    parser.add_argument("--from_slurm", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def resolve_identity(args) -> tuple[int, int]:
+    """(process_id, num_processes) for jax.distributed.initialize."""
+    if args.from_mpi:
+        rank = int(os.environ.get("OMPI_COMM_WORLD_RANK",
+                                  os.environ.get("PMI_RANK", "0")))
+        size = int(os.environ.get("OMPI_COMM_WORLD_SIZE",
+                                  os.environ.get("PMI_SIZE", "1")))
+        return rank, size
+    if args.from_slurm:
+        return (int(os.environ.get("SLURM_PROCID", "0")),
+                int(os.environ.get("SLURM_NTASKS", "1")))
+    if args.node_rank >= 0 and args.nnodes > 0:
+        return args.node_rank, args.nnodes
+    if args.hosts:
+        hosts = args.hosts.split(":")
+        me = socket.gethostname()
+        # Identities this host answers to: hostname, FQDN, and local IPs
+        # (hostfiles may list either names or addresses).
+        identities = {me, socket.getfqdn()}
+        try:
+            identities.update(
+                info[4][0] for info in socket.getaddrinfo(me, None))
+        except socket.gaierror:
+            pass
+        matches = [i for i, h in enumerate(hosts) if h in identities]
+        if len(matches) != 1:
+            raise RuntimeError(
+                f"host identities {sorted(identities)} matched "
+                f"{len(matches)} entries in host list {hosts}; "
+                "need exactly one")
+        return matches[0], len(hosts)
+    # env fallback (set by runner._local_run)
+    return (int(os.environ.get(constants.PROCESS_ID_ENV, "0")),
+            int(os.environ.get(constants.NUM_PROCESSES_ENV, "1")))
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    process_id, num_processes = resolve_identity(args)
+    coordinator = f"{args.master_addr}:{args.master_port}"
+
+    os.environ[constants.COORDINATOR_ADDR_ENV] = coordinator
+    os.environ[constants.PROCESS_ID_ENV] = str(process_id)
+    os.environ[constants.NUM_PROCESSES_ENV] = str(num_processes)
+
+    if args.slots:
+        # restrict this host to its chip-index list (must happen before
+        # jax/libtpu initializes)
+        slot_lists = args.slots.split(":")
+        if process_id < len(slot_lists) and slot_lists[process_id]:
+            os.environ["TPU_VISIBLE_CHIPS"] = slot_lists[process_id]
+
+    if num_processes > 1:
+        import jax
+        logger.info(
+            f"jax.distributed.initialize(coordinator={coordinator}, "
+            f"process_id={process_id}/{num_processes})")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id)
+
+    # Become a process-group leader so SIGTERM can fan out to children the
+    # user script may spawn without touching the remote login shell
+    # (reference: launch.py terminate_process_tree :119).
+    try:
+        os.setpgrp()
+    except OSError:
+        pass  # already a session/group leader
+
+    def _terminate(signum, frame):
+        logger.warning(f"signal {signum}: terminating")
+        if os.getpgrp() == os.getpid():
+            # forward to children only; ignore our own copy so the
+            # sys.exit below (and atexit cleanup) still runs
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            os.killpg(os.getpgrp(), signal.SIGTERM)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _terminate)
+
+    sys.argv = [args.user_script] + list(args.user_args)
+    runpy.run_path(args.user_script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""Multinode runners (reference: deepspeed/launcher/multinode_runner.py).
+
+Each runner knows how to start ONE process per host (TPU model: a host
+owns its chips; contrast the reference's one-proc-per-GPU) with the
+coordinator/process-id env exported. The per-host process is
+``launch.py``, which sets JAX multi-host env and execs the user script.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import sys
+from abc import ABC, abstractmethod
+
+from . import constants
+
+
+class MultiNodeRunner(ABC):
+    def __init__(self, args, resource_pool):
+        self.args = args
+        self.resource_pool = resource_pool
+        self.user_script = args.user_script
+        self.user_arguments = list(args.user_args)
+
+    @abstractmethod
+    def backend_exists(self) -> bool:
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment: dict, active_resources) -> list:
+        ...
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def _env_exports(self, environment: dict) -> list[str]:
+        return [f"{k}={shlex.quote(v)}" for k, v in environment.items()]
+
+    @staticmethod
+    def _slots_arg(active_resources) -> str:
+        """--slots=0,2:0,1,2,3 — per-rank chip index lists, aligned with
+        host order; launch.py maps its rank to TPU_VISIBLE_CHIPS."""
+        return ":".join(",".join(map(str, slots))
+                        for slots in active_resources.values())
+
+    def _launch_cmd(self, identity_flags: list[str],
+                    active_resources) -> list[str]:
+        """The shared 'python -m deepspeed_tpu.launcher.launch ...' tail;
+        ``identity_flags`` tells launch.py how to resolve its rank
+        (--node_rank/--hosts/--from_mpi/--from_slurm)."""
+        return [
+            sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+            *identity_flags,
+            f"--slots={self._slots_arg(active_resources)}",
+            f"--master_addr={self.args.master_addr}",
+            f"--master_port={self.args.master_port}",
+            self.user_script,
+        ] + self.user_arguments
+
+
+class PDSHRunner(MultiNodeRunner):
+    """reference: multinode_runner.py PDSHRunner — fan-out over pdsh."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment = dict(environment)
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active_resources.keys())
+        exports = " ".join(
+            f"export {e};" for e in self._env_exports(environment))
+        # node_rank comes from pdsh's %n substitution of the host index is
+        # not available; launch.py falls back to matching its hostname
+        # against the encoded host order.
+        host_list = ":".join(active_resources.keys())
+        cmd = ["pdsh", "-S", "-f", str(constants.PDSH_MAX_FAN_OUT),
+               "-w", hosts,
+               exports + " " + " ".join(map(shlex.quote, self._launch_cmd(
+                   [f"--hosts={host_list}"], active_resources)))]
+        return cmd
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain ssh loop — works anywhere sshd does (no pdsh dependency).
+    TPU-pod default: GCP hosts all allow ssh from the controller."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ssh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        # One ssh per host, backgrounded by a wrapping shell; the returned
+        # command is a bash -c that waits on all of them.
+        hosts = list(active_resources.keys())
+        exports = " ".join(
+            f"export {e};" for e in self._env_exports(environment))
+        parts = []
+        for rank, host in enumerate(hosts):
+            remote = exports + " " + " ".join(
+                map(shlex.quote, self._launch_cmd(
+                    [f"--node_rank={rank}", f"--nnodes={len(hosts)}"],
+                    active_resources)))
+            parts.append(
+                f"ssh -o StrictHostKeyChecking=no {shlex.quote(host)} "
+                f"{shlex.quote(remote)} & pids+=($!);")
+        # bare `wait` discards background exit codes; wait each pid and
+        # propagate the worst so a dead host fails the launch
+        script = ("pids=(); " + " ".join(parts)
+                  + " rc=0; for p in \"${pids[@]}\"; do"
+                  + " wait \"$p\" || rc=$?; done; exit $rc")
+        return ["bash", "-c", script]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """reference: OpenMPIRunner — mpirun does rendezvous + fan-out;
+    launch.py reads OMPI_COMM_WORLD_RANK for its process id."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_hosts = len(active_resources)
+        hosts = ",".join(f"{h}:1" for h in active_resources)
+        cmd = ["mpirun", "-n", str(total_hosts), "--host", hosts,
+               "--mca", "btl", "^openib"]
+        for k, v in environment.items():
+            cmd += ["-x", f"{k}={v}"]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        cmd += self._launch_cmd(["--from_mpi"], active_resources)
+        return cmd
+
+
+class MPICHRunner(OpenMPIRunner):
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None or \
+            shutil.which("mpiexec") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_hosts = len(active_resources)
+        hosts = ",".join(active_resources.keys())
+        launcher = shutil.which("mpiexec") or "mpirun"
+        cmd = [os.path.basename(launcher), "-n", str(total_hosts),
+               "-hosts", hosts]
+        for k, v in environment.items():
+            cmd += ["-genv", k, v]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        cmd += self._launch_cmd(["--from_mpi"], active_resources)
+        return cmd
+
+
+class IMPIRunner(MPICHRunner):
+    pass
+
+
+class MVAPICHRunner(OpenMPIRunner):
+    pass
+
+
+class SlurmRunner(MultiNodeRunner):
+    """reference: SlurmRunner — srun provides SLURM_PROCID/SLURM_NNODES."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        total_hosts = len(active_resources)
+        # --nodelist pins srun to exactly the filtered hosts, in order —
+        # SLURM_PROCID follows nodelist order under block distribution, so
+        # the positional --slots mapping stays aligned
+        nodelist = ",".join(active_resources.keys())
+        cmd = ["srun", "--nodes", str(total_hosts),
+               "--ntasks", str(total_hosts), "--ntasks-per-node", "1",
+               "--nodelist", nodelist, "--distribution", "block"]
+        # runner.main() already merges `environment` into srun's own env;
+        # --export=ALL propagates it. Listing K=V pairs here would corrupt
+        # comma-containing values (srun splits --export on commas).
+        cmd += ["--export=ALL"]
+        if self.args.launcher_args:
+            cmd += shlex.split(self.args.launcher_args)
+        cmd += self._launch_cmd(["--from_slurm"], active_resources)
+        return cmd

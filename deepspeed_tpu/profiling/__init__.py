@@ -1,0 +1,1 @@
+from .flops_profiler import FlopsProfiler, get_model_profile  # noqa: F401

@@ -202,16 +202,9 @@ class CheckpointConfig(DeepSpeedConfigModel):
     async_save: bool = False
 
 
-class ElasticityConfig(DeepSpeedConfigModel):
-    enabled: bool = False
-    max_train_batch_size: int = 2000
-    micro_batch_sizes: list[int] = Field(default_factory=lambda: [2, 4, 6])
-    min_gpus: int = 1
-    max_gpus: int = 10000
-    min_time: int = 0
-    version: float = 0.2
-    ignore_non_elastic_batch_info: bool = False
-    prefer_larger_batch: bool = True
+# Single source of truth for the elasticity block lives with the
+# subsystem; re-exported here so DeepSpeedConfig.elasticity parses it.
+from ..elasticity.config import ElasticityConfig  # noqa: E402
 
 
 class DeepSpeedConfig(DeepSpeedConfigModel):
