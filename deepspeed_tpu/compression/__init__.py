@@ -1,0 +1,14 @@
+"""Compression: quantization-aware training, pruning, layer reduction
+(reference: deepspeed/compression/)."""
+
+from .compress import (Compressor, init_compression, redundancy_clean,
+                       student_initialization)
+from .config import CompressionConfig, get_compression_config
+from .scheduler import CompressionScheduler
+from . import functional
+
+__all__ = [
+    "Compressor", "init_compression", "redundancy_clean",
+    "student_initialization", "CompressionConfig", "get_compression_config",
+    "CompressionScheduler", "functional",
+]

@@ -1,0 +1,110 @@
+"""Compression config parsing (reference: deepspeed/compression/config.py +
+constants.py). Accepts the reference's ``compression_training`` JSON schema
+unchanged — shared_parameters / different_groups per technique — and
+normalizes it into dataclasses the Compressor consumes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+ACTIVATION_QUANTIZATION = "activation_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+LAYER_REDUCTION = "layer_reduction"
+
+TECHNIQUES = (WEIGHT_QUANTIZATION, ACTIVATION_QUANTIZATION, SPARSE_PRUNING,
+              ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING)
+
+
+@dataclass
+class CompressionGroup:
+    """One ``different_groups`` entry: a set of module-path regexes plus
+    technique parameters (start_bits/dense_ratio/...)."""
+    name: str
+    modules: list[str] = field(default_factory=lambda: ["*"])
+    related_modules: list[list[str]] | None = None
+    params: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TechniqueConfig:
+    name: str
+    enabled: bool = False
+    shared: dict[str, Any] = field(default_factory=dict)
+    groups: list[CompressionGroup] = field(default_factory=list)
+
+    @property
+    def schedule_offset(self) -> int:
+        return int(self.shared.get("schedule_offset", 0))
+
+    @property
+    def schedule_offset_end(self) -> int:
+        return int(self.shared.get("schedule_offset_end",
+                                   self.schedule_offset))
+
+
+@dataclass
+class LayerReductionConfig:
+    enabled: bool = False
+    keep_number_layer: int | None = None
+    module_name_prefix: str = ""
+    teacher_layer: list[int] = field(default_factory=list)
+    other_module_name: list[str] = field(default_factory=list)
+
+
+@dataclass
+class CompressionConfig:
+    techniques: dict[str, TechniqueConfig] = field(default_factory=dict)
+    layer_reduction: LayerReductionConfig = field(
+        default_factory=LayerReductionConfig)
+
+    @property
+    def any_enabled(self) -> bool:
+        return (any(t.enabled for t in self.techniques.values())
+                or self.layer_reduction.enabled)
+
+    def technique(self, name: str) -> TechniqueConfig:
+        return self.techniques.get(name, TechniqueConfig(name))
+
+
+def _parse_groups(section: dict) -> list[CompressionGroup]:
+    out = []
+    for gname, g in (section.get("different_groups") or {}).items():
+        out.append(CompressionGroup(
+            name=gname,
+            modules=list(g.get("modules", ["*"])),
+            related_modules=g.get("related_modules"),
+            params=dict(g.get("params", {}))))
+    return out
+
+
+def get_compression_config(ds_config: dict) -> CompressionConfig:
+    """Parse the ``compression_training`` section of a deepspeed config dict
+    (reference config.py get_compression_config)."""
+    ds_config = ds_config or {}
+    section = ds_config.get("compression_training")
+    if section is None:
+        # accept the bare compression_training section itself
+        known = set(TECHNIQUES) | {LAYER_REDUCTION}
+        section = ds_config if known & set(ds_config) else {}
+    cfg = CompressionConfig()
+    for name in TECHNIQUES:
+        sub = section.get(name) or {}
+        shared = dict(sub.get("shared_parameters") or {})
+        cfg.techniques[name] = TechniqueConfig(
+            name=name,
+            enabled=bool(shared.get("enabled", False)),
+            shared=shared,
+            groups=_parse_groups(sub))
+    lr = section.get(LAYER_REDUCTION) or {}
+    cfg.layer_reduction = LayerReductionConfig(
+        enabled=bool(lr.get("enabled", False)),
+        keep_number_layer=lr.get("keep_number_layer"),
+        module_name_prefix=lr.get("module_name_prefix", ""),
+        teacher_layer=list(lr.get("teacher_layer", [])),
+        other_module_name=list(lr.get("other_module_name", [])))
+    return cfg

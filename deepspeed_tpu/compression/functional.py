@@ -1,0 +1,177 @@
+"""Pure, jittable compression primitives (reference: deepspeed/compression/
+basic_layer.py + utils.py).
+
+The reference implements quantization-aware training and pruning as stateful
+``nn.Module`` substitutes (``LinearLayer_Compress``) that mutate themselves as
+the scheduler enables techniques. Under XLA everything is a pure function of
+``(weight, step)``: schedule gates are traced ``jnp.where`` selects, rounding
+uses a straight-through estimator, and masks are recomputed from the live
+weights inside the compiled step (free on TPU — the mask math fuses into the
+surrounding elementwise HLO).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(w: jax.Array, dq: jax.Array) -> jax.Array:
+    """Straight-through estimator: forward ``dq``, gradient of identity."""
+    return w + jax.lax.stop_gradient(dq - w)
+
+
+def _grouped(w: jax.Array, groups: int) -> jax.Array:
+    n = w.size
+    groups = max(1, min(groups, n))
+    while n % groups:  # reference requires divisibility; we degrade gracefully
+        groups -= 1
+    return w.reshape(groups, n // groups)
+
+
+def quantize_symmetric(w: jax.Array, bits, groups: int = 1) -> jax.Array:
+    """Symmetric per-group fake quantization (reference basic_layer.py
+    Quantizer 'symmetric'). ``bits`` may be a traced scalar (the progressive
+    start_bits->target_bits schedule runs inside the graph)."""
+    flat = _grouped(w, groups)
+    qmax = 2.0 ** (jnp.asarray(bits, jnp.float32) - 1) - 1
+    scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(flat / scale), -qmax - 1, qmax)
+    return (q * scale).reshape(w.shape).astype(w.dtype)
+
+
+def quantize_asymmetric(w: jax.Array, bits, groups: int = 1) -> jax.Array:
+    """Asymmetric (min/max affine) per-group fake quantization."""
+    flat = _grouped(w, groups)
+    levels = 2.0 ** jnp.asarray(bits, jnp.float32) - 1
+    mn = jnp.min(flat, axis=1, keepdims=True)
+    mx = jnp.max(flat, axis=1, keepdims=True)
+    scale = (mx - mn) / levels
+    scale = jnp.where(scale == 0, 1.0, scale)
+    zp = jnp.round(-mn / scale)
+    q = jnp.clip(jnp.round(flat / scale) + zp, 0, levels)
+    return ((q - zp) * scale).reshape(w.shape).astype(w.dtype)
+
+
+def fake_quantize(w: jax.Array, bits, *, symmetric: bool = True,
+                  groups: int = 1, ratio=1.0) -> jax.Array:
+    """QAT weight transform with STE; ``ratio`` blends toward the fp value
+    (reference fp16_mixed_quantize, WEIGHT_QUANTIZE_CHANGE_RATIO)."""
+    dq = (quantize_symmetric(w, bits, groups) if symmetric
+          else quantize_asymmetric(w, bits, groups))
+    ratio = jnp.asarray(ratio, w.dtype)
+    return _ste(w, dq * ratio + w * (1 - ratio))
+
+
+def progressive_bits(step, *, start_bits: float, target_bits: float,
+                     offset: int, period: int):
+    """Bits anneal from start to target, one bit per ``period`` steps after
+    ``offset`` (reference quantize_period / start_bits / target_bits)."""
+    step = jnp.asarray(step, jnp.float32)
+    dec = jnp.floor(jnp.maximum(step - offset, 0.0) / max(period, 1))
+    return jnp.clip(start_bits - dec, target_bits, start_bits)
+
+
+def quantize_activation(x: jax.Array, bits: int = 8, *,
+                        symmetric: bool = True,
+                        static_range: tuple[float, float] | None = None
+                        ) -> jax.Array:
+    """Activation fake-quant (reference QuantAct): dynamic range from the
+    live tensor, or a static calibrated range."""
+    if static_range is not None:
+        lo, hi = static_range
+        if symmetric:
+            qmax = 2.0 ** (bits - 1) - 1
+            scale = max(abs(lo), abs(hi)) / qmax
+            q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+            return _ste(x, (q * scale).astype(x.dtype))
+        scale = (hi - lo) / (2.0 ** bits - 1)
+        q = jnp.clip(jnp.round((x - lo) / scale), 0, 2.0 ** bits - 1)
+        return _ste(x, (q * scale + lo).astype(x.dtype))
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.max(jnp.abs(x)) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+        return _ste(x, (q * scale).astype(x.dtype))
+    return quantize_activation(x, bits, symmetric=True)  # dynamic asym ~ sym
+
+
+def _block_scores(w: jax.Array, pattern: str) -> tuple[jax.Array, tuple]:
+    """L1 score per block for block-sparse patterns like '4x1' (reference
+    SPARSE_PRUNING_BLOCK_PATTERN). Returns (scores, block_shape) or falls
+    back to elementwise when dims don't divide."""
+    try:
+        br, bc = (int(t) for t in pattern.split("x"))
+    except ValueError:
+        return jnp.abs(w), (1, 1)
+    if w.ndim < 2 or w.shape[-2] % br or w.shape[-1] % bc:
+        return jnp.abs(w), (1, 1)
+    lead = w.shape[:-2]
+    blocked = jnp.abs(w).reshape(*lead, w.shape[-2] // br, br,
+                                 w.shape[-1] // bc, bc)
+    return blocked.sum(axis=(-3, -1)), (br, bc)
+
+
+def sparse_mask(w: jax.Array, dense_ratio, *, pattern: str = "1x1"
+                ) -> jax.Array:
+    """Unstructured / block-structured magnitude mask keeping the top
+    ``dense_ratio`` fraction (reference l1/topk/snip_momentum methods —
+    all magnitude-based at mask time). ``dense_ratio`` may be traced (the
+    snip_momentum progressive schedule)."""
+    scores, (br, bc) = _block_scores(w, pattern)
+    q = jnp.clip(1.0 - jnp.asarray(dense_ratio, jnp.float32), 0.0, 1.0)
+    thr = jnp.quantile(scores.astype(jnp.float32), q)
+    mask = (scores >= thr).astype(w.dtype)
+    if (br, bc) != (1, 1):
+        mask = jnp.repeat(jnp.repeat(mask, br, axis=-2), bc, axis=-1)
+    return mask
+
+
+def progressive_ratio(step, *, target_ratio: float, offset: int,
+                      offset_end: int, stride: int = 1):
+    """Dense ratio anneals 1 -> target over [offset, offset_end] in steps of
+    ``stride`` (reference snip_momentum schedule_offset_stride)."""
+    step = jnp.asarray(step, jnp.float32)
+    if offset_end <= offset:
+        return jnp.asarray(target_ratio, jnp.float32)
+    frac = jnp.clip((step - offset) / (offset_end - offset), 0.0, 1.0)
+    if stride > 1:
+        total = max((offset_end - offset) // stride, 1)
+        frac = jnp.floor(frac * total) / total
+    return 1.0 - frac * (1.0 - target_ratio)
+
+
+def row_mask(w: jax.Array, dense_ratio) -> jax.Array:
+    """Structured mask over the *output* dim (last axis; our weights are
+    ``x @ w`` so reference 'rows' are our columns). Scores are L1 over all
+    other axes; broadcastable mask of shape [..., 1, out]."""
+    axes = tuple(range(w.ndim - 1))
+    scores = jnp.sum(jnp.abs(w), axis=axes)
+    q = jnp.clip(1.0 - jnp.asarray(dense_ratio, jnp.float32), 0.0, 1.0)
+    thr = jnp.quantile(scores.astype(jnp.float32), q)
+    return (scores >= thr).astype(w.dtype)  # [out]
+
+
+def head_mask(w: jax.Array, num_heads: int, dense_ratio) -> jax.Array:
+    """Mask attention heads by the L1 norm of the output-projection slice
+    each head feeds (reference head pruning on attention output matrix).
+    ``w`` is wo with input dim = heads*head_dim at axis -2; returns a
+    per-head keep mask [heads]."""
+    hd = w.shape[-2] // num_heads
+    lead = w.shape[:-2]
+    per_head = jnp.abs(w).reshape(*lead, num_heads, hd, w.shape[-1])
+    reduce_axes = tuple(range(len(lead))) + (len(lead) + 1, len(lead) + 2)
+    scores = per_head.sum(axis=reduce_axes)
+    q = jnp.clip(1.0 - jnp.asarray(dense_ratio, jnp.float32), 0.0, 1.0)
+    thr = jnp.quantile(scores.astype(jnp.float32), q)
+    return (scores >= thr).astype(w.dtype)  # [heads]
+
+
+def apply_head_mask(w: jax.Array, mask: jax.Array) -> jax.Array:
+    """Zero the input slices of wo corresponding to pruned heads."""
+    num_heads = mask.shape[0]
+    hd = w.shape[-2] // num_heads
+    full = jnp.repeat(mask, hd)  # [heads*hd]
+    return w * full[..., :, None]

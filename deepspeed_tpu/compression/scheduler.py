@@ -1,0 +1,41 @@
+"""Compression scheduler (reference: deepspeed/compression/scheduler.py).
+
+The reference scheduler flips flags on the substituted modules at each
+technique's ``schedule_offset``. In the TPU build the gates are *inside*
+the compiled step (traced ``step >= offset`` selects), so the scheduler's
+runtime job reduces to observability: report which techniques are live at
+the current step, and mirror the reference's verbose prints."""
+
+from __future__ import annotations
+
+from .config import TECHNIQUES, CompressionConfig
+
+
+class CompressionScheduler:
+
+    def __init__(self, config: CompressionConfig, verbose: bool = False):
+        self.config = config
+        self.verbose = verbose
+        self.training_steps = 0
+        self._announced: set[str] = set()
+
+    def active_techniques(self, step: int | None = None) -> list[str]:
+        step = self.training_steps if step is None else step
+        out = []
+        for name in TECHNIQUES:
+            t = self.config.technique(name)
+            if t.enabled and step >= t.schedule_offset:
+                out.append(name)
+        return out
+
+    def step(self, step_zero_check: bool = False) -> None:
+        self.training_steps += 1
+        if not self.verbose:
+            return
+        for name in self.active_techniques():
+            if name not in self._announced:
+                self._announced.add(name)
+                from ..utils.logging import logger
+                logger.info(
+                    f"compression: {name} activated at step "
+                    f"{self.training_steps}")

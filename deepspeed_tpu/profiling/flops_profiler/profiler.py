@@ -110,9 +110,13 @@ class FlopsProfiler:
         self.macs = self.flops / 2
         self.bytes_accessed = float(self._cost.get("bytes accessed", 0.0))
         # params-by-convention: the FIRST dict-like positional arg (model
-        # state); later dict args are batches and must not be counted
+        # state); later dict args are batches and must not be counted.
+        # Engine train states carry params alongside moments/step — count
+        # only the model params, not optimizer state
         for a in args:
             if isinstance(a, dict) or hasattr(a, "keys"):
+                if "params" in a:
+                    a = a["params"]
                 self.params = count_params(a)
                 break
         jax.block_until_ready(compiled(*args, **kwargs))  # warm caches

@@ -174,14 +174,8 @@ class CurriculumLearningConfig(DeepSpeedConfigModel):
     schedule_config: dict[str, Any] = Field(default_factory=dict)
 
 
-class CompressionConfig(DeepSpeedConfigModel):
-    weight_quantization: dict[str, Any] = Field(default_factory=dict)
-    activation_quantization: dict[str, Any] = Field(default_factory=dict)
-    sparse_pruning: dict[str, Any] = Field(default_factory=dict)
-    row_pruning: dict[str, Any] = Field(default_factory=dict)
-    head_pruning: dict[str, Any] = Field(default_factory=dict)
-    channel_pruning: dict[str, Any] = Field(default_factory=dict)
-    layer_reduction: dict[str, Any] = Field(default_factory=dict)
+# Compression parsing lives with the subsystem (compression/config.py,
+# get_compression_config); the engine passes this raw section through.
 
 
 class AIOConfig(DeepSpeedConfigModel):
@@ -239,7 +233,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     curriculum_learning: CurriculumLearningConfig = Field(
         default_factory=CurriculumLearningConfig)
-    compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+    compression_training: dict[str, Any] = Field(default_factory=dict)
     aio: AIOConfig = Field(default_factory=AIOConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)

@@ -174,11 +174,31 @@ class DeepSpeedEngine:
         # --- sequence parallelism (reference: deepspeed/sequence) -------
         self._loss_fn = self._configure_sequence_parallel()
 
+        # --- compression (reference: deepspeed/compression) -------------
+        from ..compression import Compressor, get_compression_config
+        _ccfg = get_compression_config(
+            {"compression_training": self.config.compression_training})
+        self.compressor = Compressor(_ccfg) if _ccfg.any_enabled else None
+        if _ccfg.technique("activation_quantization").enabled:
+            logger.warning(
+                "activation_quantization is enabled but not auto-applied: "
+                "thread compressor.activation_quantizer() through the "
+                "model's forward (weight-side techniques apply "
+                "automatically)")
+
         # --- compiled step ----------------------------------------------
         def _loss_on_device(params, batch):
             return self._loss_fn(self._params_to_device(params), batch)
 
         self._loss_fn_dev = _loss_on_device
+        if self.compressor is not None:
+            _tr = self.compressor.transform
+
+            def _loss_on_device_step(params, batch, step):
+                p = self._params_to_device(params)
+                return self._loss_fn(_tr(p, step), batch)
+
+            self._loss_fn_dev_step = _loss_on_device_step
         if self._nvme_offload:
             if self._is_pipeline:
                 raise ValueError(
@@ -189,8 +209,11 @@ class DeepSpeedEngine:
             self._train_step = self._build_grads_step()
         else:
             self._train_step = self._build_train_step()
-        self._eval_loss = jax.jit(self._loss_fn_dev)
+        self._eval_loss = jax.jit(
+            self._loss_fn_dev if self.compressor is None
+            else self._loss_fn_dev_step)
         self._micro_grads_jit = None
+        self._accum_add_jit = None
         self._apply_grads_jit = None
         self._accum_grads = None
         self._micro_count = 0
@@ -317,8 +340,11 @@ class DeepSpeedEngine:
         self.state = jax.device_put(self.state, self.state_shardings)
         self._uses_host_memory = False
         self._train_step = self._build_train_step()
-        self._eval_loss = jax.jit(self._loss_fn_dev)
+        self._eval_loss = jax.jit(
+            self._loss_fn_dev if self.compressor is None
+            else self._loss_fn_dev_step)
         self._micro_grads_jit = None
+        self._accum_add_jit = None
         self._apply_grads_jit = None
 
     def _params_to_device(self, params):
@@ -341,8 +367,15 @@ class DeepSpeedEngine:
         compute_dtype = self.compute_dtype
         shardings = self.state_shardings
         fetch = fetch_to_device
+        compress = (self.compressor.transform
+                    if self.compressor is not None else None)
 
-        def micro_loss(params, batch, scale):
+        def micro_loss(params, batch, scale, step):
+            if compress is not None:
+                # QAT/pruning transform under grad: quantization rounds with
+                # an STE, pruning masks gate the gradient too (reference
+                # basic_layer.py forward semantics)
+                params = compress(params, step)
             loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
@@ -353,7 +386,8 @@ class DeepSpeedEngine:
             scale = state["loss_scale"].scale
 
             def body(acc, micro):
-                (_, loss), grads = grad_fn(params, micro, scale)
+                (_, loss), grads = grad_fn(params, micro, scale,
+                                           state["step"])
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 grads = constrain(grads, mesh, grad_specs)
                 acc = jax.tree.map(jnp.add, acc, grads)
@@ -440,7 +474,12 @@ class DeepSpeedEngine:
         grad_specs = self.plan.grad_specs
         loss_fn = self._loss_fn
 
-        def micro_loss(params, batch, scale):
+        compress = (self.compressor.transform
+                    if self.compressor is not None else None)
+
+        def micro_loss(params, batch, scale, step):
+            if compress is not None:
+                params = compress(params, step)
             loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
@@ -451,7 +490,8 @@ class DeepSpeedEngine:
             scale = state["loss_scale"].scale
 
             def body(acc, micro):
-                (_, loss), grads = grad_fn(params, micro, scale)
+                (_, loss), grads = grad_fn(params, micro, scale,
+                                           state["step"])
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 grads = constrain(grads, mesh, grad_specs)
                 return jax.tree.map(jnp.add, acc, grads), loss
@@ -578,6 +618,9 @@ class DeepSpeedEngine:
         Stores the batch for the subsequent backward()."""
         batch = self._put_batch(batch)
         self._pending_batch = batch
+        if self.compressor is not None:
+            return self._eval_loss(self.state["params"], batch,
+                                   self.state["step"])
         return self._eval_loss(self.state["params"], batch)
 
     def __call__(self, batch):
@@ -588,10 +631,12 @@ class DeepSpeedEngine:
         engine.backward:2007). The `loss` argument is accepted for API
         parity; gradients are recomputed functionally."""
         if self._micro_grads_jit is None:
-            def micro(params, batch, scale):
+            def micro(params, batch, scale, step):
                 params = self._params_to_device(params)
 
                 def f(p):
+                    if self.compressor is not None:
+                        p = self.compressor.transform(p, step)
                     return self._loss_fn(p, batch) * scale
                 g = jax.grad(f)(params)
                 g = jax.tree.map(lambda x: x.astype(jnp.float32), g)
@@ -599,12 +644,15 @@ class DeepSpeedEngine:
             self._micro_grads_jit = jax.jit(
                 micro, out_shardings=self.grad_shardings)
         g = self._micro_grads_jit(self.state["params"], self._pending_batch,
-                                  self.state["loss_scale"].scale)
+                                  self.state["loss_scale"].scale,
+                                  self.state["step"])
         if self._accum_grads is None:
             self._accum_grads = g
         else:
-            self._accum_grads = jax.jit(
-                lambda a, b: jax.tree.map(jnp.add, a, b))(self._accum_grads, g)
+            if self._accum_add_jit is None:
+                self._accum_add_jit = jax.jit(
+                    lambda a, b: jax.tree.map(jnp.add, a, b))
+            self._accum_grads = self._accum_add_jit(self._accum_grads, g)
         self._micro_count += 1
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -718,6 +766,9 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch):
         batch = self._put_batch(batch)
+        if self.compressor is not None:
+            return self._eval_loss(self.state["params"], batch,
+                                   self.state["step"])
         return self._eval_loss(self.state["params"], batch)
 
     # --- accessors (reference parity) ---------------------------------

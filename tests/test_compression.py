@@ -1,0 +1,162 @@
+"""Compression subsystem (reference: deepspeed/compression/ — QAT weight/
+activation quantization, sparse/row/head pruning, layer reduction,
+redundancy_clean)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.compression import (Compressor, functional as F,
+                                       get_compression_config,
+                                       init_compression, redundancy_clean,
+                                       student_initialization,
+                                       CompressionScheduler)
+from deepspeed_tpu.models import GPT2
+
+
+def wq_config(**params):
+    return {
+        "weight_quantization": {
+            "shared_parameters": {
+                "enabled": True, "schedule_offset": 0,
+                "quantize_groups": 1, "quantization_type": "symmetric",
+                **params},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 8, "target_bits": 8},
+                        "modules": ["*"]}}}}
+
+
+def test_fake_quantize_ste_gradient():
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 32))
+    g = jax.grad(lambda x: jnp.sum(F.fake_quantize(x, 8)))(w)
+    np.testing.assert_allclose(g, np.ones_like(w))
+
+
+def test_fake_quantize_levels():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+    dq = F.quantize_symmetric(w, 4, groups=4)
+    # 4-bit symmetric -> at most 16 distinct levels per group
+    for grp in dq.reshape(4, -1):
+        assert len(np.unique(np.round(grp, 6))) <= 16
+    err8 = np.abs(F.quantize_symmetric(w, 8) - w).max()
+    err4 = np.abs(F.quantize_symmetric(w, 4) - w).max()
+    assert err8 < err4
+
+
+def test_sparse_mask_fraction():
+    w = jax.random.normal(jax.random.PRNGKey(1), (64, 64))
+    mask = F.sparse_mask(w, 0.25)
+    assert abs(float(mask.mean()) - 0.25) < 0.02
+    blocked = F.sparse_mask(w, 0.5, pattern="4x1")
+    assert abs(float(blocked.mean()) - 0.5) < 0.05
+    # block structure: mask constant within each 4x1 block
+    b = np.asarray(blocked).reshape(16, 4, 64)
+    assert (b.min(axis=1) == b.max(axis=1)).all()
+
+
+def test_row_and_head_masks():
+    w = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 32))
+    rm = F.row_mask(w, 0.5)
+    assert rm.shape == (32,) and abs(float(rm.mean()) - 0.5) < 0.05
+    hm = F.head_mask(w, num_heads=4, dense_ratio=0.5)
+    assert hm.shape == (4,) and float(hm.sum()) == 2
+    masked = F.apply_head_mask(w, hm)
+    kept = np.asarray(hm).repeat(16)
+    assert (np.asarray(masked)[:, kept == 0, :] == 0).all()
+
+
+def test_progressive_schedules():
+    bits = F.progressive_bits(jnp.asarray(0), start_bits=8, target_bits=4,
+                              offset=10, period=5)
+    assert float(bits) == 8
+    bits = F.progressive_bits(jnp.asarray(40), start_bits=8, target_bits=4,
+                              offset=10, period=5)
+    assert float(bits) == 4
+    r = F.progressive_ratio(jnp.asarray(50), target_ratio=0.2, offset=0,
+                            offset_end=100)
+    assert abs(float(r) - 0.6) < 1e-5
+
+
+def test_compressor_transform_gated_by_step():
+    comp = init_compression(deepspeed_config={
+        "compression_training": wq_config(schedule_offset=5)})
+    params = {"layers": {"wq": jax.random.normal(jax.random.PRNGKey(0),
+                                                 (2, 32, 32))}}
+    before = comp.transform(params, jnp.asarray(0))
+    np.testing.assert_allclose(before["layers"]["wq"], params["layers"]["wq"])
+    after = comp.transform(params, jnp.asarray(5))
+    assert not np.allclose(after["layers"]["wq"], params["layers"]["wq"])
+
+
+def test_excluded_leaves_untouched():
+    comp = init_compression(deepspeed_config={
+        "compression_training": wq_config()})
+    params = {"embed": {"tokens": jnp.ones((16, 8))},
+              "layers": {"ln1_scale": jnp.ones((2, 8)),
+                         "wq_b": jnp.ones((2, 8))}}
+    out = comp.transform(params, jnp.asarray(100))
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(params)):
+        np.testing.assert_allclose(a, b)
+
+
+def test_engine_trains_with_compression(devices8):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"fsdp": -1},
+        "compression_training": {
+            **wq_config(),
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                                      "method": "l1"},
+                "different_groups": {
+                    "sp1": {"params": {"dense_ratio": 0.5},
+                            "modules": ["layers/w_"]}}}},
+    }
+    engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 17), 0, 512)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    losses = [float(engine.train_batch(batch)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_redundancy_clean_sparsity():
+    cfg = {"compression_training": {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "method": "l1"},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.3}, "modules": ["*"]}}}}}
+    params = {"layers": {"wq": jax.random.normal(jax.random.PRNGKey(0),
+                                                 (2, 64, 64))}}
+    cleaned = redundancy_clean(params, cfg)
+    density = float((cleaned["layers"]["wq"] != 0).mean())
+    assert abs(density - 0.3) < 0.03
+
+
+def test_student_initialization_layer_reduction():
+    teacher = GPT2(size="tiny", num_layers=4)
+    student = GPT2(size="tiny", num_layers=2)
+    tp = teacher.init(jax.random.PRNGKey(0))
+    sp = student.init(jax.random.PRNGKey(1))
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 2,
+        "teacher_layer": [1, 3]}}}
+    out = student_initialization(sp, tp, cfg)
+    np.testing.assert_allclose(out["layers"]["wq"][0], tp["layers"]["wq"][1])
+    np.testing.assert_allclose(out["layers"]["wq"][1], tp["layers"]["wq"][3])
+    np.testing.assert_allclose(out["embed"]["tokens"], tp["embed"]["tokens"])
+
+
+def test_scheduler_reports_active():
+    cfg = get_compression_config({"compression_training": wq_config(
+        schedule_offset=3)})
+    sched = CompressionScheduler(cfg)
+    assert sched.active_techniques(0) == []
+    assert sched.active_techniques(3) == ["weight_quantization"]
+    for _ in range(4):
+        sched.step()
+    assert "weight_quantization" in sched.active_techniques()
