@@ -112,10 +112,11 @@ class Compressor:
             for g in cp.groups:
                 if not _match(g.modules, path, w):
                     continue
-                mask = F.row_mask(out, float(g.params.get("dense_ratio", 0.5)))
+                mask = F.channel_mask(out,
+                                      float(g.params.get("dense_ratio", 0.5)))
                 gated = jnp.where(step >= cp.schedule_offset, mask,
                                   jnp.ones_like(mask))
-                out = out * gated
+                out = out * gated[..., :, None]  # input-channel axis (-2)
 
         wq = cfg.technique(WEIGHT_QUANTIZATION)
         if wq.enabled:
@@ -217,8 +218,11 @@ def student_initialization(student_params: PyTree, teacher_params: PyTree,
     def pick(path, s_leaf, t_leaf):
         p = _path_str(path)
         if "layers/" in p or p.startswith("layers"):
-            if len(idx) and np.shape(t_leaf)[0] >= len(idx) \
-                    and np.shape(s_leaf)[0] == len(idx):
+            if len(idx) and np.shape(s_leaf)[0] == len(idx):
+                if idx.max() >= np.shape(t_leaf)[0]:
+                    raise ValueError(
+                        f"teacher_layer {cfg.teacher_layer} out of range "
+                        f"for {p} with {np.shape(t_leaf)[0]} layers")
                 return jnp.take(t_leaf, idx, axis=0).astype(s_leaf.dtype)
             return s_leaf
         if np.shape(s_leaf) == np.shape(t_leaf):

@@ -154,6 +154,16 @@ def row_mask(w: jax.Array, dense_ratio) -> jax.Array:
     return (scores >= thr).astype(w.dtype)  # [out]
 
 
+def channel_mask(w: jax.Array, dense_ratio) -> jax.Array:
+    """Structured mask over the *input*-channel dim (axis -2 in the
+    ``x @ w`` layout — reference channel pruning). Returns [in]."""
+    axes = tuple(d for d in range(w.ndim) if d != w.ndim - 2)
+    scores = jnp.sum(jnp.abs(w), axis=axes)
+    q = jnp.clip(1.0 - jnp.asarray(dense_ratio, jnp.float32), 0.0, 1.0)
+    thr = jnp.quantile(scores.astype(jnp.float32), q)
+    return (scores >= thr).astype(w.dtype)  # [in]
+
+
 def head_mask(w: jax.Array, num_heads: int, dense_ratio) -> jax.Array:
     """Mask attention heads by the L1 norm of the output-projection slice
     each head feeds (reference head pruning on attention output matrix).

@@ -1,0 +1,17 @@
+"""Data efficiency pipeline (reference: deepspeed/runtime/data_pipeline/):
+curriculum learning, difficulty-bucketed sampling, dataset metric analysis,
+mmap indexed datasets, and random-LTD token dropping."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampling.data_sampler import DeepSpeedDataSampler
+from .data_sampling.indexed_dataset import (MMapIndexedDataset,
+                                            MMapIndexedDatasetBuilder)
+from .data_sampling.data_analyzer import DataAnalyzer
+from .data_routing.basic_layer import RandomLayerTokenDrop, random_ltd_gather
+from .data_routing.scheduler import RandomLTDScheduler
+
+__all__ = [
+    "CurriculumScheduler", "DeepSpeedDataSampler", "MMapIndexedDataset",
+    "MMapIndexedDatasetBuilder", "DataAnalyzer", "RandomLayerTokenDrop",
+    "random_ltd_gather", "RandomLTDScheduler",
+]

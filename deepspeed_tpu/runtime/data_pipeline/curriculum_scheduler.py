@@ -1,0 +1,99 @@
+"""Curriculum difficulty scheduler (reference:
+runtime/data_pipeline/curriculum_scheduler.py:11).
+
+Maps global step -> difficulty (e.g. sequence length). Schedule types match
+the reference: fixed_discrete, fixed_linear, fixed_root, custom. On TPU the
+difficulty feeds XLA shape *buckets*: difficulty_step quantization bounds
+the number of distinct compiled shapes (the reference's Tensor-Core
+multiple-of-8 advice becomes a recompile-count bound here)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: dict[str, Any]):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires '{key}'")
+        self.state = {
+            "min_difficulty": config["min_difficulty"],
+            "max_difficulty": config["max_difficulty"],
+            "current_difficulty": config["min_difficulty"],
+            "schedule_type": config["schedule_type"],
+            "schedule_config": dict(config.get("schedule_config", {})),
+        }
+        self.custom_get_difficulty: Callable[[int], int] | None = None
+        sched = self.state["schedule_config"]
+        stype = self.state["schedule_type"]
+        if stype == "fixed_discrete":
+            diff = sched.get("difficulty")
+            max_step = sched.get("max_step")
+            if not diff or max_step is None or len(diff) != len(max_step) + 1:
+                raise ValueError(
+                    "fixed_discrete needs schedule_config.difficulty (n) "
+                    "and .max_step (n-1)")
+        elif stype in ("fixed_linear", "fixed_root"):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in sched:
+                    raise ValueError(f"{stype} needs schedule_config.{key}")
+            if stype == "fixed_root" and "root_degree" not in sched:
+                raise ValueError("fixed_root needs schedule_config.root_degree")
+        elif stype != "custom":
+            raise ValueError(f"unsupported curriculum schedule {stype!r}")
+
+    # -- reference-parity accessors ------------------------------------
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def get_state(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    # -- schedules ------------------------------------------------------
+    def _fixed_discrete(self, step: int) -> int:
+        sched = self.state["schedule_config"]
+        for limit, diff in zip(sched["max_step"], sched["difficulty"]):
+            if step <= limit:
+                return diff
+        return sched["difficulty"][-1]
+
+    def _fixed_root(self, step: int, degree: float) -> int:
+        sched = self.state["schedule_config"]
+        lo, hi = self.state["min_difficulty"], self.state["max_difficulty"]
+        frac = (float(step) / sched["total_curriculum_step"]) ** (1.0 / degree)
+        diff = math.floor(frac * (hi - lo) + lo)
+        diff -= diff % sched["difficulty_step"]
+        return min(diff, hi)
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == "fixed_discrete":
+            return self._fixed_discrete(global_steps)
+        if stype == "fixed_linear":
+            return self._fixed_root(global_steps, 1.0)
+        if stype == "fixed_root":
+            return self._fixed_root(
+                global_steps, self.state["schedule_config"]["root_degree"])
+        if self.custom_get_difficulty is None:
+            raise RuntimeError(
+                "custom schedule requires set_custom_get_difficulty")
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["current_difficulty"] < self.state["max_difficulty"]:
+            self.state["current_difficulty"] = max(
+                self.get_difficulty(global_steps),
+                self.state["min_difficulty"])
+        return self.state["current_difficulty"]

@@ -1,0 +1,44 @@
+"""Random layer token dropping (reference:
+runtime/data_pipeline/data_routing/basic_layer.py RandomLayerTokenDrop).
+
+Random-LTD trains middle layers on a random subset of tokens: gather a
+scheduled number of tokens, run the layer on the short sequence, scatter
+the outputs back (dropped tokens ride the residual). On TPU the kept count
+must be static per compile, so the scheduler quantizes it (reference's
+random_ltd kernels become one jnp.take + one scatter that XLA fuses)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_gather(x: jax.Array, keep: int, rng: jax.Array):
+    """Pick ``keep`` random token positions (shared across the batch, order
+    preserved). Returns (subset [B, keep, D], idx [keep])."""
+    seq = x.shape[1]
+    keep = min(int(keep), seq)
+    idx = jnp.sort(jax.random.choice(rng, seq, (keep,), replace=False))
+    return jnp.take(x, idx, axis=1), idx
+
+
+def random_ltd_scatter(x: jax.Array, sub: jax.Array, idx: jax.Array):
+    """Write the processed subset back into the full sequence."""
+    return x.at[:, idx].set(sub.astype(x.dtype))
+
+
+class RandomLayerTokenDrop:
+    """Wraps a layer fn ``(params, x [B,S,D]) -> [B,S,D]`` so it runs on a
+    random token subset; dropped tokens pass through unchanged."""
+
+    def __init__(self, layer_fn: Callable):
+        self.layer_fn = layer_fn
+
+    def __call__(self, params, x: jax.Array, *, keep: int, rng: jax.Array):
+        if keep >= x.shape[1]:
+            return self.layer_fn(params, x)
+        sub, idx = random_ltd_gather(x, keep, rng)
+        out = self.layer_fn(params, sub)
+        return random_ltd_scatter(x, out, idx)

@@ -1,0 +1,52 @@
+"""Random-LTD schedule (reference:
+runtime/data_pipeline/data_routing/scheduler.py RandomLTDScheduler).
+
+Kept-token count grows from min_value to max_value over the schedule;
+values are quantized to ``seq_per_step`` so the number of distinct XLA
+compilations stays bounded (the TPU analogue of the reference's CUDA-side
+granularity knob)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class RandomLTDScheduler:
+
+    def __init__(self, config: dict[str, Any]):
+        ltd = config.get("random_ltd", config)
+        self.min_value = int(ltd.get("random_ltd_schedule", {}).get(
+            "min_value", ltd.get("min_value", 128)))
+        self.max_value = int(ltd.get("random_ltd_schedule", {}).get(
+            "max_value", ltd.get("max_value", 1024)))
+        sched = ltd.get("random_ltd_schedule", ltd)
+        cfg = sched.get("schedule_config", {})
+        self.total_steps = int(cfg.get("require_steps",
+                                       cfg.get("total_layer_tokens", 1000)))
+        self.seq_per_step = int(cfg.get("seq_per_step", 8))
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise ValueError(
+                f"unsupported random_ltd schedule {self.schedule_type!r}")
+        self.current_value = self.min_value
+        self.global_step = 0
+
+    def get_current_seq(self) -> int:
+        return self.current_value
+
+    def update_seq(self, global_step: int) -> int:
+        self.global_step = global_step
+        frac = min(max(global_step / max(self.total_steps, 1), 0.0), 1.0)
+        val = int(self.min_value + frac * (self.max_value - self.min_value))
+        val -= val % self.seq_per_step
+        self.current_value = max(self.min_value,
+                                 min(val, self.max_value))
+        return self.current_value
+
+    def state_dict(self) -> dict[str, Any]:
+        return {"current_value": self.current_value,
+                "global_step": self.global_step}
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.current_value = state["current_value"]
+        self.global_step = state["global_step"]

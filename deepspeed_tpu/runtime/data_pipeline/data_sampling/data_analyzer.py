@@ -1,0 +1,118 @@
+"""Dataset metric analyzer (reference:
+runtime/data_pipeline/data_sampling/data_analyzer.py DataAnalyzer).
+
+Map-reduce indexing of per-sample difficulty metrics: each map worker
+computes metric values over its shard of the dataset and writes them to
+disk; reduce merges the shards into the index files the curriculum sampler
+reads (sample->metric, sorted index->sample order, metric-value->samples).
+Workers are plain processes — on a pod, run one mapper per host and reduce
+once (the reference's torch.distributed barrier becomes a filesystem
+rendezvous)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+
+class DataAnalyzer:
+
+    def __init__(self, dataset: Sequence,
+                 metric_names: list[str],
+                 metric_functions: list[Callable],
+                 metric_types: list[str] | None = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1,
+                 worker_id: int = 0,
+                 batch_size: int = 1024,
+                 metric_dtypes: list | None = None):
+        self.dataset = dataset
+        self.metric_names = metric_names
+        self.metric_functions = metric_functions
+        self.metric_types = metric_types or \
+            ["single_value_per_sample"] * len(metric_names)
+        self.save_path = Path(save_path)
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+
+    def _metric_dir(self, metric: str) -> Path:
+        d = self.save_path / metric
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _worker_range(self) -> range:
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        start = per * self.worker_id
+        return range(start, min(start + per, n))
+
+    # -- map ------------------------------------------------------------
+    def run_map(self) -> None:
+        """Compute this worker's shard of every metric and persist it."""
+        rng = self._worker_range()
+        for name, fn, mtype in zip(self.metric_names, self.metric_functions,
+                                   self.metric_types):
+            out = self._metric_dir(name) / f"worker{self.worker_id}.npy"
+            if mtype == "accumulate_value_over_samples":
+                acc = None
+                for i in rng:
+                    v = np.asarray(fn(self.dataset[i]), np.float64)
+                    acc = v if acc is None else acc + v
+                np.save(out, acc if acc is not None else np.zeros(1))
+            else:  # single_value_per_sample
+                vals = np.empty(len(rng), np.float64)
+                for j, i in enumerate(rng):
+                    vals[j] = float(fn(self.dataset[i]))
+                np.save(out, vals)
+        meta = {"num_workers": self.num_workers, "total": len(self.dataset)}
+        (self.save_path / "map_meta.json").write_text(json.dumps(meta))
+
+    # -- reduce ---------------------------------------------------------
+    def run_reduce(self) -> None:
+        """Merge worker shards into the sampler-facing index files."""
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            d = self._metric_dir(name)
+            shards = [np.load(d / f"worker{w}.npy")
+                      for w in range(self.num_workers)]
+            if mtype == "accumulate_value_over_samples":
+                total = shards[0]
+                for s in shards[1:]:
+                    total = total + s
+                np.save(d / f"{name}_value.npy", total)
+                continue
+            vals = np.concatenate(shards)
+            # sample -> metric value (indexed dataset, one entry/sample)
+            with MMapIndexedDatasetBuilder(
+                    str(d / f"{name}_sample_to_metric"),
+                    dtype=np.float64) as b:
+                for v in vals:
+                    b.add_item([v])
+            # difficulty-sorted sample order (percentile lookups)
+            order = np.argsort(vals, kind="stable")
+            np.save(d / f"{name}_index_to_sample.npy", order)
+            # metric value -> sample ids (value-based lookups)
+            uniq = {}
+            for i, v in enumerate(vals):
+                uniq.setdefault(float(v), []).append(i)
+            np.savez(d / f"{name}_metric_to_sample.npz",
+                     **{str(k): np.asarray(v) for k, v in uniq.items()})
+
+    # -- consumers ------------------------------------------------------
+    def get_metric_values(self, metric: str) -> np.ndarray:
+        ds = MMapIndexedDataset(
+            str(self._metric_dir(metric) / f"{metric}_sample_to_metric"))
+        return np.asarray([ds[i][0] for i in range(len(ds))])
+
+    def run_map_reduce(self) -> None:
+        if self.num_workers != 1:
+            raise ValueError(
+                "run_map_reduce is the single-process path; run run_map "
+                "per worker then run_reduce once")
+        self.run_map()
+        self.run_reduce()

@@ -1,0 +1,154 @@
+"""Curriculum data sampler (reference:
+runtime/data_pipeline/data_sampling/data_sampler.py:38 DeepSpeedDataSampler).
+
+Yields per-step sample indices drawn from the pool of samples whose
+per-metric difficulty is within the current curriculum thresholds
+(value-based: metric value <= difficulty; percentile-based: sample rank
+<= difficulty percentile). Clusters are rebuilt only when a difficulty
+advances, sampling within a cluster is a seeded shuffle, and the global
+batch is deterministic across data-parallel ranks — each rank slices its
+shard of the same global index list (no inter-host communication needed,
+matching the reference's identical-RNG design)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ..curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self, data_efficiency_config: dict[str, Any],
+                 one_epoch_total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_rank: int,
+                 data_parallel_size: int,
+                 data_sampling_num_workers: int = 1,
+                 gradient_accumulation_steps: int = 1,
+                 global_rank: int = 0,
+                 drop_last: bool = True,
+                 metric_values: dict[str, np.ndarray] | None = None):
+        """``metric_values`` maps metric name -> per-sample difficulty array
+        (the output of DataAnalyzer; the reference reads the same data via
+        its index files)."""
+        cl = (data_efficiency_config.get("data_sampling", {})
+              .get("curriculum_learning", {}))
+        self.enabled = bool(cl.get("enabled", False))
+        self.seed = int(data_efficiency_config.get("seed", 1234))
+        self.total = int(one_epoch_total_samples)
+        self.micro_batch = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.gas = gradient_accumulation_steps
+        self.drop_last = drop_last
+        self.global_batch = micro_batch_size * data_parallel_size \
+            * gradient_accumulation_steps
+        self.metric_values = metric_values or {}
+
+        self.schedulers: dict[str, CurriculumScheduler] = {}
+        self.difficulty_type: dict[str, str] = {}
+        self._order: dict[str, np.ndarray] = {}  # rank->sample by metric
+        for metric, mcfg in (cl.get("metrics", {}) or {}).items():
+            self.schedulers[metric] = CurriculumScheduler(mcfg)
+            self.difficulty_type[metric] = mcfg.get("difficulty_type",
+                                                    "value")
+            if metric in self.metric_values:
+                vals = np.asarray(self.metric_values[metric])
+                if len(vals) != self.total:
+                    raise ValueError(
+                        f"metric {metric!r} has {len(vals)} values for "
+                        f"{self.total} samples")
+                self._order[metric] = np.argsort(vals, kind="stable")
+        self.consumed_samples = 0
+        self._cluster: np.ndarray | None = None
+        self._prev_difficulties = {m: -1 for m in self.schedulers}
+
+    def __len__(self) -> int:
+        return self.total
+
+    def set_custom_curriculum_learning_schedule(self, fn_dict: dict) -> None:
+        for metric, fn in fn_dict.items():
+            if metric in self.schedulers:
+                self.schedulers[metric].set_custom_get_difficulty(fn)
+
+    # -- cluster construction ------------------------------------------
+    def _eligible(self, metric: str, difficulty: int) -> np.ndarray:
+        vals = np.asarray(self.metric_values[metric])
+        if self.difficulty_type[metric] == "value":
+            return np.nonzero(vals <= difficulty)[0]
+        # percentile-based: lowest `difficulty` percent of samples by rank
+        max_pct = self.schedulers[metric].state["max_difficulty"]
+        count = max(1, self.total * difficulty // max(max_pct, 1))
+        return self._order[metric][:count]
+
+    def _rebuild_cluster(self) -> None:
+        pools = [self._eligible(metric, sched.get_current_difficulty())
+                 for metric, sched in self.schedulers.items()
+                 if metric in self.metric_values]
+        if not pools:
+            self._cluster = np.arange(self.total)
+            return
+        eligible = np.sort(pools[0])
+        for p in pools[1:]:
+            eligible = np.intersect1d(eligible, p, assume_unique=True)
+        if eligible.size == 0:
+            # always keep at least one global batch of the easiest samples
+            any_metric = next(iter(self._order), None)
+            base = (self._order[any_metric] if any_metric is not None
+                    else np.arange(self.total))
+            eligible = np.sort(base[:self.global_batch])
+        self._cluster = eligible
+
+    # -- iteration ------------------------------------------------------
+    def get_next_global_batch(self) -> np.ndarray:
+        step = self.consumed_samples // max(self.global_batch, 1)
+        changed = False
+        for metric, sched in self.schedulers.items():
+            diff = sched.update_difficulty(step + 1)
+            if diff != self._prev_difficulties[metric]:
+                self._prev_difficulties[metric] = diff
+                changed = True
+        if self._cluster is None or changed:
+            self._rebuild_cluster()
+        rng = np.random.default_rng(self.seed + step)
+        pick = rng.choice(len(self._cluster), size=self.global_batch,
+                          replace=len(self._cluster) < self.global_batch)
+        batch = self._cluster[pick]
+        self.consumed_samples += self.global_batch
+        return batch
+
+    def get_start_end_idx(self, batch_len: int | None = None):
+        """This DP rank's slice of the global batch (reference :122)."""
+        n = batch_len if batch_len is not None else self.global_batch
+        per_rank = (n + self.dp_size - 1) // self.dp_size
+        start = min(per_rank * self.dp_rank, n)
+        return start, min(start + per_rank, n)
+
+    def __iter__(self):
+        while self.consumed_samples < self.total:
+            batch = self.get_next_global_batch()
+            start, end = self.get_start_end_idx(len(batch))
+            yield from (batch[start:end]
+                        .reshape(-1, self.micro_batch)[: self.gas]
+                        .tolist())
+
+    # -- checkpointable state ------------------------------------------
+    def state_dict(self) -> dict[str, Any]:
+        return {
+            "consumed_samples": self.consumed_samples,
+            "curriculum_states": {m: s.get_state()
+                                  for m, s in self.schedulers.items()},
+        }
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        self.consumed_samples = state["consumed_samples"]
+        for m, s in state.get("curriculum_states", {}).items():
+            if m in self.schedulers:
+                self.schedulers[m].set_state(s)
+        self._prev_difficulties = {
+            m: s.get_current_difficulty()
+            for m, s in self.schedulers.items()}
+        self._cluster = None

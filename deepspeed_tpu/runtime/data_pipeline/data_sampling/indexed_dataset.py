@@ -1,0 +1,123 @@
+"""Memory-mapped indexed dataset (reference:
+runtime/data_pipeline/data_sampling/indexed_dataset.py — the Megatron mmap
+format the DataAnalyzer and curriculum sampler store their indices in).
+
+Layout is two files per dataset:
+  <path>.idx — header (magic, dtype code, count) + int32 lengths array +
+               int64 offsets array (element offsets into the .bin)
+  <path>.bin — the concatenated sample payload, one contiguous dtype array
+
+The reader memory-maps both, so a 100B-token corpus costs no RSS until
+touched — on a TPU host this is the input-pipeline half of the NVMe story
+(the parameter half lives in ops/aio)."""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+           9: np.uint32, 10: np.uint64}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+class MMapIndexedDatasetBuilder:
+
+    def __init__(self, out_file: str, dtype=np.int32):
+        self._path = str(out_file)
+        self._dtype = np.dtype(dtype)
+        self._bin = open(self._path + ".bin", "wb")
+        self._lengths: list[int] = []
+
+    def add_item(self, array) -> None:
+        arr = np.asarray(array, dtype=self._dtype).ravel()
+        self._bin.write(arr.tobytes(order="C"))
+        self._lengths.append(arr.size)
+
+    def add_items(self, arrays) -> None:
+        for a in arrays:
+            self.add_item(a)
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another dataset (reference builder.merge_file_ — used by
+        the DataAnalyzer's reduce step)."""
+        other = MMapIndexedDataset(other_prefix)
+        if other._dtype != self._dtype:
+            raise ValueError("dtype mismatch in merge")
+        with open(other_prefix + ".bin", "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                self._bin.write(chunk)
+        self._lengths.extend(int(n) for n in other._lengths)
+
+    def finalize(self) -> None:
+        self._bin.close()
+        lengths = np.asarray(self._lengths, np.int32)
+        offsets = np.zeros(len(lengths) + 1, np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        with open(self._path + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<BQ", _CODES[self._dtype], len(lengths)))
+            f.write(lengths.tobytes())
+            f.write(offsets.tobytes())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finalize()
+
+
+class MMapIndexedDataset:
+
+    def __init__(self, path: str):
+        self._prefix = str(path)
+        with open(self._prefix + ".idx", "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{path}.idx: bad magic {magic!r}")
+            code, count = struct.unpack("<BQ", f.read(9))
+            self._dtype = np.dtype(_DTYPES[code])
+            header = f.tell()
+        idx = np.memmap(self._prefix + ".idx", mode="r", offset=header,
+                        dtype=np.uint8)
+        self._lengths = idx[:count * 4].view(np.int32)
+        self._offsets = idx[count * 4:count * 4 + (count + 1) * 8].view(np.int64)
+        self._bin = np.memmap(self._prefix + ".bin", mode="r",
+                              dtype=self._dtype)
+
+    def __len__(self) -> int:
+        return len(self._lengths)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        return self._bin[self._offsets[i]:self._offsets[i + 1]]
+
+    def get(self, i, offset: int = 0, length: int | None = None):
+        start = self._offsets[i] + offset
+        stop = (self._offsets[i + 1] if length is None
+                else min(start + length, self._offsets[i + 1]))
+        return self._bin[start:stop]
+
+    @property
+    def sizes(self):
+        return self._lengths
+
+    @staticmethod
+    def exists(path: str) -> bool:
+        return (Path(path + ".idx").exists()
+                and Path(path + ".bin").exists())
+
+
+def make_dataset(path: str, impl: str = "mmap", skip_warmup: bool = True):
+    """reference indexed_dataset.make_dataset shim (mmap only)."""
+    if impl not in ("mmap", "infer"):
+        raise ValueError(f"only mmap impl supported, got {impl!r}")
+    return MMapIndexedDataset(path)

@@ -174,6 +174,20 @@ class DeepSpeedEngine:
         # --- sequence parallelism (reference: deepspeed/sequence) -------
         self._loss_fn = self._configure_sequence_parallel()
 
+        # --- curriculum learning (reference: engine.py:1723,1887) -------
+        self.curriculum_scheduler_legacy = None
+        self._curriculum_seqlen = None
+        cl_cfg = self.config.curriculum_learning
+        if cl_cfg.enabled:
+            from .data_pipeline.curriculum_scheduler import \
+                CurriculumScheduler
+            self.curriculum_scheduler_legacy = CurriculumScheduler({
+                "min_difficulty": cl_cfg.min_difficulty,
+                "max_difficulty": cl_cfg.max_difficulty,
+                "schedule_type": cl_cfg.schedule_type,
+                "schedule_config": cl_cfg.schedule_config,
+            })
+
         # --- compression (reference: deepspeed/compression) -------------
         from ..compression import Compressor, get_compression_config
         _ccfg = get_compression_config(
@@ -550,6 +564,24 @@ class DeepSpeedEngine:
             self.skipped_steps += 1
         return metrics
 
+    def _apply_curriculum(self, batch):
+        """Legacy seqlen curriculum (reference: engine.py:1887): truncate
+        the batch's sequence dim to the scheduled difficulty. Difficulty is
+        quantized by difficulty_step, so the set of XLA shapes (and thus
+        recompiles) is bounded."""
+        if self.curriculum_scheduler_legacy is None:
+            return batch
+        seqlen = self.curriculum_scheduler_legacy.update_difficulty(
+            self.global_steps + 1)
+        self._curriculum_seqlen = seqlen
+
+        def cut(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[1] > seqlen:
+                return x[:, :seqlen]
+            return x
+
+        return jax.tree.map(cut, batch)
+
     # ------------------------------------------------------------------
     # public API (reference parity)
     # ------------------------------------------------------------------
@@ -564,6 +596,7 @@ class DeepSpeedEngine:
             if data_iter is None:
                 raise ValueError("train_batch needs a batch or data_iter")
             batch = next(data_iter)
+        batch = self._apply_curriculum(batch)
         batch = self._put_batch(batch)
         self.tput_timer.start()
         if self._offload_opt is not None:

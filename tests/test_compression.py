@@ -151,6 +151,31 @@ def test_student_initialization_layer_reduction():
     np.testing.assert_allclose(out["embed"]["tokens"], tp["embed"]["tokens"])
 
 
+def test_channel_pruning_masks_input_axis():
+    cfg = {"compression_training": {"channel_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                              "method": "l1"},
+        "different_groups": {"cp1": {"params": {"dense_ratio": 0.5},
+                                     "modules": ["*"]}}}}}
+    w = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 8))
+    out = init_compression(deepspeed_config=cfg).transform(
+        {"layers": {"wq": w}}, jnp.asarray(10))["layers"]["wq"]
+    zero_in = np.asarray((out == 0).all(axis=(0, 2)))   # input channels
+    zero_out = np.asarray((out == 0).all(axis=(0, 1)))  # output channels
+    assert zero_in.sum() == 8 and zero_out.sum() == 0
+
+
+def test_student_initialization_rejects_bad_teacher_layer():
+    teacher = GPT2(size="tiny", num_layers=4)
+    student = GPT2(size="tiny", num_layers=2)
+    tp = teacher.init(jax.random.PRNGKey(0))
+    sp = student.init(jax.random.PRNGKey(1))
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "teacher_layer": [1, 5]}}}
+    with pytest.raises(ValueError, match="out of range"):
+        student_initialization(sp, tp, cfg)
+
+
 def test_scheduler_reports_active():
     cfg = get_compression_config({"compression_training": wq_config(
         schedule_offset=3)})

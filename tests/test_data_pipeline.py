@@ -1,0 +1,225 @@
+"""Data efficiency pipeline (reference: deepspeed/runtime/data_pipeline/ —
+curriculum scheduler, curriculum sampler, data analyzer, indexed dataset,
+random-LTD)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, RandomLayerTokenDrop,
+    RandomLTDScheduler, random_ltd_gather)
+
+
+def test_curriculum_fixed_linear():
+    s = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.update_difficulty(0) == 8
+    mid = s.update_difficulty(50)
+    assert 8 < mid < 64 and mid % 8 == 0
+    assert s.update_difficulty(100) == 64
+    assert s.update_difficulty(500) == 64  # saturates
+
+
+def test_curriculum_fixed_discrete_and_root():
+    s = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 3,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]}})
+    assert s.get_difficulty(3) == 1
+    assert s.get_difficulty(7) == 2
+    assert s.get_difficulty(11) == 3
+    r = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8, "root_degree": 2}})
+    # sqrt schedule grows faster early than linear
+    assert r.get_difficulty(25) >= 32
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    path = str(tmp_path / "ds")
+    samples = [np.arange(n, dtype=np.int32) for n in (3, 7, 1, 12)]
+    with MMapIndexedDatasetBuilder(path, dtype=np.int32) as b:
+        b.add_items(samples)
+    ds_ = MMapIndexedDataset(path)
+    assert len(ds_) == 4
+    for got, want in zip(ds_[0:4], samples):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds_.sizes, [3, 7, 1, 12])
+    np.testing.assert_array_equal(ds_.get(3, offset=2, length=4),
+                                  [2, 3, 4, 5])
+    assert MMapIndexedDataset.exists(path)
+
+
+def test_indexed_dataset_merge(tmp_path):
+    a, b_, m = (str(tmp_path / n) for n in "abm")
+    with MMapIndexedDatasetBuilder(a) as b:
+        b.add_item([1, 2])
+    with MMapIndexedDatasetBuilder(b_) as b:
+        b.add_item([3])
+    with MMapIndexedDatasetBuilder(m) as b:
+        b.add_item([0])
+        b.merge_file_(a)
+        b.merge_file_(b_)
+    merged = MMapIndexedDataset(m)
+    assert len(merged) == 3
+    np.testing.assert_array_equal(merged[1], [1, 2])
+    np.testing.assert_array_equal(merged[2], [3])
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    data = [np.full(i + 1, i, np.int32) for i in range(20)]
+    an = DataAnalyzer(data, ["seqlen"], [lambda s: len(s)],
+                      save_path=str(tmp_path))
+    an.run_map_reduce()
+    vals = an.get_metric_values("seqlen")
+    np.testing.assert_array_equal(vals, np.arange(1, 21))
+    order = np.load(tmp_path / "seqlen" / "seqlen_index_to_sample.npy")
+    np.testing.assert_array_equal(order, np.arange(20))
+
+
+def test_data_analyzer_multiworker(tmp_path):
+    data = [np.full(3, i) for i in range(10)]
+    for w in (0, 1):
+        DataAnalyzer(data, ["m"], [lambda s: float(s[0])],
+                     save_path=str(tmp_path), num_workers=2,
+                     worker_id=w).run_map()
+    an = DataAnalyzer(data, ["m"], [lambda s: float(s[0])],
+                      save_path=str(tmp_path), num_workers=2)
+    an.run_reduce()
+    np.testing.assert_array_equal(an.get_metric_values("m"), np.arange(10))
+
+
+def test_curriculum_sampler_value_based():
+    metric = np.arange(100)  # difficulty == sample id
+    cfg = {"seed": 7, "data_sampling": {"curriculum_learning": {
+        "enabled": True,
+        "metrics": {"seqlen": {
+            "min_difficulty": 10, "max_difficulty": 100,
+            "schedule_type": "fixed_linear", "difficulty_type": "value",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 10}}}}}}
+    s = DeepSpeedDataSampler(cfg, one_epoch_total_samples=100,
+                             micro_batch_size=4, data_parallel_rank=0,
+                             data_parallel_size=2,
+                             metric_values={"seqlen": metric})
+    first = s.get_next_global_batch()
+    # early batches only draw easy (low-id) samples
+    assert first.max() <= 20
+    for _ in range(12):
+        last = s.get_next_global_batch()
+    assert last.max() > 50  # difficulty saturated -> full pool
+
+    # rank slicing: two ranks partition the global batch
+    s0, e0 = s.get_start_end_idx(8)
+    assert (s0, e0) == (0, 4)
+
+    # deterministic across replicas with identical state
+    s2 = DeepSpeedDataSampler(cfg, 100, 4, 1, 2,
+                              metric_values={"seqlen": metric})
+    np.testing.assert_array_equal(s2.get_next_global_batch(), first)
+
+
+def test_curriculum_sampler_state_roundtrip():
+    metric = np.arange(50)
+    cfg = {"data_sampling": {"curriculum_learning": {
+        "enabled": True,
+        "metrics": {"m": {"min_difficulty": 5, "max_difficulty": 50,
+                          "schedule_type": "fixed_linear",
+                          "difficulty_type": "value",
+                          "schedule_config": {"total_curriculum_step": 20,
+                                              "difficulty_step": 5}}}}}}
+    s = DeepSpeedDataSampler(cfg, 50, 2, 0, 1, metric_values={"m": metric})
+    for _ in range(5):
+        s.get_next_global_batch()
+    state = s.state_dict()
+    nxt = s.get_next_global_batch()
+    s2 = DeepSpeedDataSampler(cfg, 50, 2, 0, 1, metric_values={"m": metric})
+    s2.load_state_dict(state)
+    np.testing.assert_array_equal(s2.get_next_global_batch(), nxt)
+
+
+def test_curriculum_sampler_small_pool_fills_batch():
+    """Eligible pool smaller than the global batch must resample to keep
+    batch size fixed (train_batch_size contract)."""
+    metric = np.arange(100)
+    cfg = {"data_sampling": {"curriculum_learning": {
+        "enabled": True,
+        "metrics": {"m": {"min_difficulty": 2, "max_difficulty": 100,
+                          "schedule_type": "fixed_linear",
+                          "difficulty_type": "value",
+                          "schedule_config": {"total_curriculum_step": 50,
+                                              "difficulty_step": 2}}}}}}
+    s = DeepSpeedDataSampler(cfg, 100, micro_batch_size=4,
+                             data_parallel_rank=0, data_parallel_size=2,
+                             gradient_accumulation_steps=1,
+                             metric_values={"m": metric})
+    batch = s.get_next_global_batch()
+    assert len(batch) == 8  # 4 * 2, despite only ~3 eligible samples
+    assert batch.max() <= 2
+    # iteration path: each yielded micro-batch has exactly micro_batch ids
+    s2 = DeepSpeedDataSampler(cfg, 100, micro_batch_size=1,
+                              data_parallel_rank=0, data_parallel_size=1,
+                              gradient_accumulation_steps=2,
+                              metric_values={"m": metric})
+    micro = next(iter(s2))
+    assert len(micro) == 1
+
+
+def test_random_ltd_gather_scatter():
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    layer = RandomLayerTokenDrop(lambda p, t: t + 100.0)
+    out = layer(None, x, keep=3, rng=jax.random.PRNGKey(0))
+    changed = np.asarray((out != x).any(axis=(0, 2)))
+    assert changed.sum() == 3  # exactly `keep` token positions processed
+    sub, idx = random_ltd_gather(x, 3, jax.random.PRNGKey(0))
+    assert sub.shape == (2, 3, 4)
+    np.testing.assert_array_equal(np.asarray(idx), np.sort(np.asarray(idx)))
+
+
+def test_random_ltd_scheduler():
+    s = RandomLTDScheduler({"random_ltd": {
+        "random_ltd_schedule": {
+            "min_value": 16, "max_value": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"require_steps": 100, "seq_per_step": 16}}}})
+    assert s.update_seq(0) == 16
+    assert s.update_seq(100) == 64
+    mid = s.update_seq(50)
+    assert 16 <= mid <= 64 and mid % 16 == 0
+    st = s.state_dict()
+    s2 = RandomLTDScheduler({"min_value": 16, "max_value": 64})
+    s2.load_state_dict(st)
+    assert s2.get_current_seq() == mid
+
+
+def test_engine_curriculum_seqlen(devices8):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"fsdp": -1},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen",
+            "min_difficulty": 8, "max_difficulty": 16,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+    }
+    engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+    tok = jax.random.randint(jax.random.PRNGKey(0), (8, 17), 0, 512)
+    batch = (tok[:, :-1], tok[:, 1:])
+    l0 = float(engine.train_batch(batch))
+    assert engine._curriculum_seqlen == 8  # truncated early batch
+    for _ in range(5):
+        l = float(engine.train_batch(batch))
+    assert engine._curriculum_seqlen == 16  # saturated to max
+    assert np.isfinite([l0, l]).all()
