@@ -31,10 +31,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 # Canonical axis order: outermost (slowest-varying, crosses DCN first) to
 # innermost (fastest-varying, rides ICI). Pipeline crosses slices cheaply
 # because p2p volume is small; fsdp/tp want the fastest links.
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+#
+# ``zps`` (ZeRO param-shard subgroup) subdivides the sharded-DP dimension
+# for ZeRO++ hpZ (`zero_hpz_partition_size`, reference zero/config.py:41)
+# and MiCS sub-cluster sharding (reference zero/mics.py:64): total sharded
+# DP degree = fsdp × zps, with zps innermost so the param all-gathers it
+# carries ride the fastest ICI links while fsdp spans nodes/slices.
+AXIS_ORDER = ("pp", "dp", "fsdp", "zps", "ep", "sp", "tp")
 
 # Axes along which *data* (the batch) is split.
-BATCH_AXES = ("dp", "fsdp")
+BATCH_AXES = ("dp", "fsdp", "zps")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +51,7 @@ class TopologyConfig:
     pp: int = 1
     dp: int = 1
     fsdp: int = -1
+    zps: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1
@@ -92,7 +99,7 @@ class MeshTopology:
 
     @property
     def data_parallel_size(self) -> int:
-        return self.sizes["dp"] * self.sizes["fsdp"]
+        return self.sizes["dp"] * self.sizes["fsdp"] * self.sizes["zps"]
 
     @property
     def model_parallel_size(self) -> int:

@@ -71,6 +71,8 @@ class ZeroConfig(DeepSpeedConfigModel):
     zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition
     zero_quantized_weights: bool = False  # ZeRO++ qwZ
     zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    mics_shard_size: int = -1  # MiCS sub-cluster size (ref zero/config.py)
+    mics_hierarchical_params_gather: bool = False
     round_robin_gradients: bool = False
     ignore_unused_parameters: bool = True
 
@@ -98,10 +100,13 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
 
 
 class MeshConfig(DeepSpeedConfigModel):
-    """TPU-specific: degrees for each mesh axis; fsdp=-1 absorbs the rest."""
+    """TPU-specific: degrees for each mesh axis; fsdp=-1 absorbs the rest.
+    ``zps`` (ZeRO++ hpZ / MiCS shard subgroup) is normally derived from
+    zero_hpz_partition_size / mics_shard_size, not set directly."""
     pp: int = 1
     dp: int = 1
     fsdp: int = -1
+    zps: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1

@@ -101,6 +101,13 @@ class DeepSpeedDataSampler:
                     else np.arange(self.total))
             eligible = np.sort(base[:self.global_batch])
         self._cluster = eligible
+        # seeded shuffle-and-walk: one permutation per (re)build, consumed
+        # in sequential windows so every eligible sample is visited once
+        # before any repeats (reference data_sampler shuffle semantics)
+        self._shuffles = getattr(self, "_shuffles", 0) + 1
+        rng = np.random.default_rng(self.seed + self._shuffles)
+        self._perm = rng.permutation(self._cluster)
+        self._cursor = 0
 
     # -- iteration ------------------------------------------------------
     def get_next_global_batch(self) -> np.ndarray:
@@ -113,10 +120,20 @@ class DeepSpeedDataSampler:
                 changed = True
         if self._cluster is None or changed:
             self._rebuild_cluster()
-        rng = np.random.default_rng(self.seed + step)
-        pick = rng.choice(len(self._cluster), size=self.global_batch,
-                          replace=len(self._cluster) < self.global_batch)
-        batch = self._cluster[pick]
+        out = []
+        need = self.global_batch
+        while need > 0:
+            take = min(need, len(self._perm) - self._cursor)
+            out.append(self._perm[self._cursor:self._cursor + take])
+            self._cursor += take
+            need -= take
+            if self._cursor >= len(self._perm):
+                # walked the whole cluster: reshuffle for the next pass
+                self._shuffles += 1
+                rng = np.random.default_rng(self.seed + self._shuffles)
+                self._perm = rng.permutation(self._cluster)
+                self._cursor = 0
+        batch = np.concatenate(out)
         self.consumed_samples += self.global_batch
         return batch
 
@@ -139,6 +156,8 @@ class DeepSpeedDataSampler:
     def state_dict(self) -> dict[str, Any]:
         return {
             "consumed_samples": self.consumed_samples,
+            "shuffles": getattr(self, "_shuffles", 0),
+            "cursor": getattr(self, "_cursor", 0),
             "curriculum_states": {m: s.get_state()
                                   for m, s in self.schedulers.items()},
         }
@@ -148,7 +167,11 @@ class DeepSpeedDataSampler:
         for m, s in state.get("curriculum_states", {}).items():
             if m in self.schedulers:
                 self.schedulers[m].set_state(s)
-        self._prev_difficulties = {
-            m: s.get_current_difficulty()
-            for m, s in self.schedulers.items()}
-        self._cluster = None
+        # replay difficulties as of the restored step, then rebuild the
+        # identical seeded permutation and cursor position
+        step = self.consumed_samples // max(self.global_batch, 1)
+        for metric, sched in self.schedulers.items():
+            self._prev_difficulties[metric] = sched.update_difficulty(step)
+        self._shuffles = state.get("shuffles", 1) - 1
+        self._rebuild_cluster()
+        self._cursor = state.get("cursor", 0)

@@ -72,8 +72,23 @@ class DeepSpeedEngine:
 
         # --- mesh/topology (reference: _configure_distributed_model) ----
         mesh_cfg = self.config.mesh
+        zcfg0 = self.config.zero_optimization
+        # ZeRO++ hpZ / MiCS: carve the shard subgroup out of fsdp as the
+        # inner zps axis (see ZeroShardingPlan docstring)
+        zps = mesh_cfg.zps
+        sub = max(zcfg0.zero_hpz_partition_size,
+                  zcfg0.mics_shard_size if zcfg0.mics_shard_size > 1 else 1)
+        if sub > 1 and zps == 1:
+            zps = sub
+            if mesh_cfg.fsdp not in (-1, 1):
+                if mesh_cfg.fsdp % sub != 0:
+                    raise ValueError(
+                        f"mesh.fsdp={mesh_cfg.fsdp} is not divisible by "
+                        f"zero_hpz_partition_size/mics_shard_size={sub}")
+                mesh_cfg = mesh_cfg.model_copy(
+                    update={"fsdp": mesh_cfg.fsdp // sub})
         self.topology = MeshTopology(TopologyConfig(
-            pp=mesh_cfg.pp, dp=mesh_cfg.dp, fsdp=mesh_cfg.fsdp,
+            pp=mesh_cfg.pp, dp=mesh_cfg.dp, fsdp=mesh_cfg.fsdp, zps=zps,
             ep=mesh_cfg.ep, sp=mesh_cfg.sp, tp=mesh_cfg.tp))
         set_topology(self.topology)
         self.mesh = self.topology.mesh
@@ -126,7 +141,9 @@ class DeepSpeedEngine:
         self.plan = ZeroShardingPlan(
             self.zero_stage, self.mesh, rules, abstract,
             offload_optimizer=zcfg.offload_optimizer.device == "cpu",
-            pipeline=self._is_pipeline)
+            pipeline=self._is_pipeline,
+            hpz=zcfg.zero_hpz_partition_size > 1,
+            mics=zcfg.mics_shard_size > 1)
         self._build_state_shardings(abstract)
 
         # NVMe tier keeps master+moments off-device entirely (host RAM /
@@ -366,6 +383,26 @@ class DeepSpeedEngine:
         unless offload_param device=cpu)."""
         return fetch_to_device(params, self.state_shardings["params"])
 
+    def _make_grad_fn(self, micro_loss):
+        """value_and_grad, or the ZeRO++ explicit-collective version when
+        qwZ/qgZ are enabled (runtime/zeropp.py)."""
+        zcfg = self.config.zero_optimization
+        qw, qg = zcfg.zero_quantized_weights, zcfg.zero_quantized_gradients
+        if not (qw or qg):
+            return jax.value_and_grad(micro_loss, has_aux=True)
+        from .zeropp import (quantized_value_and_grad,
+                             supports_quantized_collectives)
+        if not supports_quantized_collectives(self.mesh):
+            logger.warning(
+                "zero_quantized_weights/gradients requested but the mesh "
+                "has tp/sp/pp/ep axes; falling back to XLA's full-precision "
+                "collectives (ZeRO++ is a sharded-DP feature)")
+            return jax.value_and_grad(micro_loss, has_aux=True)
+        return quantized_value_and_grad(
+            micro_loss, self.mesh, self.plan.param_specs,
+            self.plan.grad_specs, self.topology.batch_axes(),
+            quantize_weights=qw, quantize_gradients=qg)
+
     def _build_train_step(self):
         ga = self._scan_ga or self.gradient_accumulation_steps_
         clip = self.config.gradient_clipping
@@ -393,7 +430,7 @@ class DeepSpeedEngine:
             loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
-        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+        grad_fn = self._make_grad_fn(micro_loss)
 
         def train_step(state, batch):
             params = fetch(state["params"], shardings["params"])
@@ -497,7 +534,7 @@ class DeepSpeedEngine:
             loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
-        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+        grad_fn = self._make_grad_fn(micro_loss)
 
         def grads_step(state, batch):
             params = state["params"]

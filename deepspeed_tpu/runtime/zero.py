@@ -32,12 +32,17 @@ PyTree = Any
 
 
 def overlay_axis(spec_tree: PyTree, tree: PyTree, mesh: Mesh,
-                 axis: str = "fsdp", min_size: int = 2 ** 11) -> PyTree:
-    """Add `axis` sharding to each leaf's largest still-unsharded divisible
-    dim (ZeRO's 1/N partitioning; composes with existing tp dims)."""
+                 axis: str | tuple[str, ...] = "fsdp",
+                 min_size: int = 2 ** 11) -> PyTree:
+    """Add `axis` sharding (a mesh axis name or tuple of names, e.g.
+    ``("fsdp", "zps")`` for hpZ-split meshes) to each leaf's largest
+    still-unsharded divisible dim (ZeRO's 1/N partitioning; composes with
+    existing tp dims)."""
     import jax
 
-    n = mesh.shape.get(axis, 1)
+    new_axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    new_axes = tuple(a for a in new_axes if mesh.shape.get(a, 1) > 1)
+    n = int(np.prod([mesh.shape[a] for a in new_axes])) if new_axes else 1
 
     def fix(spec, leaf):
         shape = np.shape(leaf)
@@ -45,7 +50,7 @@ def overlay_axis(spec_tree: PyTree, tree: PyTree, mesh: Mesh,
             return spec
         flat_axes = [a for e in spec if e is not None
                      for a in (e if isinstance(e, tuple) else (e,))]
-        if axis in flat_axes:
+        if any(a in flat_axes for a in new_axes):
             return spec
         spec_l = list(spec) + [None] * (len(shape) - len(spec))
         candidates = [d for d in range(len(shape))
@@ -53,7 +58,7 @@ def overlay_axis(spec_tree: PyTree, tree: PyTree, mesh: Mesh,
         if not candidates:
             return spec
         best = max(candidates, key=lambda d: shape[d])
-        spec_l[best] = axis
+        spec_l[best] = new_axes if len(new_axes) > 1 else new_axes[0]
         return PartitionSpec(*spec_l)
 
     return jax.tree.map(fix, spec_tree, tree,
@@ -103,10 +108,27 @@ class ZeroShardingPlan:
     so the same regexes match). When the mesh has a pipeline axis, layer
     stacks are pinned to it first (dim 0), then ZeRO overlays fsdp on the
     remaining dims.
+
+    **ZeRO++ hpZ** (``hpz=True``, reference ``partition_parameters.py:1664``
+    ``_partition_param_sec`` + ``zero/config.py:41``): the mesh's sharded-DP
+    dimension is split fsdp×zps; gradients/master/optimizer state shard over
+    both (full 1/N memory), while *parameters* shard only over the inner
+    ``zps`` subgroup and replicate across ``fsdp`` — forward/backward weight
+    all-gathers ride the fast intra-group links, the reference's secondary
+    intra-node partition.
+
+    **MiCS** (``mics=True``, reference ``zero/mics.py:64 MiCS_Init``):
+    everything — params, grads, optimizer state — shards only within the
+    ``zps`` sub-cluster and replicates across ``fsdp``. Gradients then need
+    summing across the replica groups: because grad specs carry only
+    ``zps``, XLA emits reduce-scatter within the sub-cluster plus all-reduce
+    across clusters — exactly MiCS's hierarchical gradient comm
+    (``mics.py:362 MiCS_Optimizer``).
     """
 
     def __init__(self, stage: int, mesh: Mesh, rules, params: PyTree,
-                 offload_optimizer: bool = False, pipeline: bool = False):
+                 offload_optimizer: bool = False, pipeline: bool = False,
+                 hpz: bool = False, mics: bool = False):
         if stage not in (0, 1, 2, 3):
             raise ValueError(f"ZeRO stage must be 0-3, got {stage}")
         self.stage = stage
@@ -114,14 +136,27 @@ class ZeroShardingPlan:
         self.rules = rules
         self.offload_optimizer = offload_optimizer
         self.pipeline = pipeline and mesh.shape.get("pp", 1) > 1
+        has_zps = mesh.shape.get("zps", 1) > 1
+        if (hpz or mics) and not has_zps:
+            raise ValueError(
+                "hpZ/MiCS need the mesh's zps axis > 1 (set "
+                "zero_hpz_partition_size / mics_shard_size in the config)")
+        self.hpz = hpz
+        self.mics = mics
+        # full sharded-DP extent vs the inner subgroup only
+        full = ("fsdp", "zps") if has_zps else "fsdp"
+        inner = "zps" if has_zps else "fsdp"
+        param_axes = inner if (hpz or mics) else full
+        state_axes = inner if mics else full
 
         base = self._base_specs(params)
-        self.param_specs = (overlay_axis(base, params, mesh)
+        self.param_specs = (overlay_axis(base, params, mesh, axis=param_axes)
                             if stage >= 3 else base)
-        self.grad_specs = (overlay_axis(base, params, mesh)
+        self.grad_specs = (overlay_axis(base, params, mesh, axis=state_axes)
                            if stage >= 2 else self.param_specs)
-        self.master_specs = (overlay_axis(base, params, mesh)
+        self.master_specs = (overlay_axis(base, params, mesh, axis=state_axes)
                              if stage >= 1 else self.param_specs)
+        self._state_axes = state_axes
 
     def _base_specs(self, tree: PyTree) -> PyTree:
         base = filter_spec_for_mesh(match_rules(self.rules, tree), self.mesh, tree)
@@ -133,7 +168,8 @@ class ZeroShardingPlan:
         """Specs for an arbitrary tree (e.g. optax state) whose leaf paths
         embed parameter paths."""
         base = self._base_specs(tree)
-        return overlay_axis(base, tree, self.mesh) if sharded else base
+        return (overlay_axis(base, tree, self.mesh, axis=self._state_axes)
+                if sharded else base)
 
     def opt_specs(self, opt_state: PyTree) -> PyTree:
         return self.spec_for_tree(opt_state, sharded=self.stage >= 1)

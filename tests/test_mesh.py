@@ -11,7 +11,8 @@ from deepspeed_tpu.parallel.partition import (
 
 def test_topology_resolve_auto_fsdp():
     sizes = TopologyConfig().resolve(8)
-    assert sizes == {"pp": 1, "dp": 1, "fsdp": 8, "ep": 1, "sp": 1, "tp": 1}
+    assert sizes == {"pp": 1, "dp": 1, "fsdp": 8, "zps": 1, "ep": 1,
+                     "sp": 1, "tp": 1}
 
 
 def test_topology_mixed_axes():

@@ -1,0 +1,156 @@
+"""ZeRO++ (hpZ/qwZ/qgZ) and MiCS (reference: runtime/zero/mics.py,
+partition_parameters.py:1664 hpZ, coalesced_collectives.py:31 qgZ)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2
+
+
+def make_batch(key, vocab=512, batch=16, seq=16):
+    tokens = jax.random.randint(key, (batch, seq + 1), 0, vocab)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 100,
+        "mesh": {"fsdp": -1},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(engine, n=3, seed=0):
+    losses = []
+    for _ in range(n):
+        batch = make_batch(jax.random.PRNGKey(seed))
+        losses.append(float(engine.train_batch(batch)))
+    return losses
+
+
+def _flat_axes(spec):
+    return {a for e in spec if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))}
+
+
+def baseline_losses():
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=base_config(zero_optimization={"stage": 3}))
+    losses = run_steps(engine)
+    from deepspeed_tpu.parallel import mesh
+    mesh.reset_topology()
+    return losses
+
+
+def test_hpz_secondary_partition(devices8):
+    """hpZ: params shard only over the zps subgroup (replicated across
+    fsdp); grads/master keep the full fsdp×zps shard."""
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=base_config(zero_optimization={
+            "stage": 3, "zero_hpz_partition_size": 4}))
+    topo = engine.topology
+    assert topo.sizes["zps"] == 4 and topo.sizes["fsdp"] == 2
+    param_axes = set().union(*(
+        _flat_axes(s) for s in jax.tree.leaves(
+            engine.plan.param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))))
+    assert "fsdp" not in param_axes          # secondary shard: zps only
+    master_axes = set().union(*(
+        _flat_axes(s) for s in jax.tree.leaves(
+            engine.plan.master_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))))
+    assert {"fsdp", "zps"} <= master_axes    # primary shard: full extent
+    losses = run_steps(engine)
+    assert losses[-1] < losses[0]
+
+
+def test_mics_matches_zero3(devices8):
+    """MiCS shards everything within the sub-cluster only; math must match
+    plain ZeRO-3 (reference: mics shards state, not semantics)."""
+    ref = baseline_losses()
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=base_config(zero_optimization={
+            "stage": 3, "mics_shard_size": 4}))
+    assert engine.topology.sizes["zps"] == 4
+    opt_axes = set().union(*(
+        _flat_axes(s) for s in jax.tree.leaves(
+            engine.plan.master_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))))
+    assert "fsdp" not in opt_axes            # state replicated across clusters
+    losses = run_steps(engine)
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_qgz_quantized_gradients_close_to_exact(devices8):
+    """qgZ: int8 gradient reduce-scatter trains close to the exact path
+    (block-wise int8 on already-averaged grads: loose tolerance)."""
+    ref = baseline_losses()
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=base_config(zero_optimization={
+            "stage": 3, "zero_quantized_gradients": True}))
+    losses = run_steps(engine)
+    np.testing.assert_allclose(losses, ref, rtol=5e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_qwz_quantized_weights_close_to_exact(devices8):
+    ref = baseline_losses()
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=base_config(zero_optimization={
+            "stage": 3, "zero_quantized_weights": True,
+            "zero_quantized_gradients": True}))
+    losses = run_steps(engine)
+    np.testing.assert_allclose(losses, ref, rtol=5e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_quantized_collectives_roundtrip(devices8):
+    """quantized all-gather + reduce-scatter against exact collectives."""
+    from jax import shard_map
+    from jax.sharding import Mesh
+    from deepspeed_tpu.runtime import zeropp
+
+    mesh = Mesh(np.array(devices8).reshape(8), ("fsdp",))
+    x = jax.random.normal(jax.random.PRNGKey(0), (8 * 2048,))
+
+    def gather_body(xl):
+        return zeropp.quantized_all_gather(xl, ("fsdp",), 0)
+
+    g = shard_map(gather_body, mesh=mesh,
+                  in_specs=PartitionSpec("fsdp"),
+                  out_specs=PartitionSpec("fsdp"), check_vma=False)(x)
+    # each shard gathers the full x then keeps its slice -> x itself
+    np.testing.assert_allclose(np.asarray(g[:2048]), np.asarray(x[:2048]),
+                               rtol=2e-2, atol=2e-2)
+
+    def rs_body(xl):
+        return zeropp.quantized_reduce_scatter(xl, ("fsdp",), 0)
+
+    # reduce-scatter of a replicated array = 8 * its shard
+    r = shard_map(rs_body, mesh=mesh,
+                  in_specs=PartitionSpec(),
+                  out_specs=PartitionSpec("fsdp"), check_vma=False)(x)
+    np.testing.assert_allclose(np.asarray(r), 8 * np.asarray(x),
+                               rtol=2e-2, atol=2e-1)
+
+    # chunk size NOT a multiple of QBLOCK: blocks must not straddle chunks
+    y = jax.random.normal(jax.random.PRNGKey(1), (8 * 768,))
+    r = shard_map(rs_body, mesh=mesh,
+                  in_specs=PartitionSpec(),
+                  out_specs=PartitionSpec("fsdp"), check_vma=False)(y)
+    np.testing.assert_allclose(np.asarray(r), 8 * np.asarray(y),
+                               rtol=2e-2, atol=2e-1)
