@@ -98,14 +98,21 @@ def dequantize_int8(q, s, meta, use_pallas: bool | None = None):
     return x.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
-def quantized_all_gather(x, *, group, comm):
-    """ZeRO++ qwZ-style: quantize, all-gather int8 + scales, dequantize.
-    `comm` is the deepspeed_tpu.comm module (inside shard_map)."""
+def quantized_all_gather(x, axes, dim: int = 0):
+    """ZeRO++ qwZ: quantize the local shard, all-gather int8 + scales along
+    mesh ``axes``, dequantize, and reassemble on ``dim``. Must run inside
+    shard_map (reference: partition_parameters.py:761 CUDAQuantizer
+    bracketing the param all-gather)."""
+    from jax import lax
+
     q, s, meta = quantize_int8(x, use_pallas=False)  # inside shard_map: jnp
-    qg = comm.all_gather(q, group=group, axis=0, tiled=False)
-    sg = comm.all_gather(s, group=group, axis=0, tiled=False)
-    shape, dtype, n = meta
-    def deq(args):
-        qq, ss = args
-        return dequantize_int8(qq, ss, meta, use_pallas=False)
-    return jax.vmap(deq)((qg, sg))
+    qg = lax.all_gather(q, axes, axis=0, tiled=False)
+    sg = lax.all_gather(s, axes, axis=0, tiled=False)
+    pieces = jax.vmap(
+        lambda qq, ss: dequantize_int8(qq, ss, meta, use_pallas=False)
+    )(qg, sg)                                   # [world, *local_shape]
+    world = pieces.shape[0]
+    out = jnp.moveaxis(pieces, 0, dim)          # [..., world, shard, ...]
+    shape = list(x.shape)
+    shape[dim] = world * x.shape[dim]
+    return out.reshape(shape)

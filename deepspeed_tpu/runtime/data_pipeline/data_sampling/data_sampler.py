@@ -172,6 +172,6 @@ class DeepSpeedDataSampler:
         step = self.consumed_samples // max(self.global_batch, 1)
         for metric, sched in self.schedulers.items():
             self._prev_difficulties[metric] = sched.update_difficulty(step)
-        self._shuffles = state.get("shuffles", 1) - 1
+        self._shuffles = max(state.get("shuffles", 1), 1) - 1
         self._rebuild_cluster()
         self._cursor = state.get("cursor", 0)

@@ -76,6 +76,10 @@ class DeepSpeedEngine:
         # ZeRO++ hpZ / MiCS: carve the shard subgroup out of fsdp as the
         # inner zps axis (see ZeroShardingPlan docstring)
         zps = mesh_cfg.zps
+        if zcfg0.zero_hpz_partition_size > 1 and zcfg0.mics_shard_size > 1:
+            raise ValueError(
+                "zero_hpz_partition_size and mics_shard_size are mutually "
+                "exclusive sharding modes; set only one")
         sub = max(zcfg0.zero_hpz_partition_size,
                   zcfg0.mics_shard_size if zcfg0.mics_shard_size > 1 else 1)
         if sub > 1 and zps == 1:

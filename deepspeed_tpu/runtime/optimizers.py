@@ -111,20 +111,24 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
         return optax.adagrad(lr_schedule, eps=eps)
     if name == ADAFACTOR_OPTIMIZER:
         return optax.adafactor(lr_schedule)
-    if name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER,
-                ONEBIT_LAMB_OPTIMIZER):
-        # Error-compensated 1-bit communication exists to save gradient
-        # allreduce bandwidth on Ethernet clusters (reference
-        # runtime/fp16/onebit/). On a TPU mesh, gradient reduction rides
-        # ICI inside the compiled step, so the compression trades accuracy
-        # for nothing; map to the uncompressed math.
-        from ..utils.logging import warning_once
-        warning_once(
-            f"{opt_type} requested: using uncompressed Adam/Lamb math — "
-            "gradient reduction on TPU rides ICI inside the XLA graph")
-        if name == ONEBIT_LAMB_OPTIMIZER:
-            return optax.lamb(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
-                              weight_decay=wd)
-        return optax.adamw(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
-                           weight_decay=wd)
+    if name == ONEBIT_ADAM_OPTIMIZER:
+        from .onebit import onebit_adam
+        return onebit_adam(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
+                           weight_decay=wd,
+                           freeze_step=int(p.pop("freeze_step", 100000)))
+    if name == ZERO_ONE_ADAM_OPTIMIZER:
+        from .onebit import zero_one_adam
+        return zero_one_adam(
+            lr_schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+            var_freeze_step=int(p.pop("var_freeze_step", 100000)),
+            var_update_scaler=int(p.pop("var_update_scaler", 16)),
+            local_step_scaler=int(p.pop("local_step_scaler", 32678)),
+            local_step_clipper=int(p.pop("local_step_clipper", 16)))
+    if name == ONEBIT_LAMB_OPTIMIZER:
+        from .onebit import onebit_lamb
+        return onebit_lamb(
+            lr_schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
+            freeze_step=int(p.pop("freeze_step", 100000)),
+            max_coeff=float(p.pop("max_coeff", 10.0)),
+            min_coeff=float(p.pop("min_coeff", 0.01)))
     raise AssertionError(name)

@@ -41,7 +41,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
-from ..ops.pallas.quantization import dequantize_int8, quantize_int8
+from ..ops.pallas.quantization import (quantize_int8, quantized_all_gather)
 
 PyTree = Any
 
@@ -60,19 +60,6 @@ def _sharded_dims(spec: PartitionSpec) -> list[tuple[int, tuple[str, ...]]]:
         axes = entry if isinstance(entry, tuple) else (entry,)
         out.append((d, tuple(axes)))
     return out
-
-
-def quantized_all_gather(x: jax.Array, axes: tuple[str, ...],
-                         dim: int) -> jax.Array:
-    """qwZ: int8 all-gather of `x` (a local shard) along mesh `axes`,
-    reassembled on `dim`. Must run inside shard_map."""
-    q, s, meta = quantize_int8(x, use_pallas=False)
-    qg = lax.all_gather(q, axes, axis=0, tiled=False)
-    sg = lax.all_gather(s, axes, axis=0, tiled=False)
-    world = qg.shape[0]
-    pieces = [dequantize_int8(qg[i], sg[i], meta, use_pallas=False)
-              for i in range(world)]
-    return jnp.concatenate(pieces, axis=dim)
 
 
 def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
