@@ -45,6 +45,18 @@ def initialize(args=None,
             "yet in this checkout") from e
 
     config = config if config is not None else config_params
+    from .runtime.config import DeepSpeedConfig as _Cfg
+    cfg = _Cfg.from_any(config)
+    config = cfg  # parsed once; downstream constructors accept it as-is
+    if cfg.hybrid_engine.enabled and not isinstance(model, PipelineModule):
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine = DeepSpeedHybridEngine(
+            args=args, model=model, optimizer=optimizer,
+            model_parameters=model_parameters, training_data=training_data,
+            lr_scheduler=lr_scheduler, mpu=mpu, config=cfg,
+            collate_fn=collate_fn, mesh_param=mesh_param)
+        return (engine, engine.optimizer, engine.training_dataloader,
+                engine.lr_scheduler)
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine
         engine = PipelineEngine(

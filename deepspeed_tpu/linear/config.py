@@ -1,0 +1,37 @@
+"""Configs for the optimized-linear subsystem (reference:
+deepspeed/linear/config.py LoRAConfig/QuantizationConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """reference: linear/config.py:10.
+
+    lora_r: adapter rank; lora_alpha: scaling (effective scale alpha/r).
+    base_weight_sharding: degree to which frozen base weights shard over
+    the fsdp axis (TPU: a PartitionSpec concern, kept for config parity).
+    offload/offload_ratio: place frozen base weights in host memory.
+    target_mods: module-name substrings LoRA applies to.
+    """
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: List[str] = dataclasses.field(
+        default_factory=lambda: ["q_proj", "k_proj", "v_proj", "o_proj",
+                                 "gate_proj", "up_proj", "down_proj"])
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """reference: linear/config.py:37. q_bits in {4, 6, 8}; group_size is
+    elements per quantization block."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512

@@ -1,0 +1,237 @@
+"""OptimizedLinear — LoRA over (optionally quantized) frozen base weights
+(reference: deepspeed/linear/optimized_linear.py OptimizedLinear /
+LoRAOptimizedLinear).
+
+The reference subclasses nn.Module and swaps itself into HF models; the
+TPU build is functional: ``OptimizedLinear`` owns an init/apply pair whose
+parameter tree separates the frozen base (``base``, possibly a
+``QuantizedParameter``) from the trainable adapters (``lora_a/lora_b``),
+and ``lora_transform`` applies the same split to an existing model
+parameter tree by path regex — the analogue of the reference walking
+``target_mods``. Only adapter leaves receive gradients; the base is
+treated as a constant (``lax.stop_gradient``), so the optimizer state for
+frozen weights simply doesn't exist — the memory win the reference gets
+from `requires_grad=False`."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .config import LoRAConfig, QuantizationConfig
+from .quantization import (QuantizedParameter, dequantize_tree, is_quantized,
+                           quantize_param)
+
+PyTree = Any
+
+
+class OptimizedLinear:
+    """y = x @ W_base(frozen, maybe quantized) + (x @ A) @ B * alpha/r
+    (reference: optimized_linear.py:20)."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 lora_config: LoRAConfig | None = None,
+                 quantization_config: QuantizationConfig | None = None,
+                 bias: bool = False, dtype=jnp.float32):
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.lora = lora_config or LoRAConfig()
+        self.quant = quantization_config
+        self.bias = bias
+        self.dtype = dtype
+
+    def init(self, key: jax.Array, base_weight: jax.Array | None = None):
+        kw, ka = jax.random.split(key)
+        if base_weight is None:
+            base_weight = jax.random.normal(
+                kw, (self.input_dim, self.output_dim),
+                self.dtype) / jnp.sqrt(self.input_dim)
+        base = (quantize_param(base_weight, self.quant)
+                if self.quant is not None else base_weight)
+        r = self.lora.lora_r
+        params = {
+            "base": base,
+            "lora_a": jax.random.normal(
+                ka, (self.input_dim, r), self.dtype) / jnp.sqrt(r),
+            "lora_b": jnp.zeros((r, self.output_dim), self.dtype),
+        }
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,), self.dtype)
+        return params
+
+    def apply(self, params, x):
+        w = params["base"]
+        if is_quantized(w):
+            w = w.dequantized()
+        w = jax.lax.stop_gradient(w)
+        y = x @ w.astype(x.dtype)
+        scale = self.lora.lora_alpha / self.lora.lora_r
+        y = y + (x @ params["lora_a"].astype(x.dtype)) \
+            @ params["lora_b"].astype(x.dtype) * scale
+        if self.bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+    def __call__(self, params, x):
+        return self.apply(params, x)
+
+
+@dataclasses.dataclass
+class LoRAState:
+    """Adapter params + the transform back to effective weights."""
+    adapters: PyTree            # {path: {"a":..., "b":...}}
+    lora_config: LoRAConfig
+
+
+def _target_paths(params: PyTree, cfg: LoRAConfig) -> list[str]:
+    from ..parallel.partition import _path_str
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            params, is_leaf=is_quantized):
+        name = _path_str(path)
+        if getattr(leaf, "ndim", 0) in (2, 3) and \
+                any(t in name for t in cfg.target_mods):
+            out.append(name)
+    return out
+
+
+def lora_transform(params: PyTree, lora_config: LoRAConfig | None = None,
+                   quantization_config: QuantizationConfig | None = None,
+                   key: jax.Array | None = None,
+                   target_regex: str | None = None
+                   ) -> tuple[PyTree, LoRAState, Callable]:
+    """Split a model tree into (frozen_base, adapters, merge_fn).
+
+    - frozen base: targeted 2-D weights, optionally quantized
+    - adapters: fresh {a, b} pairs per targeted weight (b zero-init, so
+      merge(base, adapters) == original model at step 0)
+    - merge_fn(base, adapters) -> effective params for the model's apply;
+      gradients flow only into adapters (base is stop_gradient'ed).
+
+    reference: optimized_linear.py LoRAOptimizedLinear weight path +
+    hybrid_engine.py:132 fuse/unfuse used for RLHF.
+    """
+    cfg = lora_config or LoRAConfig()
+    key = key if key is not None else jax.random.PRNGKey(0)
+    from ..parallel.partition import _path_str
+
+    targets = (set(_target_paths(params, cfg)) if target_regex is None
+               else None)
+
+    def is_target(name):
+        if target_regex is not None:
+            return re.search(target_regex, name) is not None
+        return name in targets
+
+    leaves = jax.tree_util.tree_leaves_with_path(params,
+                                                 is_leaf=is_quantized)
+    adapters = {}
+    keys = jax.random.split(key, max(len(leaves), 1))
+
+    def freeze(path, leaf, k):
+        name = _path_str(path)
+        # 2-D weights, or 3-D layer-stacked weights [L, in, out] (the
+        # scan-over-layers layout the models use)
+        if leaf.ndim in (2, 3) and is_target(name):
+            *stack, fan_in, fan_out = leaf.shape
+            dtype = leaf.dtype
+            adapters[name] = {
+                "a": (jax.random.normal(
+                    k, (*stack, fan_in, cfg.lora_r), dtype)
+                    / jnp.sqrt(cfg.lora_r)),
+                "b": jnp.zeros((*stack, cfg.lora_r, fan_out), dtype),
+            }
+            if is_quantized(leaf):
+                return leaf  # already quantized; keep as-is
+            return (quantize_param(leaf, quantization_config)
+                    if quantization_config is not None else leaf)
+        return leaf
+
+    frozen = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(params, is_leaf=is_quantized),
+        [freeze(p, l, k) for (p, l), k in zip(leaves, keys)])
+
+    merge = make_merge_fn(cfg, stop_gradient=True)
+    return frozen, LoRAState(adapters, cfg), merge
+
+
+def make_merge_fn(cfg: LoRAConfig, stop_gradient: bool = True) -> Callable:
+    """merge(base, adapters) -> effective params (dequant base + a@b
+    deltas); usable inside jit. With stop_gradient, grads flow only into
+    the adapters."""
+    from ..parallel.partition import _path_str
+    scale = cfg.lora_alpha / cfg.lora_r
+
+    def merge(base: PyTree, adapters: PyTree) -> PyTree:
+        def one(path, leaf):
+            name = _path_str(path)
+            if is_quantized(leaf):
+                leaf = leaf.dequantized()
+            if stop_gradient:
+                leaf = jax.lax.stop_gradient(leaf)
+            ad = adapters.get(name) if isinstance(adapters, dict) else None
+            if ad is not None:
+                # batched matmul covers both [in,r]@[r,out] and
+                # layer-stacked [L,in,r]@[L,r,out]
+                leaf = leaf + (ad["a"] @ ad["b"]).astype(leaf.dtype) * scale
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(one, base,
+                                                is_leaf=is_quantized)
+
+    return merge
+
+
+def fuse_lora(base: PyTree, state: LoRAState) -> PyTree:
+    """Materialize adapters into the base weights (reference:
+    hybrid_engine.py:132 _fuse_lora before generation)."""
+    return make_merge_fn(state.lora_config, stop_gradient=False)(
+        base, state.adapters)
+
+
+class LoRAModel:
+    """Wrap a Model so the *adapters* are the trainable parameter tree and
+    the base stays frozen/quantized — plug this into
+    ``deepspeed_tpu.initialize`` and the engine optimizes LoRA weights
+    only (the TPU analogue of the reference marking base weights
+    ``requires_grad=False`` in LoRAOptimizedLinear)."""
+
+    def __init__(self, module, lora_config: LoRAConfig | None = None,
+                 quantization_config: QuantizationConfig | None = None,
+                 target_regex: str | None = None, seed: int = 0):
+        self.module = module
+        self.config = getattr(module, "config", None)
+        base_params = module.init(jax.random.PRNGKey(seed))
+        self.frozen, self.lora_state, self.merge = lora_transform(
+            base_params, lora_config, quantization_config,
+            key=jax.random.PRNGKey(seed + 1), target_regex=target_regex)
+
+    def init(self, rng):
+        del rng  # adapters were initialized in lora_transform
+        return self.lora_state.adapters
+
+    def effective_params(self, adapters):
+        return self.merge(self.frozen, adapters)
+
+    def loss(self, adapters, batch, **kw):
+        return self.module.loss(self.effective_params(adapters), batch, **kw)
+
+    def partition_rules(self):
+        # adapters are small; replicate them (base sharding is carried by
+        # the frozen tree's own placement)
+        return []
+
+    def init_cache(self, *a, **kw):
+        return self.module.init_cache(*a, **kw)
+
+    def decode(self, adapters, tokens, cache):
+        return self.module.decode(self.effective_params(adapters), tokens,
+                                  cache)
+
+    def flops_per_token(self, *a, **kw):
+        return self.module.flops_per_token(*a, **kw) \
+            if hasattr(self.module, "flops_per_token") else None

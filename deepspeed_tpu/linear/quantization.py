@@ -1,0 +1,81 @@
+"""Quantized frozen parameters (reference: deepspeed/linear/quantization.py
+QuantizedParameter + csrc/fp_quantizer — FP6/INT8 weight storage with
+on-the-fly dequantization).
+
+A ``QuantizedParameter`` is a pytree-registered container of int8 codes +
+per-block scales. It lives inside a parameter tree like a regular leaf
+pair and dequantizes inside jit right before the matmul — XLA fuses the
+dequant into the GEMM prologue, which is the TPU counterpart of the
+reference's fused dequant kernels (fp_quantize.cu selective dequant)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .config import QuantizationConfig
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedParameter:
+    """int8/intX block-quantized tensor (reference: quantization.py:27)."""
+
+    codes: jax.Array          # int8 [nblocks, group_size]
+    scales: jax.Array         # f32  [nblocks, 1]
+    shape: tuple = ()         # original shape (static)
+    dtype: Any = jnp.float32  # original dtype (static)
+    q_bits: int = 8           # static
+
+    def tree_flatten(self):
+        return (self.codes, self.scales), (self.shape, self.dtype,
+                                           self.q_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        codes, scales = children
+        return cls(codes, scales, *aux)
+
+    def dequantized(self) -> jax.Array:
+        """reference: QuantizedParameter.dequantized()"""
+        import math
+        x = self.codes.astype(jnp.float32) * self.scales
+        n = math.prod(self.shape) if self.shape else 1
+        return x.reshape(-1)[:n].reshape(self.shape).astype(self.dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+
+def quantize_param(x: jax.Array,
+                   cfg: QuantizationConfig | None = None
+                   ) -> QuantizedParameter:
+    """Symmetric block quantization at cfg.q_bits (8/6/4)."""
+    cfg = cfg or QuantizationConfig()
+    if cfg.q_bits not in (4, 6, 8):
+        raise ValueError(f"q_bits must be 4, 6 or 8, got {cfg.q_bits}")
+    qmax = 2 ** (cfg.q_bits - 1) - 1
+    g = cfg.group_size
+    n = x.size
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, (-n) % g))
+    blocks = flat.reshape(-1, g)
+    amax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+    scales = jnp.maximum(amax / qmax, 1e-12)
+    codes = jnp.clip(jnp.round(blocks / scales), -qmax, qmax).astype(jnp.int8)
+    return QuantizedParameter(codes, scales, tuple(x.shape), x.dtype,
+                              cfg.q_bits)
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, QuantizedParameter)
+
+
+def dequantize_tree(tree: Any) -> Any:
+    """Replace every QuantizedParameter leaf with its dequantized array."""
+    return jax.tree.map(
+        lambda x: x.dequantized() if is_quantized(x) else x,
+        tree, is_leaf=is_quantized)

@@ -99,6 +99,19 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     policy: str = "nothing_saveable"
 
 
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """reference: deepspeed/runtime/config.py hybrid_engine block
+    (DeepSpeedHybridEngineConfig: enabled, max_out_tokens,
+    inference_tp_size, release_inference_cache, pin_parameters,
+    tp_gather_partition_size)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-specific: degrees for each mesh axis; fsdp=-1 absorbs the rest.
     ``zps`` (ZeRO++ hpZ / MiCS shard subgroup) is normally derived from
@@ -242,6 +255,8 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     aio: AIOConfig = Field(default_factory=AIOConfig)
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    hybrid_engine: HybridEngineConfig = Field(
+        default_factory=HybridEngineConfig)
 
     @classmethod
     def from_any(cls, config: "str | dict | DeepSpeedConfig | None") -> "DeepSpeedConfig":

@@ -1,0 +1,137 @@
+"""Hybrid engine — RLHF train<->generate (reference:
+runtime/hybrid_engine.py DeepSpeedHybridEngine:30).
+
+The reference swaps a ZeRO-3 training module's layers into injected
+inference containers before each ``generate`` (gathering partitioned
+params, :357 _zero3_forward), fusing LoRA weights in and out (:132-146).
+On TPU none of that swapping exists as runtime work: ``generate`` is a
+second jit of the *same* functional model over the *same* sharded training
+state — XLA gathers ZeRO-3 shards inside the compiled decode exactly as it
+does in the training step, and LoRA "fusing" is the adapter merge already
+inside the model's apply (linear/optimized_linear.py LoRAModel). What
+remains — and is implemented here — is the engine surface: a cached
+compiled prefill+decode loop sharing the live training params, latency
+bookkeeping, and the fuse/unfuse hooks as cheap no-ops."""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .engine import DeepSpeedEngine
+
+PyTree = Any
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """reference: runtime/hybrid_engine.py:30"""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if not hasattr(self.module, "decode") or \
+                not hasattr(self.module, "init_cache"):
+            raise ValueError(
+                "hybrid engine needs a model with decode()/init_cache() "
+                "(DecoderLM or LoRAModel)")
+        self._generate_fns: dict = {}
+        self._max_out = self.config.hybrid_engine.max_out_tokens
+        # latency stats (reference: _generate_latency/_training_latency)
+        self._generate_latency = 0.0
+        self._generate_count = 0
+        self.is_in_generate = False
+
+    # --- LoRA fuse/unfuse (reference: :132 _fuse_lora / :146 _unfuse) ---
+    def fuse_lora_weight(self):
+        """No-op: LoRAModel merges adapters inside the compiled apply, so
+        generation always sees fused weights."""
+
+    def unfuse_lora_weight(self):
+        """No-op: training grads only ever flow to adapters."""
+
+    # --- generation ----------------------------------------------------
+    def _build_generate(self, prompt_len: int, max_new: int, greedy: bool,
+                        temperature: float, top_k: int):
+        model = self.module
+        cache_len = prompt_len + max_new
+        if cache_len > self._max_out:
+            raise ValueError(
+                f"prompt+max_new_tokens ({cache_len}) exceeds "
+                f"hybrid_engine.max_out_tokens ({self._max_out})")
+        dtype = self.compute_dtype
+
+        def sample(logits, key):
+            logits = logits.astype(jnp.float32)
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if temperature != 1.0:
+                logits = logits / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(
+                jnp.int32)
+
+        def generate(params, tokens, key):
+            b = tokens.shape[0]
+            cache = model.init_cache(b, cache_len, dtype=dtype)
+            logits, cache = model.decode(params, tokens, cache)  # prefill
+            key, sub = jax.random.split(key)
+            nxt = sample(logits[:, -1, :], sub)
+
+            def body(carry, _):
+                cache, tok, key = carry
+                logits, cache = model.decode(params, tok[:, None], cache)
+                key, sub = jax.random.split(key)
+                return (cache, sample(logits[:, -1, :], sub), key), tok
+
+            (_, last, _), toks = jax.lax.scan(
+                body, (cache, nxt, key), None, length=max_new - 1)
+            out = jnp.concatenate([toks.T, last[:, None]], axis=1)
+            return jnp.concatenate([tokens, out], axis=1)
+
+        return jax.jit(generate)
+
+    def generate(self, tokens, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, seed: int | None = None, **kwargs):
+        """Generate with the live training weights (reference:
+        hybrid_engine.py:168 generate). Without an explicit seed each call
+        draws a fresh key, so repeated sampled rollouts differ."""
+        if seed is None:
+            seed = self._generate_count + 1_000_003 * (self.global_steps + 1)
+        tokens = jnp.asarray(tokens)
+        if tokens.ndim == 1:
+            tokens = tokens[None, :]
+        sig = (tokens.shape[1], max_new_tokens, not do_sample, temperature,
+               top_k)
+        if sig not in self._generate_fns:
+            self._generate_fns[sig] = self._build_generate(
+                tokens.shape[1], max_new_tokens, greedy=not do_sample,
+                temperature=temperature, top_k=top_k)
+        self.is_in_generate = True
+        t0 = time.time()
+        try:
+            out = self._generate_fns[sig](self.state["params"], tokens,
+                                          jax.random.PRNGKey(seed))
+            out.block_until_ready()
+        finally:
+            self.is_in_generate = False
+        self._generate_latency += time.time() - t0
+        self._generate_count += 1
+        return out
+
+    def generate_latency(self) -> float:
+        """Mean seconds per generate call (reference latency stats,
+        hybrid_engine.py wall-clock accounting)."""
+        return (self._generate_latency / self._generate_count
+                if self._generate_count else 0.0)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        return self

@@ -114,6 +114,7 @@ class ZeroOneAdamState(NamedTuple):
     error: optax.Updates
     var_interval: chex.Array   # current variance-update interval
     var_counter: chex.Array    # steps since last variance update
+    var_refreshes: chex.Array  # total variance refreshes so far
     lr_frozen: chex.Array      # learning rate held between refreshes
     lr_counter: chex.Array     # steps since last lr refresh
 
@@ -149,6 +150,7 @@ def zero_one_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
         return ZeroOneAdamState(jnp.zeros((), jnp.int32), z(), z(), z(),
                                 jnp.ones((), jnp.int32),
                                 jnp.zeros((), jnp.int32),
+                                jnp.zeros((), jnp.int32),
                                 jnp.asarray(lr0, jnp.float32),
                                 jnp.zeros((), jnp.int32))
 
@@ -171,12 +173,11 @@ def zero_one_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
         nu = jax.tree.map(
             lambda v, g: jnp.where(due, b2 * v + (1 - b2) * g * g, v),
             state.nu, grads)
-        # interval doubles every var_update_scaler refreshes, clipped
-        grew = due & (count % max(var_update_scaler, 1) == 0)
-        var_interval = jnp.where(
-            grew, jnp.minimum(state.var_interval * 2,
-                              max(local_step_clipper, 1)),
-            state.var_interval)
+        # interval doubles after every var_update_scaler variance
+        # refreshes (reference zoadam.py:270-274; uncapped)
+        var_refreshes = state.var_refreshes + jnp.where(due, 1, 0)
+        exp = jnp.minimum(var_refreshes // max(var_update_scaler, 1), 30)
+        var_interval = jnp.where(due, 2 ** exp, state.var_interval)
         var_counter = jnp.where(due, 0, var_counter)
 
         bc2 = 1 - b2 ** jnp.maximum(count, 1).astype(jnp.float32)
@@ -200,6 +201,7 @@ def zero_one_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
                 jnp.zeros_like, comp))
         return updates, ZeroOneAdamState(count, mu, nu, new_error,
                                          var_interval, var_counter,
+                                         var_refreshes,
                                          lr.astype(jnp.float32), lr_counter)
 
     return optax.GradientTransformation(init, update)

@@ -1,0 +1,142 @@
+"""Debug APIs over sharded optimizer state (reference:
+deepspeed/utils/tensor_fragment.py safe_get/set_full_fp32_param /
+safe_get_full_grad / safe_get/set_full_optimizer_state, :132-243).
+
+The reference maintains an lp-param -> flat-hp-partition fragment mapping
+(``get_hp_fragment_mapping`` :312) because ZeRO flattens and slices
+tensors by byte ranges. On TPU the "fragment mapping" is the
+``NamedSharding`` on each state leaf, and gathering a full tensor is just
+``jax.device_get`` of a globally-addressable array — so these helpers
+reduce to path lookups into ``engine.state`` plus resharding on set.
+
+Params are addressed by their '/'-joined pytree path (the same names the
+partition-rule tables use), e.g. ``"layers/attn/q_proj/kernel"``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def _flat_with_paths(tree: PyTree) -> dict[str, Any]:
+    from ..parallel.partition import _path_str
+    return {_path_str(p): leaf
+            for p, leaf in jax.tree_util.tree_leaves_with_path(tree)}
+
+
+def _lookup(tree: PyTree, name: str) -> Optional[Any]:
+    if tree is None:
+        return None
+    flat = _flat_with_paths(tree)
+    if name in flat:
+        return flat[name]
+    # suffix match lets users pass the param path when the tree nests it
+    # under optax state prefixes (e.g. "0/mu/<param path>")
+    hits = [v for k, v in flat.items()
+            if k.endswith("/" + name) or k == name]
+    return hits[0] if len(hits) == 1 else None
+
+
+def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
+    """Full fp32 master weight (reference: tensor_fragment.py:193)."""
+    src = engine.state["master"] if engine.state.get("master") is not None \
+        else engine.state["params"]
+    leaf = _lookup(src, name)
+    if leaf is None:
+        return None
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, name: str, value) -> bool:
+    """Overwrite a master weight (and its bf16/fp16 shadow) in place
+    (reference: tensor_fragment.py:212 safe_set_full_fp32_param)."""
+    from ..parallel.partition import _path_str
+
+    value = jnp.asarray(value)
+
+    def replace(tree):
+        if tree is None:
+            return None, False
+        matches = [k for k in _flat_with_paths(tree)
+                   if k == name or k.endswith("/" + name)]
+        if len(matches) != 1:
+            return tree, False  # ambiguous or absent: refuse, like the getter
+        target = matches[0]
+
+        def one(path, leaf):
+            if _path_str(path) != target:
+                return leaf
+            if leaf.shape != value.shape:
+                raise ValueError(
+                    f"shape mismatch for {name}: {leaf.shape} vs "
+                    f"{value.shape}")
+            return jax.device_put(value.astype(leaf.dtype), leaf.sharding)
+
+        return jax.tree_util.tree_map_with_path(one, tree), True
+
+    hit = False
+    if engine.state.get("master") is not None:
+        engine.state["master"], h = replace(engine.state["master"])
+        hit |= h
+    engine.state["params"], h = replace(engine.state["params"])
+    return hit or h
+
+
+def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
+    """Accumulated gradient for a param, if a forward/backward pair is in
+    flight (reference: tensor_fragment.py:132 safe_get_full_grad; grads
+    inside the compiled fast path are fused away — use the
+    forward()/backward() API to observe them)."""
+    grads = getattr(engine, "_accum_grads", None)
+    leaf = _lookup(grads, name)
+    if leaf is None:
+        return None
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_full_optimizer_state(engine, name: str,
+                                  state_key: str) -> Optional[np.ndarray]:
+    """Optimizer moment for a param; ``state_key`` follows the reference's
+    torch names ("exp_avg"/"exp_avg_sq") or optax's ("mu"/"nu")
+    (reference: tensor_fragment.py:160)."""
+    key = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_key, state_key)
+    flat = _flat_with_paths(engine.state["opt_state"])
+    hits = [v for k, v in flat.items()
+            if f"/{key}/" in f"/{k}/" and
+            (k.endswith("/" + name) or name in k)]
+    if len(hits) != 1:
+        return None
+    return np.asarray(jax.device_get(hits[0]), dtype=np.float32)
+
+
+def safe_set_full_optimizer_state(engine, name: str, state_key: str,
+                                  value) -> bool:
+    """reference: tensor_fragment.py:227 safe_set_full_optimizer_state."""
+    from ..parallel.partition import _path_str
+    key = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_key, state_key)
+    value = jnp.asarray(value)
+    flat = _flat_with_paths(engine.state["opt_state"])
+    matches = [k for k, v in flat.items()
+               if f"/{key}/" in f"/{k}/" and
+               (k.endswith("/" + name) or name in k)]
+    if len(matches) != 1:
+        return False  # ambiguous or absent: refuse, like the getter
+
+    def one(path, leaf):
+        if _path_str(path) != matches[0]:
+            return leaf
+        if getattr(leaf, "shape", None) != value.shape:
+            raise ValueError(
+                f"shape mismatch for {name}.{state_key}: {leaf.shape} vs "
+                f"{value.shape}")
+        return jax.device_put(value.astype(leaf.dtype), leaf.sharding)
+
+    engine.state["opt_state"] = jax.tree_util.tree_map_with_path(
+        one, engine.state["opt_state"])
+    return True

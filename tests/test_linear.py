@@ -1,0 +1,101 @@
+"""Optimized linear / LoRA / quantized params (reference:
+deepspeed/linear/, tests/unit/linear/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                  QuantizationConfig, QuantizedParameter,
+                                  dequantize_tree, fuse_lora, lora_transform,
+                                  quantize_param)
+
+
+def test_quantized_param_roundtrip():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 48))
+    for bits, tol in [(8, 2e-2), (6, 7e-2), (4, 3e-1)]:
+        qp = quantize_param(x, QuantizationConfig(q_bits=bits))
+        err = float(jnp.max(jnp.abs(qp.dequantized() - x)))
+        assert err < tol, (bits, err)
+        assert qp.codes.dtype == jnp.int8
+
+
+def test_quantized_param_is_pytree_leaf_pair():
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 32))
+    qp = quantize_param(x)
+    leaves = jax.tree.leaves(qp)
+    assert len(leaves) == 2  # codes + scales travel through jit
+    out = jax.jit(lambda q: q.dequantized())(qp)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(qp.dequantized()))
+
+
+def test_optimized_linear_zero_init_matches_base():
+    lin = OptimizedLinear(16, 8, LoRAConfig(lora_r=4),
+                          QuantizationConfig(q_bits=8))
+    params = lin.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+    y = lin.apply(params, x)
+    # lora_b starts at zero: output equals the quantized base matmul
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x @ params["base"].dequantized()),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_optimized_linear_grads_only_adapters():
+    lin = OptimizedLinear(16, 8, LoRAConfig(lora_r=4))
+    params = lin.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+    g = jax.grad(lambda p: jnp.sum(lin.apply(p, x) ** 2))(params)
+    assert float(jnp.abs(g["base"]).max()) == 0.0      # frozen
+    # zero-init b blocks grad to a; b itself sees gradient immediately
+    assert float(jnp.abs(g["lora_b"]).max()) > 0.0
+
+
+def test_lora_transform_and_fuse():
+    params = {
+        "layers": {
+            "q_proj": {"kernel": jax.random.normal(
+                jax.random.PRNGKey(0), (32, 32))},
+            "ln": {"scale": jnp.ones((32,))},
+        }
+    }
+    frozen, state, merge = lora_transform(
+        params, LoRAConfig(lora_r=4, target_mods=["q_proj"]),
+        QuantizationConfig(q_bits=8), key=jax.random.PRNGKey(1))
+    assert len(state.adapters) == 1
+    assert isinstance(frozen["layers"]["q_proj"]["kernel"],
+                      QuantizedParameter)
+    # zero-init b: merged == dequantized original
+    eff = merge(frozen, state.adapters)
+    np.testing.assert_allclose(
+        np.asarray(eff["layers"]["q_proj"]["kernel"]),
+        np.asarray(frozen["layers"]["q_proj"]["kernel"].dequantized()))
+    # train only the adapters on a toy objective
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 32))
+
+    def loss(adapters):
+        p = merge(frozen, adapters)
+        return jnp.sum((x @ p["layers"]["q_proj"]["kernel"]) ** 2)
+
+    g = jax.grad(loss)(state.adapters)
+    name = next(iter(state.adapters))
+    assert float(jnp.abs(g[name]["b"]).max()) > 0
+    # fuse returns a plain tree with the adapter delta baked in
+    adapters = jax.tree.map(lambda a: a + 1e-2, state.adapters)
+    state2 = type(state)(adapters, state.lora_config)
+    fused = fuse_lora(frozen, state2)
+    assert not isinstance(fused["layers"]["q_proj"]["kernel"],
+                          QuantizedParameter)
+    delta = np.asarray(fused["layers"]["q_proj"]["kernel"]) - \
+        np.asarray(eff["layers"]["q_proj"]["kernel"])
+    assert np.abs(delta).max() > 0
+
+
+def test_dequantize_tree():
+    tree = {"a": quantize_param(jnp.ones((16, 16))), "b": jnp.zeros((3,))}
+    out = dequantize_tree(tree)
+    np.testing.assert_allclose(np.asarray(out["a"]), np.ones((16, 16)),
+                               rtol=1e-3)
+    assert out["b"].shape == (3,)

@@ -1,0 +1,77 @@
+"""safe_get/set debug APIs (reference: utils/tensor_fragment.py:132-243,
+tested in tests/unit/runtime/zero/test_zero_tensor_fragment.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2
+from deepspeed_tpu.utils.tensor_fragment import (
+    safe_get_full_fp32_param, safe_get_full_grad,
+    safe_get_full_optimizer_state, safe_set_full_fp32_param,
+    safe_set_full_optimizer_state)
+
+
+def make_engine(devices8, stage=3, dtype_cfg=None):
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 100,
+        "mesh": {"fsdp": -1},
+        "zero_optimization": {"stage": stage},
+    }
+    cfg.update(dtype_cfg or {})
+    engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+    return engine
+
+
+def batch():
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (16, 17), 0, 512)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def some_param_name(engine):
+    from deepspeed_tpu.parallel.partition import _path_str
+    paths = [
+        _path_str(p) for p, leaf in
+        jax.tree_util.tree_leaves_with_path(engine.state["params"])
+        if getattr(leaf, "ndim", 0) == 2]
+    return paths[0]
+
+
+def test_get_set_full_fp32_param(devices8):
+    engine = make_engine(devices8, dtype_cfg={"bf16": {"enabled": True}})
+    engine.train_batch(batch())
+    name = some_param_name(engine)
+    w = safe_get_full_fp32_param(engine, name)
+    assert w is not None and w.dtype == np.float32
+    new = np.zeros_like(w)
+    assert safe_set_full_fp32_param(engine, name, new)
+    got = safe_get_full_fp32_param(engine, name)
+    np.testing.assert_allclose(got, 0.0)
+
+
+def test_get_full_optimizer_state(devices8):
+    engine = make_engine(devices8)
+    engine.train_batch(batch())
+    name = some_param_name(engine)
+    m = safe_get_full_optimizer_state(engine, name, "exp_avg")
+    v = safe_get_full_optimizer_state(engine, name, "exp_avg_sq")
+    assert m is not None and v is not None
+    assert np.abs(m).max() > 0          # one step taken
+    assert safe_set_full_optimizer_state(engine, name, "exp_avg",
+                                         np.zeros_like(m))
+    m2 = safe_get_full_optimizer_state(engine, name, "exp_avg")
+    np.testing.assert_allclose(m2, 0.0)
+
+
+def test_get_full_grad_via_micro_api(devices8):
+    engine = make_engine(devices8, stage=2)
+    b = batch()
+    engine.forward(b)
+    engine.backward()
+    name = some_param_name(engine)
+    g = safe_get_full_grad(engine, name)
+    assert g is not None and np.abs(g).max() > 0
+    assert safe_get_full_grad(engine, "does/not/exist") is None
