@@ -46,17 +46,7 @@ def initialize(args=None,
 
     config = config if config is not None else config_params
     from .runtime.config import DeepSpeedConfig as _Cfg
-    cfg = _Cfg.from_any(config)
-    config = cfg  # parsed once; downstream constructors accept it as-is
-    if cfg.hybrid_engine.enabled and not isinstance(model, PipelineModule):
-        from .runtime.hybrid_engine import DeepSpeedHybridEngine
-        engine = DeepSpeedHybridEngine(
-            args=args, model=model, optimizer=optimizer,
-            model_parameters=model_parameters, training_data=training_data,
-            lr_scheduler=lr_scheduler, mpu=mpu, config=cfg,
-            collate_fn=collate_fn, mesh_param=mesh_param)
-        return (engine, engine.optimizer, engine.training_dataloader,
-                engine.lr_scheduler)
+    config = _Cfg.from_any(config)  # parsed once; constructors accept it
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine
         engine = PipelineEngine(
@@ -64,7 +54,11 @@ def initialize(args=None,
             training_data=training_data, lr_scheduler=lr_scheduler,
             collate_fn=collate_fn, mpu=mpu or model.topology(), args=args)
     else:
-        engine = DeepSpeedEngine(
+        engine_cls = DeepSpeedEngine
+        if config.hybrid_engine.enabled:
+            from .runtime.hybrid_engine import DeepSpeedHybridEngine
+            engine_cls = DeepSpeedHybridEngine
+        engine = engine_cls(
             args=args, model=model, optimizer=optimizer,
             model_parameters=model_parameters, training_data=training_data,
             lr_scheduler=lr_scheduler, mpu=mpu, config=config,

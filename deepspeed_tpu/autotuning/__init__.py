@@ -1,0 +1,7 @@
+"""Autotuning (reference: deepspeed/autotuning/)."""
+
+from .autotuner import (Autotuner, ResourceManager,  # noqa: F401
+                        memory_per_device, model_info_profile)
+from .config import AutotuningConfig  # noqa: F401
+from .tuner import (BaseTuner, GridSearchTuner, ModelBasedTuner,  # noqa: F401
+                    RandomTuner)

@@ -1,0 +1,206 @@
+"""Autotuner (reference: deepspeed/autotuning/autotuner.py Autotuner:42).
+
+Discovers the ZeRO stage + micro-batch configuration with the best
+measured metric. The reference profiles the model (:663
+_generate_experiments model_info), prunes ZeRO stages by a memory
+estimate, generates a config grid, launches each experiment through the
+launcher, and picks the best. The TPU port keeps the same pipeline but
+runs each trial *in-process*: build the engine, run a few compiled steps,
+measure — no process launches, because a jit-compiled trial is hermetic
+(state is rebuilt per trial, and XLA compilation is the honest setup cost
+either way).
+
+Memory model (reference: autotuner.py get_instantiation_memory_required_
+per_module Z0-Z3): with P params, dtype size b, world size N, optimizer
+states in fp32 (Adam: master + 2 moments = 12-16 bytes/param):
+  stage 0: M = 2P(b) + 16P            (grads + states replicated)
+  stage 1: M = 2P(b) + 2P(b) + 16P/N  (states sharded)
+  stage 2: M = 2P(b) + (2P + 16P)/N   (grads too)
+  stage 3: M = (2P + 2P + 16P)/N      (params too)
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+from .config import (METRIC_FLOPS, METRIC_LATENCY, METRIC_THROUGHPUT,
+                     TUNER_GRIDSEARCH, TUNER_MODELBASED, TUNER_RANDOM,
+                     AutotuningConfig)
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+ADAM_STATE_BYTES = 16  # fp32 master + 2 fp32 moments per param
+OVERHEAD = 1.3         # activation/fragmentation headroom factor
+
+
+def model_info_profile(model) -> dict[str, Any]:
+    """Parameter count + per-dtype size (reference: autotuner.py:663
+    model_info_profile runs a profiling experiment; here eval_shape is
+    free)."""
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    num_params = int(sum(np.prod(l.shape)
+                         for l in jax.tree.leaves(abstract)))
+    return {"num_params": num_params}
+
+
+def memory_per_device(num_params: int, stage: int, world: int,
+                      bytes_per_el: int = 2) -> int:
+    """Bytes/device for a ZeRO stage (see module docstring table)."""
+    p, b, n = num_params, bytes_per_el, max(world, 1)
+    if stage == 0:
+        return p * b + p * b + ADAM_STATE_BYTES * p
+    if stage == 1:
+        return p * b + p * b + ADAM_STATE_BYTES * p // n
+    if stage == 2:
+        return p * b + (p * b + ADAM_STATE_BYTES * p) // n
+    return (p * b + p * b + ADAM_STATE_BYTES * p) // n
+
+
+class ResourceManager:
+    """Runs experiments and records results (reference:
+    autotuning/scheduler.py ResourceManager — there it schedules launcher
+    jobs over nodes; here trials run sequentially in-process)."""
+
+    def __init__(self, run_trial: Callable[[dict], float],
+                 results_dir: Optional[str] = None):
+        self.run_trial = run_trial
+        self.results_dir = results_dir
+        self.results: list[dict] = []
+
+    def run(self, exp: dict) -> float:
+        t0 = time.time()
+        try:
+            val = self.run_trial(exp)
+            err = None
+        except Exception as e:  # OOM / invalid combos score -inf
+            val, err = -float("inf"), str(e)[:200]
+        rec = {"exp": exp, "metric_val": val, "wall_s": time.time() - t0,
+               "error": err}
+        self.results.append(rec)
+        if self.results_dir:
+            os.makedirs(self.results_dir, exist_ok=True)
+            with open(os.path.join(self.results_dir, "results.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        return val
+
+
+class Autotuner:
+    """reference: autotuner.py:42. ``tune()`` returns the best config
+    dict (ds-config shaped) and its measured metric."""
+
+    def __init__(self, model, base_config: dict,
+                 tuning_config: AutotuningConfig | None = None,
+                 device_memory_bytes: int | None = None,
+                 make_batch: Callable[[int], Any] | None = None):
+        self.model = model
+        self.base_config = dict(base_config)
+        self.cfg = tuning_config or AutotuningConfig(
+            **base_config.get("autotuning", {}))
+        self.model_info = model_info_profile(model)
+        self.world = len(jax.devices())
+        self.device_memory = device_memory_bytes or self._detect_memory()
+        self.make_batch = make_batch
+        self.rm: ResourceManager | None = None
+
+    def _detect_memory(self) -> int:
+        d = jax.devices()[0]
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+        return 16 * 2 ** 30  # v5p-ish default when the backend won't say
+
+    # -- experiment generation (reference: _generate_experiments) --------
+    def feasible_stages(self) -> list[int]:
+        if self.cfg.zero_stages:
+            return sorted(set(self.cfg.zero_stages))
+        p = self.model_info["num_params"]
+        out = [s for s in (0, 1, 2, 3)
+               if memory_per_device(p, s, self.world) * OVERHEAD
+               < self.device_memory]
+        return out or [3]
+
+    def candidate_micro_batches(self) -> list[int]:
+        lo = max(self.cfg.min_train_micro_batch_size_per_gpu, 1)
+        hi = self.cfg.max_train_micro_batch_size_per_gpu or lo * 2 ** (
+            self.cfg.num_tuning_micro_batch_sizes - 1)
+        out = []
+        mb = lo
+        while mb <= hi:
+            out.append(mb)
+            mb *= 2
+        return out[: self.cfg.num_tuning_micro_batch_sizes] or [lo]
+
+    def generate_experiments(self) -> list[dict]:
+        exps = []
+        for stage, mb in itertools.product(self.feasible_stages(),
+                                           self.candidate_micro_batches()):
+            tb = mb * self.world
+            if self.cfg.max_train_batch_size and \
+                    tb > self.cfg.max_train_batch_size:
+                continue
+            exp = json.loads(json.dumps(self.base_config))  # deep copy
+            exp.pop("autotuning", None)
+            exp.setdefault("zero_optimization", {})["stage"] = stage
+            exp["train_micro_batch_size_per_gpu"] = mb
+            exp.pop("train_batch_size", None)
+            exp["gradient_accumulation_steps"] = \
+                self.base_config.get("gradient_accumulation_steps", 1)
+            exps.append(exp)
+        return exps
+
+    # -- trial execution -------------------------------------------------
+    def _run_trial(self, exp: dict) -> float:
+        import deepspeed_tpu as ds
+        from ..parallel import mesh as mesh_mod
+
+        mesh_mod.reset_topology()
+        engine, _, _, _ = ds.initialize(model=self.model, config=exp)
+        mb = engine.train_micro_batch_size_per_gpu()
+        if self.make_batch is None:
+            raise ValueError("autotuner needs make_batch(total_batch)")
+        batch = self.make_batch(engine.train_batch_size_)
+        for _ in range(self.cfg.start_step):   # warmup incl. compile
+            engine.train_batch(batch)
+        t0 = time.time()
+        steps = max(self.cfg.end_step - self.cfg.start_step, 1)
+        for _ in range(steps):
+            engine.train_batch(batch)
+        jax.block_until_ready(engine.state["params"])
+        dt = (time.time() - t0) / steps
+        samples_per_s = engine.train_batch_size_ / dt
+        if self.cfg.metric == METRIC_LATENCY:
+            return -dt
+        if self.cfg.metric == METRIC_FLOPS:
+            fps = engine._flops_per_sample()
+            return samples_per_s * (fps or 1)
+        return samples_per_s
+
+    def tune(self) -> tuple[dict | None, float]:
+        """reference: autotuner.py tune() — returns (best_config, metric)."""
+        exps = self.generate_experiments()
+        if not exps:
+            return None, -float("inf")
+        tuner_cls = {TUNER_GRIDSEARCH: GridSearchTuner,
+                     TUNER_RANDOM: RandomTuner,
+                     TUNER_MODELBASED: ModelBasedTuner}[self.cfg.tuner_type]
+        tuner = tuner_cls(exps, metric=self.cfg.metric)
+        self.rm = ResourceManager(self._run_trial,
+                                  results_dir=self.cfg.results_dir
+                                  if not self.cfg.fast else None)
+        best = tuner.tune(self.rm.run, sample_size=1,
+                          n_trials=self.cfg.tuner_num_trials,
+                          early_stopping=self.cfg.tuner_early_stopping)
+        logger.info(
+            f"autotuner: best metric {tuner.best_metric_val:.3f} "
+            f"({self.cfg.metric}) with "
+            f"stage={best and best['zero_optimization']['stage']} "
+            f"mb={best and best['train_micro_batch_size_per_gpu']}")
+        return best, tuner.best_metric_val

@@ -1,0 +1,40 @@
+"""Autotuning config (reference: deepspeed/autotuning/config.py
+DeepSpeedAutotuningConfig + constants.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+# metrics (reference: constants.py AUTOTUNING_METRIC_*)
+METRIC_THROUGHPUT = "throughput"
+METRIC_LATENCY = "latency"
+METRIC_FLOPS = "flops"
+
+TUNER_GRIDSEARCH = "gridsearch"
+TUNER_RANDOM = "random"
+TUNER_MODELBASED = "model_based"
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    metric: str = METRIC_THROUGHPUT
+    start_step: int = 1          # steps to skip before measuring (warmup)
+    end_step: int = 4            # measured steps per trial
+    tuner_type: str = TUNER_GRIDSEARCH
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    min_train_batch_size: int = 1
+    max_train_micro_batch_size_per_gpu: Optional[int] = None
+    min_train_micro_batch_size_per_gpu: int = 1
+    num_tuning_micro_batch_sizes: int = 3
+    zero_stages: Optional[list[int]] = None  # None = try all feasible
+    overwrite: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    arg_mappings: dict[str, Any] = Field(default_factory=dict)

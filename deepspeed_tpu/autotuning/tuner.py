@@ -1,0 +1,114 @@
+"""Experiment-selection strategies (reference:
+deepspeed/autotuning/tuner/{base_tuner,index_based_tuner,
+model_based_tuner,cost_model}.py).
+
+A tuner consumes a list of candidate experiment configs and proposes the
+order to evaluate them; the model-based tuner fits a cheap cost model on
+observed results to pick the most promising next candidate (the
+reference uses XGBoost in cost_model.py; here a quadratic least-squares
+fit over (stage, log2 micro-batch) features — no extra deps, same role).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable
+
+import numpy as np
+
+
+class BaseTuner:
+    """reference: tuner/base_tuner.py:14"""
+
+    def __init__(self, exps: list[dict], metric: str = "throughput"):
+        self.all_exps = list(exps)
+        self.metric = metric
+        self.best_exp: dict | None = None
+        self.best_metric_val: float = -float("inf")
+        self.records: list[tuple[dict, float]] = []
+
+    def next_batch(self, sample_size: int) -> list[dict]:
+        raise NotImplementedError
+
+    def update(self, exp: dict, metric_val: float) -> None:
+        self.records.append((exp, metric_val))
+        if metric_val > self.best_metric_val:
+            self.best_metric_val = metric_val
+            self.best_exp = exp
+
+    def tune(self, run_fn: Callable[[dict], float], sample_size: int = 1,
+             n_trials: int = 50, early_stopping: int = 0) -> dict | None:
+        """reference: base_tuner.py tune() — sequential trial loop with
+        early stopping on no-improvement streaks."""
+        stale = 0
+        trials = 0
+        while trials < n_trials:
+            batch = self.next_batch(sample_size)
+            if not batch:
+                break
+            for exp in batch:
+                val = run_fn(exp)
+                trials += 1
+                improved = val > self.best_metric_val
+                self.update(exp, val)
+                stale = 0 if improved else stale + 1
+                if early_stopping and stale >= early_stopping:
+                    return self.best_exp
+        return self.best_exp
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive in order (reference: index_based_tuner.py GridSearchTuner)."""
+
+    def __init__(self, exps, metric="throughput"):
+        super().__init__(exps, metric)
+        self._queue = list(self.all_exps)
+
+    def next_batch(self, sample_size):
+        batch, self._queue = (self._queue[:sample_size],
+                              self._queue[sample_size:])
+        return batch
+
+
+class RandomTuner(BaseTuner):
+    """Random order without replacement (reference: RandomTuner)."""
+
+    def __init__(self, exps, metric="throughput", seed: int = 0):
+        super().__init__(exps, metric)
+        self._queue = list(self.all_exps)
+        random.Random(seed).shuffle(self._queue)
+
+    next_batch = GridSearchTuner.next_batch
+
+
+def _features(exp: dict) -> np.ndarray:
+    z = exp.get("zero_optimization", {}).get("stage", 0)
+    mb = exp.get("train_micro_batch_size_per_gpu", 1)
+    lmb = np.log2(max(mb, 1))
+    return np.array([1.0, z, lmb, z * lmb, lmb * lmb])
+
+
+class ModelBasedTuner(BaseTuner):
+    """Fit predicted-metric model on observed trials; evaluate the
+    highest-predicted untried candidate next (reference:
+    model_based_tuner.py + cost_model.py XGBoostCostModel)."""
+
+    def __init__(self, exps, metric="throughput", warmup: int = 2, seed=0):
+        super().__init__(exps, metric)
+        self._untried = list(self.all_exps)
+        random.Random(seed).shuffle(self._untried)
+        self.warmup = warmup
+
+    def next_batch(self, sample_size):
+        out = []
+        for _ in range(min(sample_size, len(self._untried))):
+            if len(self.records) < self.warmup:
+                out.append(self._untried.pop(0))
+                continue
+            X = np.stack([_features(e) for e, _ in self.records])
+            y = np.array([v for _, v in self.records])
+            coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+            preds = [float(_features(e) @ coef) for e in self._untried]
+            idx = int(np.argmax(preds))
+            out.append(self._untried.pop(idx))
+        return out

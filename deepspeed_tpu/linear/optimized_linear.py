@@ -214,6 +214,17 @@ class LoRAModel:
         del rng  # adapters were initialized in lora_transform
         return self.lora_state.adapters
 
+    def place_frozen(self, mesh) -> None:
+        """Shard the frozen base over the mesh's fsdp axis (called by the
+        engine once the mesh exists). Without this the frozen tree would
+        ride into jit as a replicated closure constant and forfeit the
+        ZeRO-style memory win for the base weights."""
+        from ..parallel.partition import fsdp_spec_tree, named_shardings
+        specs = fsdp_spec_tree(self.frozen, mesh)  # descends into the
+        #   QuantizedParameter containers' codes/scales leaves
+        self.frozen = jax.device_put(self.frozen,
+                                     named_shardings(mesh, specs))
+
     def effective_params(self, adapters):
         return self.merge(self.frozen, adapters)
 

@@ -1,0 +1,4 @@
+"""Activation checkpointing (reference:
+deepspeed/runtime/activation_checkpointing/)."""
+
+from . import checkpointing  # noqa: F401

@@ -1,0 +1,200 @@
+"""Megatron-compatible activation checkpointing (reference:
+runtime/activation_checkpointing/checkpointing.py — ``checkpoint()``
+:946, ``CheckpointFunction`` :486, activation partitioning across MP
+ranks :375/:266, CPU checkpointing, ``CudaRNGStatesTracker`` :124).
+
+TPU translation table:
+- ``checkpoint(fn, *args)``      -> ``jax.checkpoint`` (remat): recompute
+  activations in backward instead of storing them. The reference's custom
+  autograd Function is XLA's native rematerialization.
+- ``partition_activations``      -> a sharding constraint putting saved
+  activations on the ``tp`` axis: SPMD slices the stash 1/tp per device,
+  the compiler inserts the gather in backward (the roles of
+  ``partition_activations``/``gather_partitioned_activations``).
+- ``cpu_checkpointing``          -> ``save_and_offload``-style policy:
+  saved residuals live in pinned host memory between forward and backward.
+- ``CudaRNGStatesTracker``       -> named jax PRNG streams; ``fork(name)``
+  yields a fresh subkey deterministically per (name, call) so dropout
+  inside checkpointed blocks replays identically in recompute — under
+  remat XLA replays the same key automatically, so the tracker only needs
+  determinism, not state capture/restore.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+
+_CONFIG = None  # ActivationCheckpointingConfig set by configure()
+
+# name -> jax.checkpoint policy (reference config knobs select among the
+# same memory/recompute tradeoffs)
+_POLICIES = {
+    "nothing_saveable": "nothing_saveable",
+    "dots_saveable": "dots_saveable",
+    "everything_saveable": "everything_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """reference: checkpointing.py:926 configure()."""
+    global _CONFIG
+    from ..config import ActivationCheckpointingConfig, DeepSpeedConfig
+    if deepspeed_config is not None:
+        cfg = DeepSpeedConfig.from_any(deepspeed_config)
+        _CONFIG = cfg.activation_checkpointing
+    elif _CONFIG is None:
+        _CONFIG = ActivationCheckpointingConfig()
+    if partition_activations is not None:
+        _CONFIG.partition_activations = partition_activations
+    if checkpoint_in_cpu is not None:
+        _CONFIG.cpu_checkpointing = checkpoint_in_cpu
+    if num_checkpoints is not None:
+        _CONFIG.number_checkpoints = num_checkpoints
+    if profile is not None:
+        _CONFIG.profile = profile
+
+
+def is_configured() -> bool:
+    return _CONFIG is not None
+
+
+def _policy():
+    from ..config import ActivationCheckpointingConfig
+    cfg = _CONFIG or ActivationCheckpointingConfig()
+    if cfg.cpu_checkpointing:
+        # matmul residuals offloaded to pinned host memory between forward
+        # and backward (the reference copies the saved stash to CPU)
+        try:
+            return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                "device", "pinned_host")
+        except Exception:
+            logger.warning(
+                "cpu_checkpointing: offload policy unavailable on this jax "
+                "version; falling back to full recompute")
+            return jax.checkpoint_policies.nothing_saveable
+    name = _POLICIES.get(cfg.policy, "nothing_saveable")
+    return getattr(jax.checkpoint_policies, name)
+
+
+def checkpoint(function: Callable, *args, **kwargs):
+    """Checkpoint a forward block (reference: checkpoint():946 — call in
+    place of ``function(*args)``; activations are recomputed in backward).
+    """
+    from ..config import ActivationCheckpointingConfig
+    cfg = _CONFIG or ActivationCheckpointingConfig()
+    fn = function
+    if cfg.partition_activations:
+        fn = _partition_saved(function)
+    return jax.checkpoint(fn, policy=_policy())(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    """Decorator form: ``layer = checkpoint_wrapper(layer)``."""
+
+    @functools.wraps(function)
+    def wrapped(*args, **kwargs):
+        return checkpoint(function, *args, **kwargs)
+
+    return wrapped
+
+
+def _partition_saved(function: Callable) -> Callable:
+    """Constrain the block's inputs onto the tp axis so the saved
+    residuals are sharded 1/tp per device (reference:
+    partition_activations :375; the backward gather :266 is inserted by
+    SPMD)."""
+    from ...parallel.mesh import get_topology
+
+    @functools.wraps(function)
+    def wrapped(*args, **kwargs):
+        topo = get_topology()
+        if topo.sizes.get("tp", 1) <= 1:
+            return function(*args, **kwargs)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def constrain(x):
+            if not hasattr(x, "ndim") or x.ndim < 2:
+                return x
+            # shard the second-to-last dim (sequence for [b, s, d]) —
+            # last dim is usually already tp-sharded by the model
+            spec = [None] * x.ndim
+            if x.shape[-2] % topo.sizes["tp"] == 0:
+                spec[-2] = "tp"
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(topo.mesh, PartitionSpec(*spec)))
+
+        args = jax.tree.map(constrain, args)
+        return function(*args, **kwargs)
+
+    return wrapped
+
+
+# --- RNG tracker (reference: CudaRNGStatesTracker :124) -----------------
+
+class RNGStatesTracker:
+    """Named deterministic PRNG streams for dropout inside checkpointed
+    blocks (reference: CudaRNGStatesTracker + model_parallel_cuda_manual_
+    seed :245). Keys are pure functions of (seed, name, counter), so
+    forward and recompute agree by construction."""
+
+    def __init__(self):
+        self._seeds: dict[str, int] = {}
+        self._counters: dict[str, int] = {}
+
+    def reset(self):
+        self._seeds.clear()
+        self._counters.clear()
+
+    def add(self, name: str, seed: int):
+        if name in self._seeds:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._seeds[name] = seed
+        self._counters[name] = 0
+
+    def get_states(self):
+        return dict(self._seeds), dict(self._counters)
+
+    def set_states(self, states):
+        self._seeds, self._counters = dict(states[0]), dict(states[1])
+
+    def fork(self, name: str = "model-parallel-rng") -> jax.Array:
+        """A fresh deterministic key for this stream."""
+        if name not in self._seeds:
+            raise ValueError(f"unknown rng state {name!r}")
+        self._counters[name] += 1
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self._seeds[name]), self._counters[name])
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:  # reference name parity
+    return _RNG_TRACKER
+
+
+get_rng_tracker = get_cuda_rng_tracker
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """reference: checkpointing.py:245 — seed a default model-parallel
+    stream offset by the tp coordinate so dropout differs across tp ranks
+    but is reproducible."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718)
+    _RNG_TRACKER.add("data-parallel-rng", seed)
+
+
+def reset():
+    global _CONFIG
+    _CONFIG = None
+    _RNG_TRACKER.reset()

@@ -1,0 +1,61 @@
+"""Domino — tensor parallelism with communication/compute overlap
+(reference: runtime/domino/transformer.py DominoModule:19,
+DominoTransformerLayer; the handle-dict + NoOper autograd fences :56-112).
+
+The reference splits each batch into micro-chunks so the row-parallel
+all-reduce of chunk *i* overlaps the attention/MLP compute of chunk
+*i+1*, hand-scheduling CUDA streams around NCCL handles. On TPU the
+same overlap is expressed structurally and XLA's latency-hiding
+scheduler does the interleaving: the layer processes the batch as
+``n_micro`` chunks inside one compiled region, and because each chunk's
+tp all-reduce has no data dependence on the next chunk's GEMMs, the
+scheduler hoists the collectives behind the compute — the Domino
+schedule without manual streams.
+
+``DominoTransformerLayer`` here is a functional layer usable standalone
+or as a template: given attention/mlp callables whose outputs need a tp
+all-reduce (row-parallel linears), it runs them chunk-wise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class DominoModule:
+    """Marker base (reference: domino/transformer.py:19)."""
+
+
+def _chunks(x: jax.Array, n: int):
+    return jnp.split(x, n, axis=0)
+
+
+class DominoTransformerLayer(DominoModule):
+    """reference: DominoTransformerLayer — batch-dim micro-chunking.
+
+    attn_fn/mlp_fn: (params, x) -> partial output whose tp reduction is
+    still pending; reduce_fn performs the row-parallel reduction (psum
+    over "tp" inside shard_map, or a sharding-constraint under jit).
+    """
+
+    def __init__(self, attn_fn: Callable, mlp_fn: Callable,
+                 reduce_fn: Callable | None = None, n_micro: int = 2):
+        self.attn_fn = attn_fn
+        self.mlp_fn = mlp_fn
+        self.reduce_fn = reduce_fn or (lambda x: x)
+        self.n_micro = n_micro
+
+    def __call__(self, params: PyTree, x: jax.Array) -> jax.Array:
+        n = self.n_micro if x.shape[0] % self.n_micro == 0 else 1
+        outs = []
+        for xc in _chunks(x, n):
+            # chunk i's reduce is independent of chunk i+1's compute;
+            # XLA overlaps them (the role of Domino's handle waits)
+            h = xc + self.reduce_fn(self.attn_fn(params, xc))
+            outs.append(h + self.reduce_fn(self.mlp_fn(params, h)))
+        return jnp.concatenate(outs, axis=0)

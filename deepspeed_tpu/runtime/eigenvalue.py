@@ -1,0 +1,90 @@
+"""Power-iteration eigenvalue estimation per layer (reference:
+runtime/eigenvalue.py Eigenvalue — drives MoQ's quantization-period
+scheduling, engine.py:2231).
+
+The reference runs power iteration on the Hessian-vector product via
+torch.autograd.grad(create_graph=True). JAX's forward-over-reverse
+``jvp(grad(f))`` computes the same HVP; the iteration itself is a
+``lax``-friendly python loop (few, fixed steps)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class Eigenvalue:
+    """reference: runtime/eigenvalue.py Eigenvalue(verbose, max_iter,
+    tol, stability, gas_boundary_resolution, layer_name, layer_num)."""
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def compute_eigenvalue(self, loss_fn: Callable[[PyTree], jax.Array],
+                           params: PyTree,
+                           key: jax.Array | None = None) -> float:
+        """Largest |eigenvalue| of the loss Hessian at ``params``.
+
+        ``loss_fn(params) -> scalar``; typically a closure over a batch.
+        reference: Eigenvalue.compute_eigenvalue (power iteration with
+        normalized random start).
+        """
+        key = key if key is not None else jax.random.PRNGKey(0)
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v: PyTree) -> PyTree:
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        leaves, treedef = jax.tree.flatten(params)
+        keys = jax.random.split(key, len(leaves))
+        v = treedef.unflatten([
+            jax.random.normal(k, l.shape, jnp.float32)
+            for k, l in zip(keys, leaves)])
+
+        def norm(t):
+            return jnp.sqrt(sum(jnp.vdot(x, x).real
+                                for x in jax.tree.leaves(t)))
+
+        v = jax.tree.map(lambda x: x / (norm(v) + self.stability), v)
+        prev = jnp.inf
+        eigenvalue = 0.0
+        for _ in range(self.max_iter):
+            hv = hvp(v)
+            eigenvalue = float(norm(hv))
+            v = jax.tree.map(
+                lambda x: x / (eigenvalue + self.stability), hv)
+            if abs(eigenvalue - prev) / max(abs(eigenvalue), 1e-12) \
+                    < self.tol:
+                break
+            prev = eigenvalue
+        return eigenvalue
+
+    def compute_eigenvalue_per_block(
+            self, loss_fn: Callable, params: dict,
+            key: jax.Array | None = None) -> dict[str, float]:
+        """Per-top-level-block eigenvalues (reference iterates layers by
+        layer_name/layer_num; the pytree's first level plays that role).
+        Other blocks are held constant."""
+        key = key if key is not None else jax.random.PRNGKey(0)
+        out = {}
+        for i, name in enumerate(params):
+            def block_loss(p_block, name=name):
+                full = dict(params)
+                full[name] = p_block
+                return loss_fn(full)
+            out[name] = self.compute_eigenvalue(
+                block_loss, params[name], jax.random.fold_in(key, i))
+        return out

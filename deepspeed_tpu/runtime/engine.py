@@ -105,6 +105,9 @@ class DeepSpeedEngine:
 
         # --- model ------------------------------------------------------
         self.module = self._wrap_module(_as_model(model))
+        if hasattr(self.module, "place_frozen"):
+            # LoRA-style modules shard their frozen base over the mesh
+            self.module.place_frozen(self.mesh)
         self.model_config: ModelConfig | None = getattr(self.module, "config", None)
         self.compute_dtype = self.config.compute_dtype
         self._mixed = self.compute_dtype != jnp.float32

@@ -54,7 +54,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     # --- generation ----------------------------------------------------
     def _build_generate(self, prompt_len: int, max_new: int, greedy: bool,
-                        temperature: float, top_k: int):
+                        top_k: int):
         model = self.module
         cache_len = prompt_len + max_new
         if cache_len > self._max_out:
@@ -63,30 +63,30 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
                 f"hybrid_engine.max_out_tokens ({self._max_out})")
         dtype = self.compute_dtype
 
-        def sample(logits, key):
+        def sample(logits, key, temperature):
             logits = logits.astype(jnp.float32)
             if greedy:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            if temperature != 1.0:
-                logits = logits / temperature
+            logits = logits / temperature  # runtime value: no recompile
             if top_k > 0:
                 kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
                 logits = jnp.where(logits < kth, -1e30, logits)
             return jax.random.categorical(key, logits, axis=-1).astype(
                 jnp.int32)
 
-        def generate(params, tokens, key):
+        def generate(params, tokens, key, temperature):
             b = tokens.shape[0]
             cache = model.init_cache(b, cache_len, dtype=dtype)
             logits, cache = model.decode(params, tokens, cache)  # prefill
             key, sub = jax.random.split(key)
-            nxt = sample(logits[:, -1, :], sub)
+            nxt = sample(logits[:, -1, :], sub, temperature)
 
             def body(carry, _):
                 cache, tok, key = carry
                 logits, cache = model.decode(params, tok[:, None], cache)
                 key, sub = jax.random.split(key)
-                return (cache, sample(logits[:, -1, :], sub), key), tok
+                return (cache, sample(logits[:, -1, :], sub, temperature),
+                        key), tok
 
             (_, last, _), toks = jax.lax.scan(
                 body, (cache, nxt, key), None, length=max_new - 1)
@@ -106,17 +106,22 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         tokens = jnp.asarray(tokens)
         if tokens.ndim == 1:
             tokens = tokens[None, :]
-        sig = (tokens.shape[1], max_new_tokens, not do_sample, temperature,
-               top_k)
+        # compile cache keys exclude temperature (a runtime scalar); bound
+        # the cache so ragged prompt lengths can't grow it without limit
+        # (one compile per distinct (prompt_len, max_new, mode, top_k))
+        sig = (tokens.shape[1], max_new_tokens, not do_sample, top_k)
         if sig not in self._generate_fns:
+            if len(self._generate_fns) >= 16:
+                self._generate_fns.pop(next(iter(self._generate_fns)))
             self._generate_fns[sig] = self._build_generate(
                 tokens.shape[1], max_new_tokens, greedy=not do_sample,
-                temperature=temperature, top_k=top_k)
+                top_k=top_k)
         self.is_in_generate = True
         t0 = time.time()
         try:
             out = self._generate_fns[sig](self.state["params"], tokens,
-                                          jax.random.PRNGKey(seed))
+                                          jax.random.PRNGKey(seed),
+                                          jnp.float32(temperature))
             out.block_until_ready()
         finally:
             self.is_in_generate = False

@@ -1,0 +1,49 @@
+"""Progressive layer drop (reference: runtime/progressive_layer_drop.py
+ProgressiveLayerDrop — theta schedule consumed by engine.forward,
+engine.py:1723).
+
+theta(t) = (1 - theta_0) * exp(-gamma * t) ... inverted: the keep
+probability ramps from 1.0 toward ``theta`` with rate ``gamma``; layer i
+of L keeps with prob 1 - i/L * (1 - theta(t)) (PLD paper's progressive
+schedule). ``layer_keep_probs`` hands a per-layer keep vector to a model
+whose scan body applies stochastic depth."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """reference: ProgressiveLayerDrop(theta, gamma)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        """reference: update_state — theta decays 1.0 -> theta."""
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def layer_keep_probs(self, num_layers: int) -> jax.Array:
+        """Per-layer keep probability: deeper layers drop first."""
+        depth = jnp.arange(1, num_layers + 1) / num_layers
+        return 1.0 - depth * (1.0 - self.current_theta)
+
+    def sample_mask(self, num_layers: int, key: jax.Array) -> jax.Array:
+        """Bernoulli keep-mask [num_layers] for one step; feed to a model
+        scan body as `keep * f(x) + (1-keep) * x`."""
+        return jax.random.bernoulli(
+            key, self.layer_keep_probs(num_layers)).astype(jnp.float32)

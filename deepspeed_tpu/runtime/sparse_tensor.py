@@ -1,0 +1,65 @@
+"""SparseTensor for sparse-embedding gradient reduction (reference:
+runtime/sparse_tensor.py SparseTensor + engine.py:2549
+sparse_allreduce_no_retain).
+
+Embedding-table grads are row-sparse: only rows of tokens in the batch
+are nonzero. The reference ships (indices, values) pairs through
+allreduce instead of the dense table. Under jit the dense grad never
+materializes row-zero traffic if XLA scatters — but for explicit
+shard_map reductions (and host-side aggregation) this container carries
+the same (indices, values, dense_size) triple."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class SparseTensor:
+    """reference: sparse_tensor.py SparseTensor."""
+
+    indices: jax.Array          # [nnz] row indices
+    values: jax.Array           # [nnz, row_dim]
+    dense_size: tuple = ()      # static full shape
+
+    def tree_flatten(self):
+        return (self.indices, self.values), (self.dense_size,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    @classmethod
+    def from_dense(cls, dense: jax.Array, max_rows: int | None = None
+                   ) -> "SparseTensor":
+        """Row-sparsify; max_rows bounds nnz for a static shape (take the
+        largest-norm rows)."""
+        norms = jnp.sum(jnp.abs(dense), axis=tuple(range(1, dense.ndim)))
+        k = max_rows or dense.shape[0]
+        _, idx = jax.lax.top_k(norms, k)
+        return cls(idx, dense[idx], tuple(dense.shape))
+
+    def to_dense(self) -> jax.Array:
+        """reference: SparseTensor.to_dense (scatter-add of rows)."""
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> tuple[int, int]:
+        import math
+        return (self.indices.size + self.values.size,
+                math.prod(self.dense_size))
+
+
+def sparse_allreduce(st: SparseTensor, axes) -> SparseTensor:
+    """All-gather (indices, values) along ``axes`` — the reference's
+    sparse_allreduce gathers both and leaves summation to to_dense()
+    (engine.py:2597 sparse_allreduce). Must run inside shard_map."""
+    from jax import lax
+    idx = lax.all_gather(st.indices, axes, axis=0, tiled=True)
+    vals = lax.all_gather(st.values, axes, axis=0, tiled=True)
+    return SparseTensor(idx, vals, st.dense_size)

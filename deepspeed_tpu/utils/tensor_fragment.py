@@ -30,17 +30,20 @@ def _flat_with_paths(tree: PyTree) -> dict[str, Any]:
             for p, leaf in jax.tree_util.tree_leaves_with_path(tree)}
 
 
+def _match_paths(flat: dict[str, Any], name: str) -> list[str]:
+    """Exact path match wins; otherwise a unique suffix match (the same
+    rule for getters and setters, so what can be read can be written)."""
+    if name in flat:
+        return [name]
+    return [k for k in flat if k.endswith("/" + name)]
+
+
 def _lookup(tree: PyTree, name: str) -> Optional[Any]:
     if tree is None:
         return None
     flat = _flat_with_paths(tree)
-    if name in flat:
-        return flat[name]
-    # suffix match lets users pass the param path when the tree nests it
-    # under optax state prefixes (e.g. "0/mu/<param path>")
-    hits = [v for k, v in flat.items()
-            if k.endswith("/" + name) or k == name]
-    return hits[0] if len(hits) == 1 else None
+    hits = _match_paths(flat, name)
+    return flat[hits[0]] if len(hits) == 1 else None
 
 
 def safe_get_full_fp32_param(engine, name: str) -> Optional[np.ndarray]:
@@ -63,8 +66,7 @@ def safe_set_full_fp32_param(engine, name: str, value) -> bool:
     def replace(tree):
         if tree is None:
             return None, False
-        matches = [k for k in _flat_with_paths(tree)
-                   if k == name or k.endswith("/" + name)]
+        matches = _match_paths(_flat_with_paths(tree), name)
         if len(matches) != 1:
             return tree, False  # ambiguous or absent: refuse, like the getter
         target = matches[0]
@@ -106,13 +108,13 @@ def safe_get_full_optimizer_state(engine, name: str,
     torch names ("exp_avg"/"exp_avg_sq") or optax's ("mu"/"nu")
     (reference: tensor_fragment.py:160)."""
     key = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_key, state_key)
-    flat = _flat_with_paths(engine.state["opt_state"])
-    hits = [v for k, v in flat.items()
-            if f"/{key}/" in f"/{k}/" and
-            (k.endswith("/" + name) or name in k)]
+    flat = {k: v for k, v in
+            _flat_with_paths(engine.state["opt_state"]).items()
+            if f"/{key}/" in f"/{k}/"}
+    hits = _match_paths(flat, name)
     if len(hits) != 1:
         return None
-    return np.asarray(jax.device_get(hits[0]), dtype=np.float32)
+    return np.asarray(jax.device_get(flat[hits[0]]), dtype=np.float32)
 
 
 def safe_set_full_optimizer_state(engine, name: str, state_key: str,
@@ -121,10 +123,10 @@ def safe_set_full_optimizer_state(engine, name: str, state_key: str,
     from ..parallel.partition import _path_str
     key = {"exp_avg": "mu", "exp_avg_sq": "nu"}.get(state_key, state_key)
     value = jnp.asarray(value)
-    flat = _flat_with_paths(engine.state["opt_state"])
-    matches = [k for k, v in flat.items()
-               if f"/{key}/" in f"/{k}/" and
-               (k.endswith("/" + name) or name in k)]
+    flat = {k: v for k, v in
+            _flat_with_paths(engine.state["opt_state"]).items()
+            if f"/{key}/" in f"/{k}/"}
+    matches = _match_paths(flat, name)
     if len(matches) != 1:
         return False  # ambiguous or absent: refuse, like the getter
 

@@ -1,0 +1,87 @@
+"""Autotuner (reference: deepspeed/autotuning/, tests/unit/autotuning/)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import (Autotuner, AutotuningConfig,
+                                      GridSearchTuner, ModelBasedTuner,
+                                      RandomTuner, memory_per_device,
+                                      model_info_profile)
+from deepspeed_tpu.models import GPT2
+
+
+def test_memory_model_monotone_in_stage():
+    p = 10**9
+    mems = [memory_per_device(p, s, world=8) for s in (0, 1, 2, 3)]
+    assert mems[0] > mems[1] > mems[2] > mems[3]
+    # stage 3 shards everything
+    assert mems[3] == (2 * p + 2 * p + 16 * p) // 8
+
+
+def test_model_info_profile():
+    info = model_info_profile(GPT2(size="tiny"))
+    assert info["num_params"] > 10_000
+
+
+def _exps():
+    return [{"zero_optimization": {"stage": s},
+             "train_micro_batch_size_per_gpu": mb}
+            for s in (0, 1) for mb in (1, 2, 4)]
+
+
+@pytest.mark.parametrize("cls", [GridSearchTuner, RandomTuner,
+                                 ModelBasedTuner])
+def test_tuners_find_best(cls):
+    # synthetic metric: stage 1 with mb 4 is best
+    def run(exp):
+        return (exp["zero_optimization"]["stage"] * 10
+                + exp["train_micro_batch_size_per_gpu"])
+
+    tuner = cls(_exps())
+    best = tuner.tune(run, n_trials=10)
+    assert best["zero_optimization"]["stage"] == 1
+    assert best["train_micro_batch_size_per_gpu"] == 4
+    assert tuner.best_metric_val == 14
+
+
+def test_tuner_early_stopping():
+    calls = []
+
+    def run(exp):
+        calls.append(exp)
+        return 1.0  # never improves after the first
+
+    tuner = GridSearchTuner(_exps())
+    tuner.tune(run, n_trials=10, early_stopping=2)
+    assert len(calls) <= 4
+
+
+def test_autotuner_end_to_end(devices8):
+    """Two-trial grid over ZeRO stages on the tiny model; in-process
+    trials must produce a best config with a positive throughput."""
+
+    def make_batch(total):
+        tokens = jax.random.randint(jax.random.PRNGKey(0),
+                                    (total, 17), 0, 512)
+        return tokens[:, :-1], tokens[:, 1:]
+
+    base = {
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+        "mesh": {"fsdp": -1},
+        "gradient_accumulation_steps": 1,
+    }
+    tuner_cfg = AutotuningConfig(
+        enabled=True, zero_stages=[0, 3],
+        min_train_micro_batch_size_per_gpu=2,
+        num_tuning_micro_batch_sizes=1,
+        start_step=1, end_step=3)
+    at = Autotuner(GPT2(size="tiny"), base, tuner_cfg,
+                   make_batch=make_batch)
+    exps = at.generate_experiments()
+    assert len(exps) == 2
+    best, val = at.tune()
+    assert best is not None and val > 0
+    assert best["zero_optimization"]["stage"] in (0, 3)
+    assert len(at.rm.results) == 2

@@ -1,0 +1,179 @@
+"""Small runtime subsystems: activation checkpointing, Domino, tiling,
+eigenvalue, progressive layer drop, sparse tensors (reference:
+runtime/activation_checkpointing/, runtime/domino/, zero/tiling.py,
+runtime/eigenvalue.py, runtime/progressive_layer_drop.py,
+runtime/sparse_tensor.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.domino import DominoTransformerLayer
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                 sparse_allreduce)
+from deepspeed_tpu.runtime.tiling import TiledLinear
+
+
+@pytest.fixture(autouse=True)
+def _reset_ckpt():
+    yield
+    checkpointing.reset()
+
+
+# --- activation checkpointing ------------------------------------------
+
+def test_checkpoint_matches_uncheckpointed():
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 32))
+
+    def block(x):
+        return jnp.tanh(x @ w) @ w.T
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32))
+    f_plain = lambda x: jnp.sum(block(x) ** 2)  # noqa: E731
+    f_ckpt = lambda x: jnp.sum(  # noqa: E731
+        checkpointing.checkpoint(block, x) ** 2)
+    np.testing.assert_allclose(np.asarray(f_plain(x)),
+                               np.asarray(f_ckpt(x)), rtol=1e-5)
+    g1 = jax.grad(f_plain)(x)
+    g2 = jax.jit(jax.grad(f_ckpt))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_checkpoint_configure_and_wrapper():
+    checkpointing.configure(deepspeed_config={
+        "activation_checkpointing": {"partition_activations": True,
+                                     "cpu_checkpointing": False}})
+    assert checkpointing.is_configured()
+    w = jnp.eye(16)
+    block = checkpointing.checkpoint_wrapper(lambda x: x @ w)
+    out = jax.jit(lambda x: block(x).sum())(jnp.ones((4, 16)))
+    assert float(out) == 64.0
+
+
+def test_rng_tracker_deterministic_streams():
+    checkpointing.model_parallel_cuda_manual_seed(1234)
+    tr = checkpointing.get_cuda_rng_tracker()
+    k1 = tr.fork("model-parallel-rng")
+    k2 = tr.fork("model-parallel-rng")
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    # replay from saved state reproduces the same keys
+    checkpointing.model_parallel_cuda_manual_seed(1234)
+    assert np.array_equal(np.asarray(tr.fork("model-parallel-rng")),
+                          np.asarray(k1))
+    with pytest.raises(ValueError):
+        tr.fork("nope")
+
+
+# --- Domino -------------------------------------------------------------
+
+def test_domino_layer_matches_unchunked():
+    w1 = jax.random.normal(jax.random.PRNGKey(0), (16, 16)) * 0.1
+    w2 = jax.random.normal(jax.random.PRNGKey(1), (16, 16)) * 0.1
+    attn = lambda p, x: x @ p["w1"]  # noqa: E731
+    mlp = lambda p, x: jnp.tanh(x @ p["w2"])  # noqa: E731
+    params = {"w1": w1, "w2": w2}
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+
+    ref_h = x + attn(params, x)
+    ref = ref_h + mlp(params, ref_h)
+    for n in (1, 2, 4):
+        layer = DominoTransformerLayer(attn, mlp, n_micro=n)
+        np.testing.assert_allclose(np.asarray(layer(params, x)),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-6)
+    # non-divisible batch falls back to a single chunk
+    layer = DominoTransformerLayer(attn, mlp, n_micro=3)
+    assert layer(params, x).shape == x.shape
+
+
+# --- tiling -------------------------------------------------------------
+
+def test_tiled_linear_matches_dense():
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (32, 24)) * 0.1
+    b = jnp.arange(24, dtype=jnp.float32)
+    lin, params = TiledLinear.from_dense(w, b, in_splits=4, out_splits=3)
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, 32))
+    np.testing.assert_allclose(np.asarray(lin(params, x)),
+                               np.asarray(x @ w + b), rtol=1e-4,
+                               atol=1e-5)
+    p2 = lin.init(jax.random.PRNGKey(2))
+    assert p2["tiles"].shape == (4, 3, 8, 8)
+    with pytest.raises(ValueError):
+        TiledLinear(30, 24, in_splits=4)
+
+
+# --- eigenvalue ---------------------------------------------------------
+
+def test_eigenvalue_power_iteration_quadratic():
+    """For loss = 0.5 x^T A x the Hessian is A; power iteration must find
+    its top eigenvalue."""
+    evals = jnp.array([1.0, 3.0, 10.0])
+    q, _ = jnp.linalg.qr(jax.random.normal(jax.random.PRNGKey(0), (3, 3)))
+    A = q @ jnp.diag(evals) @ q.T
+
+    def loss(p):
+        x = p["x"]
+        return 0.5 * x @ A @ x
+
+    ev = Eigenvalue(max_iter=200, tol=1e-4)
+    top = ev.compute_eigenvalue(loss, {"x": jnp.ones((3,))})
+    np.testing.assert_allclose(top, 10.0, rtol=1e-2)
+
+
+def test_eigenvalue_per_block():
+    def loss(p):
+        return 0.5 * (2.0 * jnp.sum(p["a"] ** 2) + 6.0 * jnp.sum(p["b"] ** 2))
+
+    ev = Eigenvalue(max_iter=100, tol=1e-4)
+    out = ev.compute_eigenvalue_per_block(
+        loss, {"a": jnp.ones((4,)), "b": jnp.ones((4,))})
+    np.testing.assert_allclose(out["a"], 2.0, rtol=1e-2)
+    np.testing.assert_allclose(out["b"], 6.0, rtol=1e-2)
+
+
+# --- progressive layer drop ---------------------------------------------
+
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    t1 = pld.update_state(10)
+    t2 = pld.update_state(1000)
+    assert 0.5 < t2 < t1 < 1.0
+    probs = pld.layer_keep_probs(4)
+    assert probs.shape == (4,)
+    assert float(probs[0]) > float(probs[-1])  # deeper drops first
+    mask = pld.sample_mask(4, jax.random.PRNGKey(0))
+    assert set(np.unique(np.asarray(mask))) <= {0.0, 1.0}
+
+
+# --- sparse tensors -----------------------------------------------------
+
+def test_sparse_tensor_roundtrip():
+    dense = jnp.zeros((16, 4)).at[jnp.array([2, 7])].set(1.5)
+    st = SparseTensor.from_dense(dense, max_rows=2)
+    np.testing.assert_allclose(np.asarray(st.to_dense()),
+                               np.asarray(dense))
+    nnz, total = st.sparse_size()
+    assert nnz < total
+
+
+def test_sparse_allreduce(devices8):
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    mesh = Mesh(np.array(devices8).reshape(8), ("dp",))
+
+    def body():
+        i = jax.lax.axis_index("dp")
+        st = SparseTensor(jnp.array([i]),
+                          jnp.ones((1, 4)),
+                          (8, 4))
+        return sparse_allreduce(st, ("dp",)).to_dense()
+
+    out = shard_map(body, mesh=mesh, in_specs=(),
+                    out_specs=P(), check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out), np.ones((8, 4)))

@@ -1,0 +1,99 @@
+"""Block-sparse attention (reference: deepspeed/ops/sparse_attention/,
+tests/unit/ops/sparse_attention/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig,
+    SparseAttentionUtils, SparseSelfAttention, VariableSparsityConfig,
+    layout_to_bias)
+
+
+@pytest.mark.parametrize("cfg_cls,kw", [
+    (DenseSparsityConfig, {}),
+    (FixedSparsityConfig, {"num_local_blocks": 2, "num_global_blocks": 1}),
+    (VariableSparsityConfig, {"num_random_blocks": 1,
+                              "local_window_blocks": [1, 2]}),
+    (BigBirdSparsityConfig, {"num_random_blocks": 1,
+                             "num_sliding_window_blocks": 3}),
+    (BSLongformerSparsityConfig, {"num_sliding_window_blocks": 3,
+                                  "global_block_indices": [0]}),
+    (LocalSlidingWindowSparsityConfig, {"num_sliding_window_blocks": 3}),
+])
+def test_layouts_well_formed(cfg_cls, kw):
+    cfg = cfg_cls(num_heads=2, block=8, **kw)
+    layout = cfg.make_layout(64)
+    assert layout.shape == (2, 8, 8)
+    assert layout.dtype == bool
+    # every query block attends somewhere (no fully-masked rows)
+    assert layout.any(axis=-1).all()
+    # diagonal is always live for these configs
+    assert all(layout[h, i, i] for h in range(2) for i in range(8))
+
+
+def test_unidirectional_layout_is_causal():
+    cfg = FixedSparsityConfig(num_heads=1, block=4, num_local_blocks=2,
+                              attention="unidirectional")
+    layout = cfg.make_layout(32)
+    assert not np.triu(layout[0], k=1).any()
+    cfg = BigBirdSparsityConfig(num_heads=1, block=4,
+                                attention="unidirectional")
+    assert not np.triu(cfg.make_layout(32)[0], k=1).any()
+
+
+def test_layout_rejects_indivisible_seq():
+    with pytest.raises(ValueError):
+        DenseSparsityConfig(num_heads=1, block=16).make_layout(40)
+
+
+def test_dense_config_matches_full_attention():
+    b, h, s, d = 2, 2, 32, 16
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, s, d))
+               for i in range(3))
+    attn = SparseSelfAttention(DenseSparsityConfig(num_heads=h, block=8))
+    out = attn(q, k, v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(d)
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(scores, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_blocks_get_zero_probability():
+    """Dead blocks must contribute nothing: perturbing masked keys cannot
+    change the output."""
+    h, s, d = 1, 32, 8
+    cfg = LocalSlidingWindowSparsityConfig(
+        num_heads=h, block=8, num_sliding_window_blocks=1)
+    attn = SparseSelfAttention(cfg)
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (1, h, s, d))
+               for i in range(3))
+    out = attn(q, k, v)
+    # block 3 keys/values are invisible to query block 0 (window=1)
+    k2 = k.at[:, :, 24:].set(99.0)
+    v2 = v.at[:, :, 24:].set(-99.0)
+    out2 = attn(q, k2, v2)
+    np.testing.assert_allclose(np.asarray(out[:, :, :8]),
+                               np.asarray(out2[:, :, :8]), rtol=1e-5)
+
+
+def test_layout_to_bias_expansion():
+    layout = np.zeros((1, 2, 2), bool)
+    layout[0, 0, 0] = True
+    bias = layout_to_bias(layout, block=4)
+    assert bias.shape == (1, 8, 8)
+    assert float(bias[0, 0, 0]) == 0.0
+    assert float(bias[0, 0, 7]) < -1e29
+
+
+def test_pad_unpad_roundtrip():
+    tokens = jnp.ones((2, 13), jnp.int32)
+    padded, pad = SparseAttentionUtils.pad_to_block_size(8, tokens)
+    assert padded.shape == (2, 16) and pad == 3
+    out = SparseAttentionUtils.unpad_sequence_output(
+        pad, jnp.ones((2, 16, 4)))
+    assert out.shape == (2, 13, 4)
