@@ -1,0 +1,41 @@
+"""Engine factory (reference: inference/v2/engine_factory.py
+build_hf_engine — maps an architecture name to its inference model
+implementation and constructs InferenceEngineV2).
+
+The reference reads an HF checkpoint dir and dispatches on
+``config.model_type`` over {llama, mistral, mixtral, falcon, opt, phi,
+phi3, qwen, qwen2, qwen2_moe}. Here the same names resolve through the
+model registry (models/base.py); weights come from a params pytree or a
+fresh init (checkpoint loading flows through the training checkpoint
+subsystem, runtime/checkpointing.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ...models.base import get_model_class
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+
+__all__ = ["build_engine", "SUPPORTED_MODEL_TYPES"]
+
+# reference engine_factory.py name table
+SUPPORTED_MODEL_TYPES = ("gpt2", "llama", "mistral", "mixtral", "falcon",
+                         "opt", "phi", "phi3", "qwen", "qwen2", "qwen2_moe")
+
+
+def build_engine(model_type: str, size: str = "tiny",
+                 engine_config: RaggedInferenceEngineConfig | dict |
+                 None = None,
+                 params: Optional[Any] = None,
+                 **model_overrides) -> InferenceEngineV2:
+    """reference: engine_factory.py build_hf_engine (policy dispatch)."""
+    if model_type not in SUPPORTED_MODEL_TYPES:
+        raise ValueError(
+            f"unsupported model_type {model_type!r}; supported: "
+            f"{SUPPORTED_MODEL_TYPES}")
+    model = get_model_class(model_type)(size=size, **model_overrides)
+    if engine_config is None:
+        engine_config = RaggedInferenceEngineConfig()
+    elif isinstance(engine_config, dict):
+        engine_config = RaggedInferenceEngineConfig(**engine_config)
+    return InferenceEngineV2(model, engine_config, params=params)

@@ -38,10 +38,12 @@ def scatter_kv(pool: jax.Array, kv: jax.Array, block_table: jax.Array,
 
 
 def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
-                    block_tables: jax.Array, pos0: jax.Array):
+                    block_tables: jax.Array, pos0: jax.Array,
+                    window: int | None = None):
     """q: [B, S_new, H, D]; pools [num_blocks, bs, H_kv, D]; block_tables
     [B, max_blocks]; pos0 [B] tokens already cached before this chunk.
-    Causal over absolute positions. (reference: blocked_flash)"""
+    Causal over absolute positions; ``window`` restricts lookback
+    (Mistral SWA). (reference: blocked_flash)"""
     b, sq, hq, d = q.shape
     bs = k_pool.shape[1]
     hkv = k_pool.shape[2]
@@ -61,6 +63,8 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     qpos = pos0[:, None] + jnp.arange(sq)[None, :]            # [B, S]
     kpos = jnp.arange(smax)[None, :]
     mask = kpos[:, None, :] <= qpos[:, :, None]               # [B, S, smax]
+    if window is not None:
+        mask &= kpos[:, None, :] > qpos[:, :, None] - window
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
@@ -86,7 +90,11 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         q, k, v = model._qkv(p, h, positions)
         k_pool = scatter_kv(k_pool, k, block_tables, pos0, true_len)
         v_pool = scatter_kv(v_pool, v, block_tables, pos0, true_len)
-        a = paged_attention(q, k_pool, v_pool, block_tables, pos0)
+        a = paged_attention(q, k_pool, v_pool, block_tables, pos0,
+                            window=model.config.sliding_window)
+        if model.config.parallel_residual:
+            m, _ = model._mlp(p, h)
+            return x + model._attn_out(p, a) + m, (k_pool, v_pool)
         x = x + model._attn_out(p, a)
         x, _ = model._mlp_residual(p, x)
         return x, (k_pool, v_pool)

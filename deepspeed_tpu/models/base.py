@@ -36,8 +36,15 @@ class ModelConfig:
     activation: str = "gelu"            # gelu | swiglu
     position_embedding: str = "learned"  # learned | rope
     use_bias: bool = True
+    attn_qkv_bias: bool = False     # qkv biases even when use_bias=False
+    #                                 (Qwen-style)
+    parallel_residual: bool = False  # Falcon/Phi-2: x + attn(h) + mlp(h)
+    #                                  with a single input norm (no ln2)
+    rotary_pct: float = 1.0         # partial rotary (GPT-NeoX/Phi-2)
+    sliding_window: int | None = None  # Mistral windowed attention
     # MoE (0 experts = dense; reference: deepspeed/moe)
     num_experts: int = 0
+    moe_num_shared_experts: int = 0  # Qwen2-MoE always-on experts
     moe_top_k: int = 2
     capacity_factor: float = 1.25
     min_capacity: int = 4
@@ -71,14 +78,21 @@ class ModelConfig:
         mlp = 3 * d * f if self.activation == "swiglu" else 2 * d * f
         if self.num_experts > 0:
             mlp = mlp * self.num_experts + d * self.num_experts  # + gate
-        per_layer = attn + mlp + 2 * d  # + ln scales
+            if self.moe_num_shared_experts > 0:
+                # shared experts fused into one n-times-wider swiglu MLP
+                # plus the sigmoid gate proj (d -> 1)
+                mlp += 3 * d * f * self.moe_num_shared_experts + d
+        n_norms = 1 if self.parallel_residual else 2
+        per_layer = attn + mlp + n_norms * d  # + ln scales
+        if self.use_bias or self.attn_qkv_bias:
+            per_layer += nh_d + 2 * kv      # qkv biases
         if self.use_bias:
-            per_layer += nh_d + 2 * kv + d  # attn biases
+            per_layer += d                  # wo bias
             per_layer += f + d              # w_up_b, w_down_b
             if self.activation == "swiglu":
                 per_layer += f              # w_gate_b
         if self.norm_type == "layernorm":
-            per_layer += 2 * d              # ln biases
+            per_layer += n_norms * d        # ln biases
         embed = v * d + (0 if self.tie_embeddings else v * d)
         pos = self.max_seq_len * d if self.position_embedding == "learned" else 0
         final_norm = d + (d if self.norm_type == "layernorm" else 0)

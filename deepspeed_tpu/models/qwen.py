@@ -1,0 +1,140 @@
+"""Qwen family (reference: inference/v2/model_implementations/{qwen,
+qwen_v2,qwen_v2_moe}/ — llama-style decoders with qkv biases; the MoE
+variant adds routed experts plus an always-on shared expert)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..moe.sharded_moe import moe_ffn
+from ..ops import layers as L
+from .base import ModelConfig, register_model
+from .mixtral import Mixtral
+from .transformer import DecoderLM, _dense_init
+
+
+def qwen_config(size: str = "7b", **overrides) -> ModelConfig:
+    presets = {
+        "tiny": dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128, vocab_size=512,
+                     max_seq_len=128),
+        "7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                   num_kv_heads=32, intermediate_size=11008,
+                   vocab_size=151936, max_seq_len=8192),
+        "72b": dict(hidden_size=8192, num_layers=80, num_heads=64,
+                    num_kv_heads=64, intermediate_size=24576,
+                    vocab_size=152064, max_seq_len=32768,
+                    rope_theta=1e6),
+    }
+    base = dict(norm_type="rmsnorm", activation="swiglu",
+                position_embedding="rope", use_bias=False,
+                attn_qkv_bias=True, tie_embeddings=False)
+    base.update(presets[size])
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def qwen2_config(size: str = "7b", **overrides) -> ModelConfig:
+    presets = {
+        "tiny": dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128, vocab_size=512,
+                     max_seq_len=128),
+        "7b": dict(hidden_size=3584, num_layers=28, num_heads=28,
+                   num_kv_heads=4, intermediate_size=18944,
+                   vocab_size=152064, max_seq_len=32768, rope_theta=1e6),
+    }
+    base = dict(norm_type="rmsnorm", activation="swiglu",
+                position_embedding="rope", use_bias=False,
+                attn_qkv_bias=True, tie_embeddings=False)
+    base.update(presets[size])
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+def qwen2_moe_config(size: str = "a2.7b", **overrides) -> ModelConfig:
+    presets = {
+        "tiny": dict(hidden_size=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, intermediate_size=128, vocab_size=512,
+                     max_seq_len=128, num_experts=4, moe_top_k=2,
+                     moe_num_shared_experts=1),
+        "a2.7b": dict(hidden_size=2048, num_layers=24, num_heads=16,
+                      num_kv_heads=16, intermediate_size=1408,
+                      vocab_size=151936, max_seq_len=8192,
+                      num_experts=60, moe_top_k=4, rope_theta=1e6,
+                      moe_num_shared_experts=1),
+    }
+    base = dict(norm_type="rmsnorm", activation="swiglu",
+                position_embedding="rope", use_bias=False,
+                attn_qkv_bias=True, tie_embeddings=False)
+    base.update(presets[size])
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+@register_model("qwen")
+class Qwen(DecoderLM):
+    def __init__(self, config: ModelConfig | None = None,
+                 size: str | None = None, **overrides):
+        if config is not None and (size is not None or overrides):
+            raise ValueError(
+                "pass either an explicit config or size/overrides, not both")
+        super().__init__(config or qwen_config(size or "7b", **overrides))
+
+
+@register_model("qwen2")
+class Qwen2(DecoderLM):
+    def __init__(self, config: ModelConfig | None = None,
+                 size: str | None = None, **overrides):
+        if config is not None and (size is not None or overrides):
+            raise ValueError(
+                "pass either an explicit config or size/overrides, not both")
+        super().__init__(config or qwen2_config(size or "7b", **overrides))
+
+
+@register_model("qwen2_moe")
+class Qwen2MoE(Mixtral):
+    """Routed experts + a shared expert whose output is added through a
+    sigmoid gate (reference: qwen_v2_moe modules)."""
+
+    def __init__(self, config: ModelConfig | None = None,
+                 size: str | None = None, **overrides):
+        if config is None:
+            config = qwen2_moe_config(size or "a2.7b", **overrides)
+        elif size is not None or overrides:
+            raise ValueError(
+                "pass either an explicit config or size/overrides, not both")
+        super().__init__(config)
+
+    def init(self, rng: jax.Array):
+        params = super().init(rng)
+        c = self.config
+        dt = c.param_dtype
+        d, Ln = c.hidden_size, c.num_layers
+        # n shared experts fuse into one n-times-wider swiglu MLP
+        fs = c.intermediate_size * max(c.moe_num_shared_experts, 1)
+        keys = jax.random.split(jax.random.fold_in(rng, 23), 4)
+        std = 0.02
+        params["layers"]["shared"] = {
+            "w_gate": _dense_init(keys[0], (Ln, d, fs), std, dt),
+            "w_up": _dense_init(keys[1], (Ln, d, fs), std, dt),
+            "w_down": _dense_init(keys[2], (Ln, fs, d),
+                                  std / (2 * Ln) ** 0.5, dt),
+            "gate_proj": _dense_init(keys[3], (Ln, d, 1), std, dt),
+        }
+        return params
+
+    def _mlp(self, p, h):
+        out, aux = super()._mlp(p, h)
+        sh = p["shared"]
+        shared = (L.silu(h @ sh["w_gate"]) * (h @ sh["w_up"])) @ sh["w_down"]
+        gate = jax.nn.sigmoid(h @ sh["gate_proj"])
+        return out + gate * shared, aux
+
+    def partition_rules(self):
+        return super().partition_rules() + [
+            (r"layers/shared/(w_gate|w_up)$", P(None, None, "tp")),
+            (r"layers/shared/w_down$", P(None, "tp", None)),
+            (r"layers/shared/gate_proj$", P()),
+        ]

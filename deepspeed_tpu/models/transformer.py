@@ -44,9 +44,14 @@ class DecoderLM:
     def __init__(self, config: ModelConfig):
         self.config = config
         if config.position_embedding == "rope":
+            # partial rotary (rotary_pct < 1): rope covers only the first
+            # rot_dim channels of each head (GPT-NeoX/Phi-2 style)
+            self._rot_dim = max(2, int(config.head_dim
+                                       * config.rotary_pct) // 2 * 2)
             self._rope = L.rotary_embedding(
-                config.max_seq_len, config.head_dim, config.rope_theta)
+                config.max_seq_len, self._rot_dim, config.rope_theta)
         else:
+            self._rot_dim = 0
             self._rope = None
 
     # ---------------- init ----------------
@@ -69,20 +74,25 @@ class DecoderLM:
             "wk": layer_stack(lk[1], (d, nkv * hd), std),
             "wv": layer_stack(lk[2], (d, nkv * hd), std),
             "wo": layer_stack(lk[3], (nh * hd, d), resid_std),
-            "ln2_scale": jnp.ones((c.num_layers, d), dt),
             "w_up": layer_stack(lk[4], (d, f), std),
             "w_down": layer_stack(lk[5], (f, d), resid_std),
         }
+        if not c.parallel_residual:  # parallel blocks share ln1
+            layers["ln2_scale"] = jnp.ones((c.num_layers, d), dt)
         if c.activation == "swiglu":
             layers["w_gate"] = layer_stack(lk[6], (d, f), std)
         if c.norm_type == "layernorm":
             layers["ln1_bias"] = jnp.zeros((c.num_layers, d), dt)
-            layers["ln2_bias"] = jnp.zeros((c.num_layers, d), dt)
-        if c.use_bias:
+            if not c.parallel_residual:
+                layers["ln2_bias"] = jnp.zeros((c.num_layers, d), dt)
+        if c.use_bias or c.attn_qkv_bias:
             layers.update({
                 "wq_b": jnp.zeros((c.num_layers, nh * hd), dt),
                 "wk_b": jnp.zeros((c.num_layers, nkv * hd), dt),
                 "wv_b": jnp.zeros((c.num_layers, nkv * hd), dt),
+            })
+        if c.use_bias:
+            layers.update({
                 "wo_b": jnp.zeros((c.num_layers, d), dt),
                 "w_up_b": jnp.zeros((c.num_layers, f), dt),
                 "w_down_b": jnp.zeros((c.num_layers, d), dt),
@@ -132,15 +142,25 @@ class DecoderLM:
         q = h @ p["wq"]
         k = h @ p["wk"]
         v = h @ p["wv"]
-        if c.use_bias:
+        if c.use_bias or c.attn_qkv_bias:
             q, k, v = q + p["wq_b"], k + p["wk_b"], v + p["wv_b"]
         q = q.reshape(b, s, nh, hd)
         k = k.reshape(b, s, nkv, hd)
         v = v.reshape(b, s, nkv, hd)
         if self._rope is not None:
             cos, sin = self._rope
-            q = L.apply_rotary(q, cos, sin, positions)
-            k = L.apply_rotary(k, cos, sin, positions)
+            if self._rot_dim < hd:   # partial rotary: rotate a prefix
+                q = jnp.concatenate(
+                    [L.apply_rotary(q[..., :self._rot_dim], cos, sin,
+                                    positions), q[..., self._rot_dim:]],
+                    axis=-1)
+                k = jnp.concatenate(
+                    [L.apply_rotary(k[..., :self._rot_dim], cos, sin,
+                                    positions), k[..., self._rot_dim:]],
+                    axis=-1)
+            else:
+                q = L.apply_rotary(q, cos, sin, positions)
+                k = L.apply_rotary(k, cos, sin, positions)
         return q, k, v
 
     def _attn_out(self, p: PyTree, a: jax.Array) -> jax.Array:
@@ -163,17 +183,40 @@ class DecoderLM:
         c = self.config
         p = layer_params
         if attn_fn is None:
-            if c.attn_impl == "flash":
+            if c.attn_impl == "flash" and c.sliding_window is None:
                 from ..ops.pallas.flash_attention import flash_attention
                 attn_fn = flash_attention
+            elif c.sliding_window is not None:
+                import functools
+                if c.attn_impl == "flash":
+                    from ..utils.logging import warning_once
+                    warning_once(
+                        "sliding_window set: flash attention kernel has no "
+                        "window support yet; using the masked reference "
+                        "attention (O(S^2) memory)")
+                attn_fn = functools.partial(
+                    L.dot_product_attention,
+                    bias=self._window_bias(x.shape[1]))
             else:
                 attn_fn = L.dot_product_attention
 
         h = self._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = self._qkv(p, h, positions)
         a = attn_fn(q, k, v, causal=True)
+        if c.parallel_residual:
+            # Falcon/Phi-2: attention and MLP read the same normed input
+            m, aux = self._mlp(p, h)
+            return x + self._attn_out(p, a) + m, aux
         x = x + self._attn_out(p, a)
         return self._mlp_residual(p, x)
+
+    def _window_bias(self, seq_len: int) -> jax.Array:
+        """Additive mask for sliding-window attention (Mistral): query i
+        sees keys in (i - window, i]."""
+        w = self.config.sliding_window
+        qi = jnp.arange(seq_len)[:, None]
+        ki = jnp.arange(seq_len)[None, :]
+        return jnp.where(qi - ki < w, 0.0, -1e30)[None, None]
 
     def _mlp(self, p: PyTree, h: jax.Array):
         """Dense FFN. Returns (out, aux_loss) — MoE subclasses override
@@ -223,7 +266,11 @@ class DecoderLM:
             k_cache, k.astype(k_cache.dtype), index, axis=1)
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), index, axis=1)
-        a = L.cached_attention(q, k_cache, v_cache, index)
+        a = L.cached_attention(q, k_cache, v_cache, index,
+                               window=self.config.sliding_window)
+        if self.config.parallel_residual:
+            m, _ = self._mlp(p, h)
+            return x + self._attn_out(p, a) + m, k_cache, v_cache
         x = x + self._attn_out(p, a)
         x, _ = self._mlp_residual(p, x)
         return x, k_cache, v_cache

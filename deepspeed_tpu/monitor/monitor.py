@@ -84,6 +84,34 @@ class WandbMonitor(Monitor):
             wandb.log({name: float(value)}, step=int(step))
 
 
+class CometMonitor(Monitor):
+    """reference: monitor/comet.py CometMonitor."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.experiment = None
+        try:
+            import comet_ml
+            self.experiment = comet_ml.start(
+                api_key=getattr(config, "api_key", None),
+                project=getattr(config, "project", None),
+                workspace=getattr(config, "workspace", None),
+                experiment_key=getattr(config, "experiment_key", None),
+                mode=getattr(config, "mode", None),
+                online=getattr(config, "online", None))
+            name = getattr(config, "experiment_name", None)
+            if name and self.experiment is not None:
+                self.experiment.set_name(name)
+        except Exception as e:
+            logger.warning(f"comet monitor disabled: {e}")
+
+    def write_events(self, events: List[Event]):
+        if self.experiment is None:
+            return
+        for name, value, step in events:
+            self.experiment.log_metric(name, float(value), step=int(step))
+
+
 class MonitorMaster(Monitor):
     """reference: monitor.py:30 — rank-0-only fan-out."""
 
@@ -97,6 +125,9 @@ class MonitorMaster(Monitor):
             self.monitors.append(CSVMonitor(ds_config.csv_monitor))
         if ds_config.wandb.enabled:
             self.monitors.append(WandbMonitor(ds_config.wandb))
+        if getattr(ds_config, "comet", None) is not None and \
+                ds_config.comet.enabled:
+            self.monitors.append(CometMonitor(ds_config.comet))
 
     @property
     def enabled(self) -> bool:

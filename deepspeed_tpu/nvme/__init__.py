@@ -1,0 +1,5 @@
+"""DeepNVMe qualification tooling (reference: deepspeed/nvme/)."""
+
+from .perf_sweep import (available_io_backends, perf_run_sweep,  # noqa: F401
+                         sweep_configs)
+from .validate_async_io import validate_async_io  # noqa: F401

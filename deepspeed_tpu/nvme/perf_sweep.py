@@ -1,0 +1,89 @@
+"""NVMe read/write performance sweep (reference:
+deepspeed/nvme/perf_run_sweep.py + perf_sweep_utils.py + ds_aio_job.py —
+sweeps block_size x queue_depth x thread-count over the aio op and
+reports GB/s so users can pick aio_config values for ZeRO-Infinity).
+
+Runs in-process against the native AIO op (ops/aio.py / csrc/aio.cpp);
+each configuration times a write+read of ``io_size`` bytes against
+``folder`` and reports bandwidth. ``parse_results`` mirrors
+parse_nvme_stats.py's best-by-key summary."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import tempfile
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+DEFAULT_SWEEP = {
+    "block_size": [1 << 17, 1 << 20],   # 128K, 1M
+    "queue_depth": [4, 32],
+    "io_parallel": [1, 2],
+}
+
+
+def available_io_backends() -> list[str]:
+    """reference: GDS vs bounce-buffer AIO probing; TPU hosts have no
+    cuFile, so the native aio op is the only backend."""
+    try:
+        from ..ops.aio import get_aio_handle
+        get_aio_handle()
+        return ["aio"]
+    except Exception:
+        return []
+
+
+def sweep_configs(sweep: Optional[dict] = None) -> list[dict]:
+    sweep = {**DEFAULT_SWEEP, **(sweep or {})}
+    keys = sorted(sweep)
+    return [dict(zip(keys, vals))
+            for vals in itertools.product(*(sweep[k] for k in keys))]
+
+
+def _run_one(cfg: dict, folder: str, io_size: int) -> dict:
+    from ..ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(block_size=cfg["block_size"],
+                      queue_depth=cfg["queue_depth"],
+                      num_threads=cfg.get("io_parallel", 1))
+    buf = np.random.default_rng(0).integers(
+        0, 255, size=io_size, dtype=np.uint8)
+    out = np.zeros_like(buf)
+    path = os.path.join(folder, "ds_aio_perf.bin")
+    t0 = time.time()
+    h.sync_pwrite(buf, path)
+    t_write = time.time() - t0
+    t0 = time.time()
+    h.sync_pread(out, path)
+    t_read = time.time() - t0
+    os.unlink(path)
+    gb = io_size / 2 ** 30
+    return {**cfg, "write_gbs": gb / max(t_write, 1e-9),
+            "read_gbs": gb / max(t_read, 1e-9)}
+
+
+def perf_run_sweep(folder: Optional[str] = None,
+                   io_size: int = 1 << 26,
+                   sweep: Optional[dict] = None,
+                   verbose: bool = False) -> list[dict]:
+    """reference: perf_run_sweep.py main sweep loop."""
+    if not available_io_backends():
+        return []
+    folder = folder or tempfile.gettempdir()
+    results = []
+    for cfg in sweep_configs(sweep):
+        r = _run_one(cfg, folder, io_size)
+        results.append(r)
+        if verbose:
+            print(f"{cfg}: write {r['write_gbs']:.2f} GB/s, "
+                  f"read {r['read_gbs']:.2f} GB/s")
+    return results
+
+
+def parse_results(results: list[dict], key: str = "read_gbs") -> dict:
+    """Best configuration by metric (reference: parse_nvme_stats.py)."""
+    if not results:
+        return {}
+    return max(results, key=lambda r: r[key])

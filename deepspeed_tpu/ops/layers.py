@@ -110,7 +110,7 @@ def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
     return out.astype(q.dtype)
 
 
-def cached_attention(q, k_cache, v_cache, index):
+def cached_attention(q, k_cache, v_cache, index, *, window: int | None = None):
     """Decode-time attention against a static KV cache (reference:
     csrc/transformer/inference softmax + attention over the
     inference_context.h KV buffers).
@@ -118,7 +118,8 @@ def cached_attention(q, k_cache, v_cache, index):
     q: [B, S_new, H, D] (the tokens being decoded); k/v_cache:
     [B, S_max, H_kv, D] with positions [0, index + S_new) valid (the new
     tokens' k/v already written at [index, index + S_new)). `index` is a
-    traced scalar — the mask keeps shapes static for XLA.
+    traced scalar — the mask keeps shapes static for XLA. ``window``
+    restricts each query to its last `window` positions (Mistral SWA).
     """
     b, sq, hq, d = q.shape
     _, smax, hkv, _ = k_cache.shape
@@ -131,8 +132,10 @@ def cached_attention(q, k_cache, v_cache, index):
                         preferred_element_type=jnp.float32) * scale
     qpos = index + jnp.arange(sq)[:, None]        # absolute q positions
     kpos = jnp.arange(smax)[None, :]
-    mask = (kpos <= qpos)[None, None]             # causal over the cache
-    logits = jnp.where(mask, logits, -1e30)
+    mask = kpos <= qpos                           # causal over the cache
+    if window is not None:
+        mask &= kpos > qpos - window
+    logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache)
     return out.astype(q.dtype)

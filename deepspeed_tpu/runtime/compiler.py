@@ -1,0 +1,29 @@
+"""Compile shim (reference: runtime/compiler.py is_compile_supported /
+disable — a guard layer over torch.compile).
+
+Under JAX everything already runs through the XLA compiler; this module
+keeps the reference's API for portability. ``disable`` marks a function
+to be kept out of jit tracing via ``jax.ensure_compile_time_eval`` — in
+practice callers use it to fence host-side code, which in JAX simply
+stays outside jit, so the decorator is the identity with the guard
+recorded."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_compile_disabled = False
+
+
+def is_compile_supported() -> bool:
+    """reference: compiler.py:18 — always true: jit IS the runtime."""
+    return True
+
+
+def disable(fn: Callable = None, *, recursive: bool = True):
+    """reference: compiler.py:22 torch.compiler.disable shim. Identity
+    decorator (host code is naturally outside jit); usable bare or with
+    arguments."""
+    if fn is None:
+        return lambda f: f
+    return fn

@@ -166,6 +166,19 @@ class WandbConfig(DeepSpeedConfigModel):
     project: str = "deepspeed_tpu"
 
 
+class CometConfig(DeepSpeedConfigModel):
+    """reference: monitor/config.py CometConfig."""
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    online: Optional[bool] = None
+    mode: Optional[str] = None
+
+
 class CSVConfig(MonitorConfigBase):
     pass
 
@@ -247,6 +260,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    comet: CometConfig = Field(default_factory=CometConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     curriculum_learning: CurriculumLearningConfig = Field(

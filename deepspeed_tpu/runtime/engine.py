@@ -278,7 +278,8 @@ class DeepSpeedEngine:
                 seed=self.config.seed)
         self.monitor = None
         if (self.config.tensorboard.enabled or self.config.wandb.enabled
-                or self.config.csv_monitor.enabled):
+                or self.config.csv_monitor.enabled
+                or self.config.comet.enabled):
             from ..monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(self.config)
         log_dist(

@@ -1,0 +1,73 @@
+"""NVMe perf tooling, monitor backends, compiler shim (reference:
+deepspeed/nvme/, deepspeed/monitor/, runtime/compiler.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.nvme import (available_io_backends, perf_run_sweep,
+                                sweep_configs, validate_async_io)
+from deepspeed_tpu.nvme.perf_sweep import parse_results
+from deepspeed_tpu.runtime import compiler
+
+
+def test_validate_async_io():
+    # the native op is built in this image; roundtrip must hold
+    if not available_io_backends():
+        pytest.skip("aio op not built")
+    assert validate_async_io()
+
+
+def test_sweep_configs_cartesian():
+    cfgs = sweep_configs({"block_size": [1, 2], "queue_depth": [4],
+                          "io_parallel": [1]})
+    assert len(cfgs) == 2
+    assert {c["block_size"] for c in cfgs} == {1, 2}
+
+
+def test_perf_sweep_smoke(tmp_path):
+    if not available_io_backends():
+        pytest.skip("aio op not built")
+    res = perf_run_sweep(folder=str(tmp_path), io_size=1 << 20,
+                         sweep={"block_size": [1 << 17],
+                                "queue_depth": [4], "io_parallel": [1]})
+    assert len(res) == 1
+    assert res[0]["read_gbs"] > 0 and res[0]["write_gbs"] > 0
+    best = parse_results(res)
+    assert best == res[0]
+
+
+def test_csv_monitor_and_master(tmp_path):
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig.from_any({
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "job"}})
+    m = MonitorMaster(cfg)
+    assert m.enabled
+    m.write_events([("Train/loss", 1.5, 10), ("Train/loss", 1.2, 20)])
+    lines = open(os.path.join(str(tmp_path), "job.csv")).read().splitlines()
+    assert lines[0] == "name,value,step"
+    assert len(lines) == 3
+
+
+def test_comet_monitor_degrades_gracefully():
+    from deepspeed_tpu.monitor.monitor import CometMonitor
+    from deepspeed_tpu.runtime.config import CometConfig
+    mon = CometMonitor(CometConfig(enabled=True))
+    mon.write_events([("x", 1.0, 1)])  # no comet_ml installed: no-op
+
+
+def test_compiler_shim():
+    assert compiler.is_compile_supported()
+
+    @compiler.disable
+    def f(x):
+        return x + 1
+
+    @compiler.disable(recursive=False)
+    def g(x):
+        return x + 2
+
+    assert f(1) == 2 and g(1) == 3
