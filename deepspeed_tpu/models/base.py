@@ -51,6 +51,8 @@ class ModelConfig:
     router_aux_loss_coef: float = 0.01
     # numerics
     param_dtype: Any = None   # set to jnp dtype in __post_init__
+    loss_chunk: int = 0       # >0: fused chunked cross-entropy (tokens per
+    #                           chunk) — never materializes [B,S,V] logits
     remat: bool = True
     # jax.checkpoint_policies name; "nothing_saveable" = full recompute
     remat_policy: str = "nothing_saveable"

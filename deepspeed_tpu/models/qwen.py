@@ -110,10 +110,12 @@ class Qwen2MoE(Mixtral):
     def init(self, rng: jax.Array):
         params = super().init(rng)
         c = self.config
+        if c.moe_num_shared_experts <= 0:
+            return params
         dt = c.param_dtype
         d, Ln = c.hidden_size, c.num_layers
         # n shared experts fuse into one n-times-wider swiglu MLP
-        fs = c.intermediate_size * max(c.moe_num_shared_experts, 1)
+        fs = c.intermediate_size * c.moe_num_shared_experts
         keys = jax.random.split(jax.random.fold_in(rng, 23), 4)
         std = 0.02
         params["layers"]["shared"] = {
@@ -127,6 +129,8 @@ class Qwen2MoE(Mixtral):
 
     def _mlp(self, p, h):
         out, aux = super()._mlp(p, h)
+        if "shared" not in p:
+            return out, aux
         sh = p["shared"]
         shared = (L.silu(h @ sh["w_gate"]) * (h @ sh["w_up"])) @ sh["w_down"]
         gate = jax.nn.sigmoid(h @ sh["gate_proj"])

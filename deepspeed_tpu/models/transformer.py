@@ -161,7 +161,9 @@ class DecoderLM:
             else:
                 q = L.apply_rotary(q, cos, sin, positions)
                 k = L.apply_rotary(k, cos, sin, positions)
-        return q, k, v
+        from jax.ad_checkpoint import checkpoint_name
+        return (checkpoint_name(q, "qkv"), checkpoint_name(k, "qkv"),
+                checkpoint_name(v, "qkv"))
 
     def _attn_out(self, p: PyTree, a: jax.Array) -> jax.Array:
         b, s = a.shape[:2]
@@ -182,6 +184,12 @@ class DecoderLM:
         (no leading L dim)."""
         c = self.config
         p = layer_params
+        if attn_fn is not None and c.sliding_window is not None:
+            from ..utils.logging import warning_once
+            warning_once(
+                "sliding_window is set but a custom attn_fn (e.g. the "
+                "sequence-parallel wrapper) is in use; the window mask is "
+                "NOT applied by the wrapper — attention is full-causal")
         if attn_fn is None:
             if c.attn_impl == "flash" and c.sliding_window is None:
                 from ..ops.pallas.flash_attention import flash_attention
@@ -221,6 +229,7 @@ class DecoderLM:
     def _mlp(self, p: PyTree, h: jax.Array):
         """Dense FFN. Returns (out, aux_loss) — MoE subclasses override
         (aux carries the router load-balancing loss)."""
+        from jax.ad_checkpoint import checkpoint_name
         c = self.config
         if c.activation == "swiglu":
             gate = h @ p["w_gate"]
@@ -234,6 +243,7 @@ class DecoderLM:
             if c.use_bias:
                 up = up + p["w_up_b"]
             m = L.gelu(up)
+        m = checkpoint_name(m, "ffn")
         m = m @ p["w_down"]
         if c.use_bias:
             m = m + p["w_down_b"]
@@ -307,6 +317,66 @@ class DecoderLM:
               attn_fn: AttnFn | None = None,
               positions: jax.Array | None = None,
               return_aux: bool = False):
+        x, aux = self._final_hidden(params, tokens, attn_fn=attn_fn,
+                                    positions=positions)
+        logits = self._project_vocab(params, x)
+        return (logits, aux) if return_aux else logits
+
+    def loss(self, params: PyTree, batch: Any, *,
+             attn_fn: AttnFn | None = None) -> jax.Array:
+        tokens, targets = _unpack_batch(batch)
+        if self.config.loss_chunk > 0:
+            return self._chunked_loss(params, tokens, targets,
+                                      attn_fn=attn_fn)
+        logits, aux = self.apply(params, tokens, attn_fn=attn_fn,
+                                 return_aux=True)
+        ce = L.cross_entropy_loss(logits, targets)
+        return ce + self.aux_loss_coef() * aux
+
+    def _chunked_loss(self, params: PyTree, tokens, targets, *,
+                      attn_fn=None) -> jax.Array:
+        """Fused chunked cross-entropy: the [B, S, V] logits tensor is
+        never materialized — the unembed matmul + logsumexp run per
+        sequence chunk under remat, so peak HBM holds one
+        [B, loss_chunk, V] slab and the backward recomputes it per chunk.
+        The HBM-traffic role of the reference's fused logits kernels
+        (csrc/transformer/inference logits_gather + fused softmax)."""
+        c = self.config
+        x, aux = self._final_hidden(params, tokens, attn_fn=attn_fn)
+        W = (params["embed"]["tokens"].T if c.tie_embeddings
+             else params["lm_head"])
+        b, s, d = x.shape
+        chunk = min(c.loss_chunk, s)
+        n = s // chunk
+        if s % chunk != 0:
+            raise ValueError(
+                f"loss_chunk {chunk} must divide sequence length {s}")
+        xc = x[:, : n * chunk].reshape(b, n, chunk, d).swapaxes(0, 1)
+        tc = targets[:, : n * chunk].reshape(b, n, chunk).swapaxes(0, 1)
+
+        @jax.checkpoint
+        def chunk_nll(x_c, t_c):
+            logits = (x_c @ W.astype(x_c.dtype)).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tl = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+            valid = t_c >= 0
+            return jnp.sum(jnp.where(valid, lse - tl, 0.0)), \
+                jnp.sum(valid)
+
+        def body(acc, xs):
+            x_c, t_c = xs
+            nll, cnt = chunk_nll(x_c, t_c)
+            return (acc[0] + nll, acc[1] + cnt), None
+
+        (nll, cnt), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+            (xc, tc))
+        ce = nll / jnp.maximum(cnt, 1)
+        return ce + self.aux_loss_coef() * aux
+
+    def _final_hidden(self, params: PyTree, tokens, *, attn_fn=None,
+                      positions=None):
+        """Final-normed hidden states [B, S, D] + router aux loss."""
         c = self.config
         x = self.embed(params, tokens, positions)
 
@@ -317,21 +387,19 @@ class DecoderLM:
             return (x, aux + layer_aux), None
 
         if c.remat:
-            policy = (None if c.remat_policy == "nothing_saveable"
-                      else getattr(jax.checkpoint_policies, c.remat_policy))
-            body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+            body = jax.checkpoint(body, prevent_cse=False,
+                                  policy=_remat_policy(c.remat_policy))
         (x, aux), _ = jax.lax.scan(
             body, (x, jnp.zeros((), jnp.float32)), params["layers"])
-        logits = self.unembed(params, x)
-        return (logits, aux) if return_aux else logits
+        x = self._norm(x, params["final_norm"]["scale"],
+                       params["final_norm"].get("bias"))
+        return x, aux
 
-    def loss(self, params: PyTree, batch: Any, *,
-             attn_fn: AttnFn | None = None) -> jax.Array:
-        tokens, targets = _unpack_batch(batch)
-        logits, aux = self.apply(params, tokens, attn_fn=attn_fn,
-                                 return_aux=True)
-        ce = L.cross_entropy_loss(logits, targets)
-        return ce + self.aux_loss_coef() * aux
+    def _project_vocab(self, params: PyTree, x: jax.Array) -> jax.Array:
+        """Vocab projection of already-final-normed hidden states."""
+        if self.config.tie_embeddings:
+            return x @ params["embed"]["tokens"].T
+        return x @ params["lm_head"]
 
     def aux_loss_coef(self) -> float:
         return getattr(self.config, "router_aux_loss_coef", 0.0)
@@ -351,6 +419,24 @@ class DecoderLM:
             (r"final_norm", P()),
             (r"lm_head", P(None, "tp")),
         ]
+
+
+def _remat_policy(name: str):
+    """Map a config policy name to a jax.checkpoint policy. Besides the
+    stock jax.checkpoint_policies names, ``save_attn_ffn`` saves the
+    O(S)-sized per-layer tensors named "qkv"/"attn_out"/"ffn" (both the
+    reference attention and the flash wrapper name their outputs) —
+    backward then recomputes only norms and the O(S^2) attention scores,
+    usually the best single-chip throughput point."""
+    if name == "nothing_saveable":
+        return None
+    if name == "save_attn_ffn":
+        # save the O(S)-sized per-layer tensors (qkv, attention output,
+        # ffn hidden); backward recomputes only norms and the O(S^2)
+        # attention scores — the usual best single-chip throughput point
+        return jax.checkpoint_policies.save_only_these_names(
+            "qkv", "attn_out", "ffn")
+    return getattr(jax.checkpoint_policies, name)
 
 
 def _unpack_batch(batch):

@@ -1,0 +1,3 @@
+"""DS4Science ops (reference: deepspeed/ops/deepspeed4science/)."""
+
+from .evoformer_attn import DS4Sci_EvoformerAttention  # noqa: F401

@@ -107,7 +107,12 @@ def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
         logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
-    return out.astype(q.dtype)
+    # named so selective remat policies can save the O(S)-sized attention
+    # output while recomputing the O(S^2) scores in backward
+    # (models/transformer.py "save_attn_ffn")
+    from jax.ad_checkpoint import checkpoint_name
+    out = checkpoint_name(out.astype(q.dtype), "attn_out")
+    return out
 
 
 def cached_attention(q, k_cache, v_cache, index, *, window: int | None = None):
