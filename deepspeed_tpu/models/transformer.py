@@ -347,19 +347,23 @@ class DecoderLM:
              else params["lm_head"])
         b, s, d = x.shape
         chunk = min(c.loss_chunk, s)
-        n = s // chunk
         if s % chunk != 0:
             raise ValueError(
-                f"loss_chunk {chunk} must divide sequence length {s}")
-        xc = x[:, : n * chunk].reshape(b, n, chunk, d).swapaxes(0, 1)
-        tc = targets[:, : n * chunk].reshape(b, n, chunk).swapaxes(0, 1)
+                f"loss_chunk {c.loss_chunk} (effective {chunk}) must "
+                f"divide sequence length {s}")
+        n = s // chunk
+        xc = x.reshape(b, n, chunk, d).swapaxes(0, 1)
+        tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
 
         @jax.checkpoint
         def chunk_nll(x_c, t_c):
             logits = (x_c @ W.astype(x_c.dtype)).astype(jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            tl = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
-            valid = t_c >= 0
+            # same masking contract as ops.layers.cross_entropy_loss
+            valid = t_c != -100
+            safe = jnp.where(valid, t_c, 0)
+            tl = jnp.take_along_axis(logits, safe[..., None],
+                                     axis=-1)[..., 0]
             return jnp.sum(jnp.where(valid, lse - tl, 0.0)), \
                 jnp.sum(valid)
 

@@ -283,4 +283,6 @@ def flash_attention(q, k, v, *, causal: bool = True, **_kw):
             o.transpose(0, 2, 1, 3).astype(q.dtype), "attn_out")
     to_bh = lambda x: bhsd(x).reshape(b * hq, s, d)  # noqa: E731
     o = _flash(to_bh(q), to_bh(k), to_bh(v), causal)
-    return o.reshape(b, hq, s, d).transpose(0, 2, 1, 3)
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(
+        o.reshape(b, hq, s, d).transpose(0, 2, 1, 3), "attn_out")

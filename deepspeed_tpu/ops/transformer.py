@@ -1,0 +1,139 @@
+"""BERT-era fused transformer layer API (reference:
+deepspeed/ops/transformer/transformer.py — DeepSpeedTransformerConfig:34
++ DeepSpeedTransformerLayer:296, backed by the 13k-LoC fused CUDA kernels
+in csrc/transformer/).
+
+The reference exposes a drop-in encoder layer whose forward/backward runs
+as a handful of fused kernels (QKV GEMM + bias, softmax, dropout,
+layernorm, GELU). The TPU port is a functional encoder layer over the
+same config surface; the "fusion" is XLA's (plus the Pallas flash
+attention for the softmax path), and stochastic/dropout modes use
+explicit PRNG keys. Pre-LN and Post-LN variants match the reference's
+``pre_layer_norm`` switch."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """reference: ops/transformer/transformer.py:34"""
+    batch_size: int = 1
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = 12
+    initializer_range: float = 0.02
+    local_rank: int = -1
+    seed: int = 0
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    layer_norm_eps: float = 1e-12
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.heads
+
+
+class DeepSpeedTransformerLayer:
+    """reference: ops/transformer/transformer.py:296 — a functional
+    (init, apply) encoder layer. q/k/v fused in one [D, 3D] projection
+    like the kernel's single QKV GEMM."""
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+
+    def init(self, rng: jax.Array) -> PyTree:
+        c = self.config
+        d, f = c.hidden_size, c.intermediate_size
+        std = c.initializer_range
+        out_std = std / jnp.sqrt(2.0 * c.num_hidden_layers) \
+            if c.adjust_init_range else std
+        ks = jax.random.split(rng, 4)
+        dt = jnp.float16 if c.fp16 else jnp.float32
+        return {
+            "qkv_w": (jax.random.normal(ks[0], (d, 3 * d)) * std).astype(dt),
+            "qkv_b": jnp.zeros((3 * d,), dt),
+            "attn_ow": (jax.random.normal(ks[1], (d, d)) * out_std
+                        ).astype(dt),
+            "attn_ob": jnp.zeros((d,), dt),
+            "attn_ln_w": jnp.ones((d,), dt),
+            "attn_ln_b": jnp.zeros((d,), dt),
+            "inter_w": (jax.random.normal(ks[2], (d, f)) * std).astype(dt),
+            "inter_b": jnp.zeros((f,), dt),
+            "output_w": (jax.random.normal(ks[3], (f, d)) * out_std
+                         ).astype(dt),
+            "output_b": jnp.zeros((d,), dt),
+            "ln_w": jnp.ones((d,), dt),
+            "ln_b": jnp.zeros((d,), dt),
+        }
+
+    def _dropout(self, x, rate, key):
+        if not self.config.training or rate <= 0.0 or key is None:
+            return x
+        keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), 0)
+
+    def apply(self, params: PyTree, hidden_states: jax.Array,
+              attention_mask: Optional[jax.Array] = None,
+              rng: Optional[jax.Array] = None) -> jax.Array:
+        """hidden_states: [B, S, D]; attention_mask additive [B, 1, 1, S]
+        (HF/BERT convention). Bidirectional attention (encoder)."""
+        c = self.config
+        p = params
+        b, s, d = hidden_states.shape
+        k1, k2 = (jax.random.split(rng, 2) if rng is not None
+                  else (None, None))
+
+        def attn_block(x):
+            qkv = x @ p["qkv_w"] + p["qkv_b"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shape = (b, s, c.heads, c.head_dim)
+            a = L.dot_product_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                causal=False,
+                bias=attention_mask)
+            a = a.reshape(b, s, d) @ p["attn_ow"] + p["attn_ob"]
+            return self._dropout(a, c.attn_dropout_ratio, k1)
+
+        def ffn_block(x):
+            h = L.gelu(x @ p["inter_w"] + p["inter_b"])
+            h = h @ p["output_w"] + p["output_b"]
+            return self._dropout(h, c.hidden_dropout_ratio, k2)
+
+        if c.gelu_checkpoint:
+            ffn_block = jax.checkpoint(ffn_block)
+
+        x = hidden_states
+        if c.pre_layer_norm:
+            x = x + attn_block(
+                L.layer_norm(x, p["attn_ln_w"], p["attn_ln_b"],
+                             c.layer_norm_eps))
+            x = x + ffn_block(
+                L.layer_norm(x, p["ln_w"], p["ln_b"], c.layer_norm_eps))
+        else:  # post-LN (original BERT)
+            x = L.layer_norm(x + attn_block(x), p["attn_ln_w"],
+                             p["attn_ln_b"], c.layer_norm_eps)
+            x = L.layer_norm(x + ffn_block(x), p["ln_w"], p["ln_b"],
+                             c.layer_norm_eps)
+        return (x,) if c.return_tuple else x
+
+    def __call__(self, params, hidden_states, **kw):
+        return self.apply(params, hidden_states, **kw)

@@ -1,0 +1,5 @@
+"""Compressed/coalesced collective backends (reference:
+deepspeed/runtime/comm/)."""
+
+from .coalesced_collectives import (all_to_all_quant_reduce,  # noqa: F401
+                                    reduce_scatter_coalesced)

@@ -1,0 +1,40 @@
+"""Coalesced / quantized collectives (reference:
+runtime/comm/coalesced_collectives.py — reduce_scatter_coalesced:81
+batches many tensors into one reduce-scatter; all_to_all_quant_reduce:31
+is ZeRO++ qgZ's int8 hierarchical gradient exchange; the compressed
+1-bit allreduce lives in runtime/comm/nccl.py:51).
+
+TPU translation: "coalescing" exists so NCCL launch overhead is paid once
+per bucket; XLA already fuses adjacent collectives, so these wrappers are
+semantic parity — they apply the collective leaf-wise over a tensor list
+inside shard_map, with the quantized variants delegating to the
+block-int8 primitives in runtime/zeropp.py. The error-compensated 1-bit
+path is the optimizers' job (runtime/onebit.py)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax import lax
+
+from ..zeropp import quantized_reduce_scatter
+
+
+def reduce_scatter_coalesced(tensors: Sequence[jax.Array], *,
+                             group) -> list[jax.Array]:
+    """Reduce-scatter each tensor along dim 0 over ``group`` (mesh axis
+    name(s)); returns this shard for each input. Must run inside
+    shard_map. (reference: coalesced_collectives.py:81)"""
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    return [lax.psum_scatter(t, axes, scatter_dimension=0, tiled=True)
+            for t in tensors]
+
+
+def all_to_all_quant_reduce(tensors: Sequence[jax.Array], *,
+                            group) -> list[jax.Array]:
+    """qgZ: block-int8 all-to-all reduce-scatter per tensor (reference:
+    coalesced_collectives.py:31 all_to_all_quant_reduce). SUM semantics;
+    must run inside shard_map."""
+    axes = (group,) if isinstance(group, str) else tuple(group)
+    return [quantized_reduce_scatter(t, axes, 0) for t in tensors]

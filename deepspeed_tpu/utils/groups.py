@@ -1,0 +1,155 @@
+"""Process-group registry (reference: deepspeed/utils/groups.py — the
+model/expert/data/sequence group factories, :68-531).
+
+On TPU a "process group" is a named mesh axis (or tuple of axes) on the
+live MeshTopology; these functions return the axis names usable as the
+``group=`` argument of every deepspeed_tpu.comm collective, plus the
+sizes/ranks the reference exposes. Creation is a no-op — the mesh already
+encodes every group — so the ``_create_*`` entry points only validate
+against the topology (the reference's world-size divisibility asserts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..parallel.mesh import get_topology
+
+# axis-name constants (the group handles)
+DATA_PARALLEL_GROUP = ("dp", "fsdp", "zps")
+SHARDED_DP_GROUP = ("fsdp", "zps")
+MODEL_PARALLEL_GROUP = "tp"
+EXPERT_PARALLEL_GROUP = "ep"
+EXPERT_DATA_PARALLEL_GROUP = ("dp", "fsdp", "zps")  # grads of experts
+PIPE_PARALLEL_GROUP = "pp"
+SEQUENCE_PARALLEL_GROUP = "sp"
+SEQUENCE_DATA_PARALLEL_GROUP = ("dp", "fsdp", "zps", "sp")
+ZERO_PARAM_INTRA_PARALLEL_GROUP = "zps"   # hpZ secondary partition group
+
+
+def _active(axes):
+    """Drop size-1 axes so collectives don't name dead mesh dims."""
+    topo = get_topology()
+    if isinstance(axes, str):
+        axes = (axes,)
+    live = tuple(a for a in axes if topo.sizes.get(a, 1) > 1)
+    return live or (axes[0],)
+
+
+# -- getters (reference: groups.py get_*_group/size/rank) ----------------
+
+def get_data_parallel_group():
+    return _active(DATA_PARALLEL_GROUP)
+
+
+def get_model_parallel_group():
+    return _active(MODEL_PARALLEL_GROUP)
+
+
+def get_tensor_model_parallel_group():
+    return _active(MODEL_PARALLEL_GROUP)
+
+
+def get_expert_parallel_group(group_name: str = "ep"):
+    return _active(EXPERT_PARALLEL_GROUP)
+
+
+def get_expert_data_parallel_group(group_name: str = "ep"):
+    return _active(EXPERT_DATA_PARALLEL_GROUP)
+
+
+def get_pipe_parallel_group():
+    return _active(PIPE_PARALLEL_GROUP)
+
+
+def get_sequence_parallel_group():
+    return _active(SEQUENCE_PARALLEL_GROUP)
+
+
+def get_sequence_data_parallel_group():
+    return _active(SEQUENCE_DATA_PARALLEL_GROUP)
+
+
+def get_zero_param_intra_parallel_group():
+    """reference: groups.py:531 _create_zero_param_parallel_group (hpZ)."""
+    return _active(ZERO_PARAM_INTRA_PARALLEL_GROUP)
+
+
+def get_data_parallel_world_size() -> int:
+    return get_topology().data_parallel_size
+
+
+def get_model_parallel_world_size() -> int:
+    return get_topology().model_parallel_size
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_topology().model_parallel_size
+
+
+def get_expert_parallel_world_size(group_name: str = "ep") -> int:
+    return get_topology().expert_parallel_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return get_topology().sequence_parallel_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return get_topology().pipe_parallel_size
+
+
+def get_world_size() -> int:
+    return get_topology().world_size
+
+
+def get_data_parallel_rank() -> int:
+    """Data-parallel rank of this process's FIRST device (processes own
+    contiguous device ranges in the process-major mesh layout, so pairing
+    this with get_data_parallel_world_size() yields non-overlapping
+    shard ranges). Inside shard_map use comm.axis_index for the
+    per-device rank."""
+    dp = max(get_data_parallel_world_size(), 1)
+    per_proc = max(dp // max(jax.process_count(), 1), 1)
+    return min(jax.process_index() * per_proc, dp - 1)
+
+
+def get_model_parallel_rank() -> int:
+    return 0  # single-controller SPMD: per-device rank exists only in-jit
+
+
+# -- creation entry points (validation only; the mesh is the registry) ---
+
+def _ensure_divisible(world: int, size: int, what: str):
+    if size > 0 and world % size != 0:
+        raise ValueError(
+            f"world size {world} not divisible by {what} {size}")
+
+
+def _create_model_parallel(model_parallel_size: int):
+    """reference: groups.py:68 — on TPU build the mesh with tp=N
+    instead; this validates the request against the live topology."""
+    topo = get_topology()
+    _ensure_divisible(topo.world_size, model_parallel_size,
+                      "model_parallel_size")
+    if topo.model_parallel_size not in (1, model_parallel_size):
+        raise ValueError(
+            f"mesh was built with tp={topo.model_parallel_size}, "
+            f"requested {model_parallel_size}")
+    return get_model_parallel_group(), get_data_parallel_group()
+
+
+def _create_expert_and_data_parallel(expert_parallel_size: int,
+                                     use_data_before_expert_parallel_: bool
+                                     = False):
+    """reference: groups.py:117."""
+    topo = get_topology()
+    _ensure_divisible(topo.world_size, expert_parallel_size,
+                      "expert_parallel_size")
+    if topo.expert_parallel_size not in (1, expert_parallel_size):
+        raise ValueError(
+            f"mesh was built with ep={topo.expert_parallel_size}, "
+            f"requested {expert_parallel_size}")
+    return get_expert_parallel_group(), get_expert_data_parallel_group()

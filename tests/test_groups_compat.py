@@ -1,0 +1,93 @@
+"""Group registry, coalesced collectives, BERT transformer layer
+(reference: utils/groups.py, runtime/comm/coalesced_collectives.py,
+ops/transformer/transformer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+from deepspeed_tpu.parallel.mesh import (MeshTopology, TopologyConfig,
+                                         set_topology)
+from deepspeed_tpu.runtime.comm import (all_to_all_quant_reduce,
+                                        reduce_scatter_coalesced)
+from deepspeed_tpu.utils import groups
+
+
+def test_groups_reflect_topology(devices8):
+    set_topology(MeshTopology(TopologyConfig(fsdp=2, tp=2, ep=2)))
+    assert groups.get_model_parallel_group() == ("tp",)
+    assert groups.get_expert_parallel_group() == ("ep",)
+    assert groups.get_data_parallel_group() == ("fsdp",)
+    assert groups.get_data_parallel_world_size() == 2
+    assert groups.get_model_parallel_world_size() == 2
+    assert groups.get_world_size() == 8
+    g, dpg = groups._create_model_parallel(2)
+    assert g == ("tp",)
+    with pytest.raises(ValueError):
+        groups._create_model_parallel(4)  # mesh says tp=2
+    with pytest.raises(ValueError):
+        groups._create_expert_and_data_parallel(3)  # not divisible
+
+
+def test_hpz_group(devices8):
+    set_topology(MeshTopology(TopologyConfig(fsdp=2, zps=4)))
+    assert groups.get_zero_param_intra_parallel_group() == ("zps",)
+
+
+def test_coalesced_collectives(devices8):
+    mesh = Mesh(np.array(devices8).reshape(8), ("fsdp",))
+    ts = [jnp.arange(16, dtype=jnp.float32),
+          jnp.ones((8, 4), jnp.float32)]
+
+    def body():
+        return reduce_scatter_coalesced(ts, group="fsdp")
+
+    out = shard_map(body, mesh=mesh, in_specs=(),
+                    out_specs=[P("fsdp"), P("fsdp", None)],
+                    check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               8 * np.arange(16, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(out[1]), 8 * np.ones((8, 4)))
+
+    def qbody():
+        return all_to_all_quant_reduce(
+            [jnp.ones((8 * 512,), jnp.float32)], group="fsdp")
+
+    out = shard_map(qbody, mesh=mesh, in_specs=(),
+                    out_specs=[P("fsdp")], check_vma=False)()
+    np.testing.assert_allclose(np.asarray(out[0]), 8.0, rtol=2e-2)
+
+
+def test_bert_transformer_layer_pre_and_post_ln():
+    for pre in (True, False):
+        cfg = DeepSpeedTransformerConfig(
+            batch_size=2, hidden_size=64, intermediate_size=256, heads=4,
+            num_hidden_layers=2, pre_layer_norm=pre, training=False)
+        layer = DeepSpeedTransformerLayer(cfg)
+        params = layer.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 64))
+        y = layer(params, x)
+        assert y.shape == x.shape
+        assert bool(jnp.isfinite(y).all())
+        # grads flow
+        g = jax.grad(lambda p: jnp.sum(layer(p, x) ** 2))(params)
+        assert float(jnp.abs(g["qkv_w"]).max()) > 0
+
+
+def test_bert_transformer_layer_mask_and_dropout():
+    cfg = DeepSpeedTransformerConfig(
+        hidden_size=64, intermediate_size=256, heads=4,
+        num_hidden_layers=2, attn_dropout_ratio=0.5,
+        hidden_dropout_ratio=0.5, training=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 64))
+    mask = jnp.zeros((2, 1, 1, 16)).at[:, :, :, 8:].set(-1e30)
+    y1 = layer(params, x, attention_mask=mask, rng=jax.random.PRNGKey(2))
+    y2 = layer(params, x, attention_mask=mask, rng=jax.random.PRNGKey(3))
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))  # dropout live
