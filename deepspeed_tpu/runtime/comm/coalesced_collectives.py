@@ -21,20 +21,36 @@ from jax import lax
 from ..zeropp import quantized_reduce_scatter
 
 
+def _flat_padded(t: jax.Array, world: int) -> jax.Array:
+    """Flatten and zero-pad to a multiple of the group size — the
+    reference's contract (it flattens + pads every tensor before the
+    collective, coalesced_collectives.py:95), so arbitrary shapes work."""
+    import jax.numpy as jnp
+    flat = t.reshape(-1)
+    pad = (-flat.size) % world
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
 def reduce_scatter_coalesced(tensors: Sequence[jax.Array], *,
                              group) -> list[jax.Array]:
-    """Reduce-scatter each tensor along dim 0 over ``group`` (mesh axis
-    name(s)); returns this shard for each input. Must run inside
-    shard_map. (reference: coalesced_collectives.py:81)"""
+    """Reduce-scatter each tensor over ``group`` (mesh axis name(s));
+    returns this rank's FLAT partition of each input (the reference
+    returns flattened padded partitions too). Must run inside shard_map.
+    (reference: coalesced_collectives.py:81)"""
     axes = (group,) if isinstance(group, str) else tuple(group)
-    return [lax.psum_scatter(t, axes, scatter_dimension=0, tiled=True)
+    world = lax.psum(1, axes)
+    return [lax.psum_scatter(_flat_padded(t, world), axes,
+                             scatter_dimension=0, tiled=True)
             for t in tensors]
 
 
 def all_to_all_quant_reduce(tensors: Sequence[jax.Array], *,
                             group) -> list[jax.Array]:
-    """qgZ: block-int8 all-to-all reduce-scatter per tensor (reference:
+    """qgZ: block-int8 all-to-all reduce-scatter per tensor; returns flat
+    partitions like reduce_scatter_coalesced (reference:
     coalesced_collectives.py:31 all_to_all_quant_reduce). SUM semantics;
     must run inside shard_map."""
     axes = (group,) if isinstance(group, str) else tuple(group)
-    return [quantized_reduce_scatter(t, axes, 0) for t in tensors]
+    world = lax.psum(1, axes)
+    return [quantized_reduce_scatter(_flat_padded(t, world), axes, 0)
+            for t in tensors]

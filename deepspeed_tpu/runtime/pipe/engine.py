@@ -37,6 +37,12 @@ class PipelineEngine(DeepSpeedEngine):
                 f"PipelineModule num_stages={stages} but mesh.pp={pp}")
         if pp <= 1:
             return module
+        from .module import _SpecStack
+        if isinstance(module, _SpecStack):
+            raise NotImplementedError(
+                "LayerSpec-list pipelines execute as one GSPMD program "
+                "(mesh.pp=1); stage-manual pipelining (pp>1) needs a "
+                "homogeneous layer stack — pass model=<DecoderLM-family>")
         return PipelinedDecoderLM(
             module, self.mesh, num_stages=pp,
             num_microbatches=self.gradient_accumulation_steps_)

@@ -1,13 +1,34 @@
 """PipelineModule (reference: runtime/pipe/module.py:86).
 
-Placeholder shell for the pipeline milestone: holds layer specs and the
-stage topology so ``initialize`` can dispatch to PipelineEngine. The 1F1B
-engine lands in runtime/pipe/engine.py.
+Declares a stage-partitionable model. Two forms:
+
+- **model= (preferred, TPU-native)**: a DecoderLM-family model whose
+  scan-over-layers stack is split into ``pp`` contiguous stage groups and
+  executed as one compiled SPMD pipeline (pipelined_model.py) — the
+  translation of the reference's per-stage process build
+  (``module.py:123``, each rank builds only its layers).
+- **layers=[LayerSpec...]**: the reference's lazy layer-factory list.
+  Specs must build functional layers (``init(rng) -> params``,
+  ``apply(params, x) -> x`` or plain callables without params). They are
+  partitioned with the same methods the reference offers
+  (``uniform`` / ``parameters`` / ``type:regex``, reference
+  ``_partition_layers`` :391) and run as a compiled sequential stack;
+  heterogeneous specs ride the pipeline only as a whole-graph GSPMD
+  program (stage-manual execution needs a homogeneous stack to scan).
+
+Tied layers (``TiedLayerSpec``, reference :77): specs sharing a ``key``
+reuse one parameter entry — the tied-weight gradient all-reduce the
+reference does across stages (:459) is structurally unnecessary here
+because autodiff of the shared pytree entry sums both uses' gradients.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
 
 
 class LayerSpec:
@@ -21,6 +42,9 @@ class LayerSpec:
     def build(self):
         return self.typename(*self.args, **self.kwargs)
 
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', '?')})"
+
 
 class TiedLayerSpec(LayerSpec):
     """reference: module.py:77 — layers sharing parameters across stages."""
@@ -32,37 +56,194 @@ class TiedLayerSpec(LayerSpec):
         self.tied_weight_attr = tied_weight_attr
 
 
-class PipelineModule:
-    """Declares a stage-partitionable model.
+# -- partition algorithms (reference: deepspeed/runtime/utils.py
+#    partition_uniform / partition_balanced, used by _partition_layers) --
 
-    TPU-native path: pass a DecoderLM-family ``model``; its scan-over-layers
-    stack is partitioned uniformly into ``num_stages`` contiguous groups
-    (the analogue of ``_partition_layers`` with method='uniform',
-    reference module.py:391). Execution is compiled by PipelineEngine /
-    PipelinedDecoderLM — there is no eager per-layer build, so LayerSpec
-    lists (torch-module factories in the reference) are accepted only for
-    API-shape compatibility and must be homogeneous stacks.
-    """
+def partition_uniform(num_items: int, num_parts: int) -> list[int]:
+    """Stage boundaries [0, ..., num_items] with near-equal item counts."""
+    if num_parts <= 0:
+        raise ValueError("num_parts must be positive")
+    base, extra = divmod(num_items, num_parts)
+    bounds = [0]
+    for p in range(num_parts):
+        bounds.append(bounds[-1] + base + (1 if p < extra else 0))
+    return bounds
+
+
+def partition_balanced(weights: Sequence[float],
+                       num_parts: int) -> list[int]:
+    """Boundaries minimizing the max per-stage weight (contiguous
+    partition; binary search over the bottleneck, reference
+    runtime/utils.py partition_balanced)."""
+    w = np.asarray(weights, dtype=np.float64)
+    n = len(w)
+    if num_parts >= n:
+        return partition_uniform(n, num_parts)
+    prefix = np.concatenate([[0.0], np.cumsum(w)])
+
+    def parts_needed(cap: float) -> Optional[list[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end with sum <= cap
+            end = int(np.searchsorted(prefix, prefix[start] + cap,
+                                      side="right")) - 1
+            if end <= start:
+                return None  # one item exceeds cap
+            bounds.append(min(end, n))
+            start = bounds[-1]
+            if start >= n:
+                break
+        if bounds[-1] < n:
+            return None
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds
+
+    lo, hi = float(w.max()), float(w.sum())
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if parts_needed(mid) is None:
+            lo = mid
+        else:
+            hi = mid
+    return parts_needed(hi)
+
+
+class PipelineModule:
+    """reference: runtime/pipe/module.py:86 (see module docstring)."""
 
     def __init__(self, layers: Sequence[Any] | None = None,
                  model: Any = None, num_stages: int | None = None,
                  topology=None, loss_fn: Callable | None = None,
-                 partition_method: str = "uniform",
-                 activation_checkpoint_interval: int = 0):
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed: int = 0):
         if model is None and layers is None:
-            raise ValueError("PipelineModule needs model= (preferred) or layers=")
-        if model is None:
-            raise NotImplementedError(
-                "LayerSpec-list pipelines are not supported on the TPU "
-                "build; pass model=<DecoderLM-family model> instead "
-                "(stage partitioning happens on its layer stack)")
+            raise ValueError(
+                "PipelineModule needs model= (preferred) or layers=")
         self.model = model
-        self.layers = list(layers or [])
+        self.specs = list(layers or [])
         self.num_stages = num_stages
         self._topology = topology
         self.loss_fn = loss_fn
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._built: list[Any] | None = None
+        self._tied_keys: dict[int, str] = {}
+        self.seed = seed
+        if model is None:
+            self.model = _SpecStack(self)
+
+    # -- spec building --------------------------------------------------
+    def build_layers(self) -> list[Any]:
+        if self._built is None:
+            self._built = []
+            for i, spec in enumerate(self.specs):
+                if isinstance(spec, TiedLayerSpec):
+                    self._tied_keys[i] = spec.key
+                self._built.append(spec.build()
+                                   if isinstance(spec, LayerSpec) else spec)
+        return self._built
+
+    # -- partitioning (reference: _partition_layers :391) ---------------
+    def partition_layers(self, num_stages: int | None = None) -> list[int]:
+        """Stage boundaries over the layer list (or the model's stack)."""
+        stages = num_stages or self.num_stages or 1
+        if self.model is not None and not self.specs:
+            # a homogeneous scan stack: every layer weighs the same, so
+            # 'uniform' and 'parameters' coincide; other methods would
+            # silently degenerate — reject them
+            if self.partition_method.lower() not in ("uniform",
+                                                     "parameters"):
+                raise NotImplementedError(
+                    f"partition_method {self.partition_method!r} is not "
+                    "meaningful for a homogeneous model= layer stack")
+            n = self.model.config.num_layers
+            return partition_uniform(n, stages)
+        layers = self.build_layers()
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return partition_uniform(len(layers), stages)
+        if method == "parameters":
+            weights = [_param_count(l, i, self) for i, l in
+                       enumerate(layers)]
+            return partition_balanced(weights, stages)
+        if method.startswith("type:"):
+            pattern = method[len("type:"):]
+            weights = [1.0 if re.search(pattern, type(l).__name__,
+                                        re.IGNORECASE) else 0.0
+                       for l in layers]
+            if sum(weights) == 0:
+                weights = [1.0] * len(layers)
+            return partition_balanced(weights, stages)
+        raise NotImplementedError(
+            f"partition_method {self.partition_method!r}")
 
     def topology(self):
         return self._topology
+
+
+def _param_count(layer, index: int, module: PipelineModule) -> float:
+    if not hasattr(layer, "init"):
+        return 0.0
+    try:
+        abstract = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+        return float(sum(int(np.prod(x.shape))
+                         for x in jax.tree.leaves(abstract)))
+    except Exception:
+        return 1.0
+
+
+class _SpecStack:
+    """Functional model over a built LayerSpec list: init() collects
+    per-layer params (tied specs share one entry), apply() runs the
+    layers sequentially. Used when PipelineModule is given layers=
+    instead of model=; compiled as one GSPMD program."""
+
+    def __init__(self, module: PipelineModule):
+        self._module = module
+        self.config = None
+
+    def init(self, rng):
+        layers = self._module.build_layers()
+        params: dict[str, Any] = {}
+        keys = jax.random.split(rng, max(len(layers), 1))
+        for i, layer in enumerate(layers):
+            if not hasattr(layer, "init"):
+                continue
+            p = layer.init(keys[i])
+            tied = self._module._tied_keys.get(i)
+            if tied is not None:
+                # only the named weight is shared across specs with this
+                # key (reference tied_weight_attr); each layer keeps its
+                # other params (bias etc.)
+                attr = self._module.specs[i].tied_weight_attr
+                if attr in p:
+                    params.setdefault(f"tied_{tied}", p.pop(attr))
+            params[f"layer_{i}"] = p
+        return params
+
+    def apply(self, params, x, **kw):
+        layers = self._module.build_layers()
+        for i, layer in enumerate(layers):
+            if hasattr(layer, "init"):
+                p = dict(params.get(f"layer_{i}", {}))
+                tied = self._module._tied_keys.get(i)
+                if tied is not None:
+                    attr = self._module.specs[i].tied_weight_attr
+                    p[attr] = params[f"tied_{tied}"]
+                fn = getattr(layer, "apply", None) or layer
+                x = fn(p, x)
+            else:
+                x = layer(x)
+        return x
+
+    def loss(self, params, batch, **kw):
+        if self._module.loss_fn is None:
+            raise ValueError("LayerSpec pipelines need loss_fn=")
+        inputs, labels = batch
+        return self._module.loss_fn(self.apply(params, inputs), labels)
+
+    def partition_rules(self):
+        return []

@@ -1,0 +1,14 @@
+"""Utilities (reference: deepspeed/utils/)."""
+
+from . import groups  # noqa: F401
+from .logging import log_dist, logger, warning_once  # noqa: F401
+from .memory import get_memory_breakdown, see_memory_usage  # noqa: F401
+from .nvtx import instrument_w_nvtx, range_pop, range_push  # noqa: F401
+from .tensor_fragment import (safe_get_full_fp32_param,  # noqa: F401
+                              safe_get_full_grad,
+                              safe_get_full_optimizer_state,
+                              safe_set_full_fp32_param,
+                              safe_set_full_optimizer_state)
+from .z3_leaf_module import (get_z3_leaf_modules, set_z3_leaf_modules,  # noqa: F401
+                             unset_z3_leaf_modules, z3_leaf_module,
+                             z3_leaf_parameter)

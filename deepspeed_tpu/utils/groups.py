@@ -11,11 +11,23 @@ against the topology (the reference's world-size divisibility asserts).
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
+import numpy as np
 
 from ..parallel.mesh import get_topology
+
+__all__ = [
+    "get_data_parallel_group", "get_model_parallel_group",
+    "get_tensor_model_parallel_group", "get_expert_parallel_group",
+    "get_expert_data_parallel_group", "get_pipe_parallel_group",
+    "get_sequence_parallel_group", "get_sequence_data_parallel_group",
+    "get_zero_param_intra_parallel_group",
+    "get_data_parallel_world_size", "get_model_parallel_world_size",
+    "get_tensor_model_parallel_world_size",
+    "get_expert_parallel_world_size", "get_sequence_parallel_world_size",
+    "get_pipe_parallel_world_size", "get_world_size",
+    "get_data_parallel_rank", "get_model_parallel_rank",
+]
 
 # axis-name constants (the group handles)
 DATA_PARALLEL_GROUP = ("dp", "fsdp", "zps")
@@ -106,14 +118,20 @@ def get_world_size() -> int:
 
 
 def get_data_parallel_rank() -> int:
-    """Data-parallel rank of this process's FIRST device (processes own
-    contiguous device ranges in the process-major mesh layout, so pairing
-    this with get_data_parallel_world_size() yields non-overlapping
-    shard ranges). Inside shard_map use comm.axis_index for the
+    """Data-parallel rank of this process's FIRST local device, read off
+    its coordinates in the topology mesh (correct for any axis layout,
+    incl. pp-outermost). Inside shard_map use comm.axis_index for the
     per-device rank."""
-    dp = max(get_data_parallel_world_size(), 1)
-    per_proc = max(dp // max(jax.process_count(), 1), 1)
-    return min(jax.process_index() * per_proc, dp - 1)
+    topo = get_topology()
+    dev = jax.local_devices()[0]
+    pos = np.argwhere(topo.mesh.devices == dev)
+    if pos.size == 0:   # device not in this topology's mesh
+        return 0
+    coords = dict(zip(topo.axis_order, pos[0]))
+    rank = 0
+    for a in ("dp", "fsdp", "zps"):
+        rank = rank * topo.sizes[a] + int(coords[a])
+    return rank
 
 
 def get_model_parallel_rank() -> int:

@@ -1,0 +1,56 @@
+"""Memory reporting (reference: deepspeed/runtime/utils.py
+see_memory_usage — prints allocated/cached device + host memory at
+phase boundaries; ``memory_breakdown`` config).
+
+TPU translation: per-device stats come from PJRT ``memory_stats()``
+(bytes_in_use / peak_bytes_in_use / bytes_limit); host RSS from
+/proc/self/status (no psutil dependency)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .logging import log_dist
+
+
+def device_memory_stats(device=None) -> dict:
+    d = device or jax.devices()[0]
+    stats = getattr(d, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def host_memory_gb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 2 ** 20
+    except OSError:
+        pass
+    return 0.0
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks: Optional[list[int]] = None) -> None:
+    """reference: runtime/utils.py see_memory_usage (called at fwd/bwd/
+    step boundaries when memory_breakdown is on)."""
+    stats = device_memory_stats()
+    gib = 2 ** 30
+    used = stats.get("bytes_in_use", 0) / gib
+    peak = stats.get("peak_bytes_in_use", 0) / gib
+    limit = stats.get("bytes_limit", 0) / gib
+    log_dist(
+        f"{message} | device MA {used:.2f} GB, peak {peak:.2f} GB, "
+        f"limit {limit:.2f} GB | host RSS {host_memory_gb():.2f} GB")
+
+
+def get_memory_breakdown() -> dict:
+    stats = device_memory_stats()
+    return {
+        "allocated_gb": stats.get("bytes_in_use", 0) / 2 ** 30,
+        "peak_gb": stats.get("peak_bytes_in_use", 0) / 2 ** 30,
+        "limit_gb": stats.get("bytes_limit", 0) / 2 ** 30,
+        "host_rss_gb": host_memory_gb(),
+    }

@@ -1,0 +1,43 @@
+"""Profiler range annotations (reference: deepspeed/utils/nvtx.py —
+``instrument_w_nvtx`` wraps hot functions in NVTX ranges).
+
+TPU translation: ``jax.profiler.TraceAnnotation`` puts named ranges into
+the XPlane trace the same way NVTX ranges land in nsys; ``range_push`` /
+``range_pop`` mirror the accelerator-API surface
+(``get_accelerator().range_push/pop``)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+_STACK: list = []
+
+
+def instrument_w_nvtx(func: Callable) -> Callable:
+    """reference: utils/nvtx.py instrument_w_nvtx."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.profiler.TraceAnnotation(func.__qualname__):
+            return func(*args, **kwargs)
+
+    return wrapped
+
+
+def range_push(name: str) -> None:
+    ann = jax.profiler.TraceAnnotation(name)
+    ann.__enter__()
+    _STACK.append(ann)
+
+
+def range_pop() -> None:
+    if _STACK:
+        _STACK.pop().__exit__(None, None, None)
+
+
+def annotate(name: str):
+    """Context manager form."""
+    return jax.profiler.TraceAnnotation(name)

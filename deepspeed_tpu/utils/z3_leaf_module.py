@@ -1,0 +1,46 @@
+"""z3 leaf-module API (reference: deepspeed/utils/z3_leaf_module.py —
+``set_z3_leaf_modules`` marks modules whose parameters ZeRO-3 gathers as
+one unit instead of per-submodule, fixing MoE-style modules whose
+execution order confuses the trace-based prefetch coordinator).
+
+TPU translation: ZeRO-3 gathering is a *static* schedule here (one
+all-gather per layer slice inside the scan-over-layers), so there is no
+trace to confuse and no per-submodule hook granularity to coarsen. The
+API is kept for portability: marked classes are recorded and queries
+answer consistently, but marking changes nothing — the docstring each
+function carries says so explicitly."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_LEAF_CLASSES: set[type] = set()
+
+
+def set_z3_leaf_modules(model: Any, leaf_module_classes:
+                        list[type | str]) -> list:
+    """reference: z3_leaf_module.py set_z3_leaf_modules. No-op on TPU
+    (static gather schedule); records the classes and returns []."""
+    for cls in leaf_module_classes:
+        if isinstance(cls, type):
+            _LEAF_CLASSES.add(cls)
+    return []
+
+
+def unset_z3_leaf_modules(model: Any, leaf_module_classes:
+                          list[type]) -> list:
+    for cls in leaf_module_classes:
+        _LEAF_CLASSES.discard(cls)
+    return []
+
+
+def get_z3_leaf_modules(model: Any) -> list:
+    return list(_LEAF_CLASSES)
+
+
+def z3_leaf_module(model: Any) -> bool:
+    return type(model) in _LEAF_CLASSES
+
+
+def z3_leaf_parameter(param: Any) -> bool:
+    return False

@@ -41,18 +41,21 @@ def test_hpz_group(devices8):
 
 def test_coalesced_collectives(devices8):
     mesh = Mesh(np.array(devices8).reshape(8), ("fsdp",))
+    # second tensor has an uneven size (18): the reference contract pads
     ts = [jnp.arange(16, dtype=jnp.float32),
-          jnp.ones((8, 4), jnp.float32)]
+          jnp.ones((18,), jnp.float32)]
 
     def body():
         return reduce_scatter_coalesced(ts, group="fsdp")
 
     out = shard_map(body, mesh=mesh, in_specs=(),
-                    out_specs=[P("fsdp"), P("fsdp", None)],
-                    check_vma=False)()
+                    out_specs=[P("fsdp"), P("fsdp")], check_vma=False)()
     np.testing.assert_allclose(np.asarray(out[0]),
                                8 * np.arange(16, dtype=np.float32))
-    np.testing.assert_allclose(np.asarray(out[1]), 8 * np.ones((8, 4)))
+    full = np.asarray(out[1])  # flat padded partition, re-gathered
+    assert full.shape == (24,)  # 18 padded to 24
+    np.testing.assert_allclose(full[:18], 8 * np.ones(18))
+    np.testing.assert_allclose(full[18:], 0.0)
 
     def qbody():
         return all_to_all_quant_reduce(
