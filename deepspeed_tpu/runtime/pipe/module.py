@@ -219,8 +219,11 @@ class _SpecStack:
                 # key (reference tied_weight_attr); each layer keeps its
                 # other params (bias etc.)
                 attr = self._module.specs[i].tied_weight_attr
-                if attr in p:
-                    params.setdefault(f"tied_{tied}", p.pop(attr))
+                if attr not in p:
+                    raise ValueError(
+                        f"TiedLayerSpec key={tied!r}: layer {i} params "
+                        f"{sorted(p)} have no tied_weight_attr {attr!r}")
+                params.setdefault(f"tied_{tied}", p.pop(attr))
             params[f"layer_{i}"] = p
         return params
 

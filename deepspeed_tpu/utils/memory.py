@@ -35,7 +35,11 @@ def host_memory_gb() -> float:
 def see_memory_usage(message: str, force: bool = False,
                      ranks: Optional[list[int]] = None) -> None:
     """reference: runtime/utils.py see_memory_usage (called at fwd/bwd/
-    step boundaries when memory_breakdown is on)."""
+    step boundaries; silent unless force=True, and rank-filtered)."""
+    if not force:
+        return
+    if ranks is not None and jax.process_index() not in ranks:
+        return
     stats = device_memory_stats()
     gib = 2 ** 30
     used = stats.get("bytes_in_use", 0) / gib

@@ -1,0 +1,54 @@
+"""runtime/utils.py parity (reference: deepspeed/runtime/utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.utils import (CheckOverflow, align_dense_tensors,
+                                         all_gather_dp_groups,
+                                         clip_grad_norm_, get_grad_norm,
+                                         get_global_norm_of_tensors)
+
+
+def test_global_norm_and_clip():
+    tree = {"a": jnp.ones((4,)) * 3.0, "b": jnp.ones((4,)) * 4.0}
+    norm = get_grad_norm(tree)
+    np.testing.assert_allclose(float(norm), 10.0, rtol=1e-6)
+    clipped, pre = clip_grad_norm_(tree, max_norm=5.0)
+    np.testing.assert_allclose(float(pre), 10.0, rtol=1e-6)
+    np.testing.assert_allclose(float(get_grad_norm(clipped)), 5.0,
+                               rtol=1e-4)
+    # inf-norm
+    n = get_global_norm_of_tensors(jax.tree.leaves(tree),
+                                   norm_type=float("inf"))
+    np.testing.assert_allclose(float(n), 4.0)
+
+
+def test_check_overflow():
+    good = {"a": jnp.ones((4,))}
+    bad = {"a": jnp.array([1.0, jnp.nan])}
+    assert not bool(CheckOverflow.has_overflow(good))
+    assert bool(CheckOverflow.has_overflow(bad))
+    assert bool(CheckOverflow.check_using_norm([jnp.inf]))
+
+
+def test_align_dense_tensors():
+    ts = [jnp.ones((3,)), jnp.ones((4,))]
+    out = align_dense_tensors(ts, alignment=8)
+    assert sum(t.size for t in out) == 8
+    np.testing.assert_allclose(np.asarray(out[1])[:4], 1.0)
+    np.testing.assert_allclose(np.asarray(out[1])[4:], 0.0)
+
+
+def test_all_gather_dp_groups(devices8):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"fsdp": -1},
+                "zero_optimization": {"stage": 3}})
+    full = all_gather_dp_groups(engine.state["params"])
+    leaf = jax.tree.leaves(full)[0]
+    assert leaf.sharding.is_fully_replicated
