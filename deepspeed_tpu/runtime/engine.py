@@ -444,20 +444,28 @@ class DeepSpeedEngine:
             params = fetch(state["params"], shardings["params"])
             scale = state["loss_scale"].scale
 
-            def body(acc, micro):
+            def one_micro(micro):
                 (_, loss), grads = grad_fn(params, micro, scale,
                                            state["step"])
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-                grads = constrain(grads, mesh, grad_specs)
-                acc = jax.tree.map(jnp.add, acc, grads)
-                return acc, loss
+                return constrain(grads, mesh, grad_specs), loss
 
-            micro_batches = jax.tree.map(
-                lambda x: x.reshape(ga, x.shape[0] // ga, *x.shape[1:]), batch)
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            zeros = constrain(zeros, mesh, grad_specs)
-            grads, losses = jax.lax.scan(body, zeros, micro_batches)
+            if ga == 1:
+                # no accumulation: skip the zeros-init + add pass
+                grads, loss = one_micro(batch)
+                losses = loss[None]
+            else:
+                def body(acc, micro):
+                    grads, loss = one_micro(micro)
+                    return jax.tree.map(jnp.add, acc, grads), loss
+
+                micro_batches = jax.tree.map(
+                    lambda x: x.reshape(ga, x.shape[0] // ga, *x.shape[1:]),
+                    batch)
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                zeros = constrain(zeros, mesh, grad_specs)
+                grads, losses = jax.lax.scan(body, zeros, micro_batches)
             # unscale + average over GAS (reference scales loss by 1/GAS
             # before backward, engine.py:2024)
             inv = 1.0 / (scale * ga)
@@ -875,6 +883,51 @@ class DeepSpeedEngine:
     def no_sync(self):
         import contextlib
         return contextlib.nullcontext()
+
+    # --- state offload (reference: engine.py:3720 offload_states /
+    #     :3747 reload_states — frees HBM during e.g. RLHF generation) ---
+    def offload_states(self, include=None, device: str = "cpu",
+                       pin_memory: bool = True, non_blocking: bool = False):
+        """Move optimizer state trees to pinned host memory. ``include``
+        selects among {"optimizer_states", "hp_params"} (reference
+        OffloadStateTypeEnum); contiguous_grads/lp_params are fused into
+        the compiled step here and have no persistent buffers to move."""
+        if device != "cpu":
+            raise ValueError("offload_states supports device='cpu'")
+        targets = set(include or ["optimizer_states", "hp_params"])
+        moved = {}
+        if "optimizer_states" in targets:
+            moved["opt_state"] = True
+        if "hp_params" in targets and self.state.get("master") is not None:
+            moved["master"] = True
+
+        def host(shardings):
+            return jax.tree.map(
+                lambda s: NamedSharding(s.mesh, s.spec,
+                                        memory_kind="pinned_host"),
+                shardings,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
+
+        done = getattr(self, "_offloaded_states", set())
+        for k in moved:
+            try:
+                self.state[k] = jax.device_put(
+                    self.state[k], host(self.state_shardings[k]))
+                done = done | {k}
+            except Exception as e:  # backend without host placement
+                logger.warning(f"offload_states({k}): {e}")
+                break
+        # union (not overwrite) so repeated calls with different include
+        # sets stay reloadable, and partial failure keeps what DID move
+        self._offloaded_states = done
+
+    def reload_states(self, non_blocking: bool = False):
+        """Bring offloaded states back to device memory (reference:
+        engine.py:3747)."""
+        for k in getattr(self, "_offloaded_states", ()):
+            self.state[k] = jax.device_put(self.state[k],
+                                           self.state_shardings[k])
+        self._offloaded_states = set()
 
     # checkpointing implemented in runtime/checkpointing.py, bound here
     def save_checkpoint(self, save_dir, tag=None, client_state=None,

@@ -23,6 +23,8 @@ def get_global_norm_of_tensors(tensors: Iterable[jax.Array],
                                norm_type: float = 2.0) -> jax.Array:
     """reference: runtime/utils.py get_global_norm_of_tensors."""
     leaves = list(tensors)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
     if norm_type == float("inf"):
         return jnp.max(jnp.stack([jnp.max(jnp.abs(t)) for t in leaves]))
     acc = sum(jnp.sum(jnp.abs(t.astype(jnp.float32)) ** norm_type)

@@ -40,6 +40,30 @@ def test_align_dense_tensors():
     np.testing.assert_allclose(np.asarray(out[1])[4:], 0.0)
 
 
+def test_offload_reload_states(devices8):
+    """reference: engine.py:3720 offload_states / :3747 reload_states."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"fsdp": -1}, "steps_per_print": 100,
+                "zero_optimization": {"stage": 2}})
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 17), 0, 512)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    l0 = float(engine.train_batch(batch))
+    engine.offload_states(include=["optimizer_states"])
+    if getattr(engine, "_offloaded_states", set()):
+        leaf = jax.tree.leaves(engine.state["opt_state"])[0]
+        assert leaf.sharding.memory_kind == "pinned_host"
+        engine.reload_states()
+        leaf = jax.tree.leaves(engine.state["opt_state"])[0]
+        assert leaf.sharding.memory_kind != "pinned_host"
+    l1 = float(engine.train_batch(batch))
+    assert l1 < l0  # training continues unharmed
+
+
 def test_all_gather_dp_groups(devices8):
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2
