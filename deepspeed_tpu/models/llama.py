@@ -20,6 +20,15 @@ def llama_config(size: str = "7b", **overrides) -> ModelConfig:
         "70b": dict(hidden_size=8192, num_layers=80, num_heads=64,
                     num_kv_heads=8, intermediate_size=28672,
                     vocab_size=32000, max_seq_len=4096),
+        # Llama-3 generation: GQA everywhere, 128k vocab, theta 500k
+        "3-8b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                     num_kv_heads=8, intermediate_size=14336,
+                     vocab_size=128256, max_seq_len=8192,
+                     rope_theta=500000.0),
+        "3-70b": dict(hidden_size=8192, num_layers=80, num_heads=64,
+                      num_kv_heads=8, intermediate_size=28672,
+                      vocab_size=128256, max_seq_len=8192,
+                      rope_theta=500000.0),
     }
     base = dict(norm_type="rmsnorm", activation="swiglu",
                 position_embedding="rope", use_bias=False,

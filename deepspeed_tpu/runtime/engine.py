@@ -672,9 +672,24 @@ class DeepSpeedEngine:
         else:
             self.tput_timer.stop(report_speed=False)
         if self.monitor is not None:
-            self.monitor.write_events([
+            # reference event set (engine.py:2348 _write_monitor): loss,
+            # lr, and the loss scale when fp16 is live
+            # lr of the step just applied: the optax count was
+            # global_steps - 1 when tx.update ran (overflow-skipped steps
+            # still advance global_steps, matching the reference's
+            # engine-side accounting)
+            events = [
                 ("Train/Samples/train_loss", float(metrics["loss"]),
-                 self.global_samples)])
+                 self.global_samples),
+                ("Train/Samples/lr",
+                 float(self.lr_schedule(max(self.global_steps - 1, 0))),
+                 self.global_samples),
+            ]
+            if self.fp16_enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(metrics["loss_scale"]),
+                               self.global_samples))
+            self.monitor.write_events(events)
         return metrics["loss"]
 
     def _report(self, metrics):
@@ -895,6 +910,11 @@ class DeepSpeedEngine:
         if device != "cpu":
             raise ValueError("offload_states supports device='cpu'")
         targets = set(include or ["optimizer_states", "hp_params"])
+        unknown = targets - {"optimizer_states", "hp_params"}
+        if unknown:
+            raise ValueError(
+                f"offload_states: unknown include entries {sorted(unknown)}"
+                "; supported: optimizer_states, hp_params")
         moved = {}
         if "optimizer_states" in targets:
             moved["opt_state"] = True
@@ -914,9 +934,11 @@ class DeepSpeedEngine:
                 self.state[k] = jax.device_put(
                     self.state[k], host(self.state_shardings[k]))
                 done = done | {k}
-            except Exception as e:  # backend without host placement
+            except jax.errors.JaxRuntimeError as e:
+                # backend without pinned_host placement (CPU emulation):
+                # skip this key but keep trying the rest; anything else
+                # (structure mismatch etc.) propagates
                 logger.warning(f"offload_states({k}): {e}")
-                break
         # union (not overwrite) so repeated calls with different include
         # sets stay reloadable, and partial failure keeps what DID move
         self._offloaded_states = done

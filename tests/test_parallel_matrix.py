@@ -1,0 +1,48 @@
+"""Composed-parallelism matrix on the 8-device CPU mesh — the hybrid
+topologies of SURVEY §2.3 (reference: tests/unit/model_parallelism +
+pipe/moe suites cover these pairwise; here each config composes 3+ axes
+with ZeRO)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2, Llama, Mixtral
+
+
+def batch(tb, seq=16, vocab=512):
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (tb, seq + 1), 0,
+                                vocab)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+CASES = [
+    # (name, model fn, mesh, zero cfg)
+    ("tp2_fsdp4_z3", lambda: Llama(size="tiny"),
+     {"tp": 2, "fsdp": -1}, {"stage": 3}),
+    ("sp2_fsdp2_dp2_z2", lambda: Llama(size="tiny"),
+     {"sp": 2, "dp": 2, "fsdp": -1}, {"stage": 2}),
+    ("ep2_tp2_fsdp2_z3_hpz", lambda: Mixtral(size="tiny"),
+     {"ep": 2, "tp": 2, "fsdp": -1},
+     {"stage": 3, "zero_hpz_partition_size": 2}),
+]
+
+
+@pytest.mark.parametrize("name,model_fn,mesh,zero",
+                         CASES, ids=[c[0] for c in CASES])
+def test_composed_parallelism_trains(name, model_fn, mesh, zero, devices8):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "zero_optimization": zero,
+        "mesh": mesh,
+        "steps_per_print": 1000,
+    }
+    engine, _, _, _ = ds.initialize(model=model_fn(), config=cfg)
+    losses = [float(engine.train_batch(batch(8))) for _ in range(3)]
+    assert all(np.isfinite(losses)), (name, losses)
+    assert losses[-1] < losses[0], (name, losses)
