@@ -69,7 +69,11 @@ class InferenceEngineV2:
                       c.num_kv_heads, c.head_dim)
         self.pools = {"k": jnp.zeros(pool_shape, self.dtype),
                       "v": jnp.zeros(pool_shape, self.dtype)}
-        # one jit; XLA caches one executable per bucket shape
+        # one jit; XLA caches one executable per bucket shape. put() is
+        # one dispatch per scheduler tick (logits_gather fused into the
+        # step); for generation loops where per-dispatch latency matters
+        # more than admission control, the v1/hybrid engines compile the
+        # whole decode loop into a single program instead.
         self._step = jax.jit(functools.partial(paged_forward, self.model),
                              donate_argnums=(1,))
         # SplitFuse budget, floored to a power of two (bucket shapes must
@@ -102,16 +106,15 @@ class InferenceEngineV2:
             tokens[i, :n] = seq.tokens[seq.seen:seq.seen + n]
             pos0[i] = seq.seen
             true_len[i] = n
-        # padded rows must not write: true_len 0 drops their scatters
+        # padded rows must not write: true_len 0 drops their scatters.
+        # logits come back already gathered at each row's last valid
+        # token (logits_gather fused into the compiled step)
         logits, self.pools = self._step(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(pos0), jnp.asarray(tables), jnp.asarray(true_len))
         for i, seq in enumerate(seqs):
             seq.seen += int(true_len[i])
-        # logits_gather (reference kernel): last valid token per sequence
-        idx = jnp.asarray(true_len - 1).clip(0)
-        out = logits[jnp.arange(b_bucket), idx]
-        return out[:len(seqs)]
+        return logits[:len(seqs)]
 
     # ------------------------------------------------------------------
     # reference API

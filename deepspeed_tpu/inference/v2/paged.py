@@ -3,12 +3,16 @@ inference/v2/kernels/ragged_ops/ — blocked_flash is a paged FlashAttention
 over the block table; linear_blocked_kv_rotary writes rotary-embedded k/v
 into KV blocks; logits_gather picks each sequence's last-token logits).
 
-TPU translation: one function computes a layer's qkv, scatters k/v into
-the block pool (XLA scatter with mode='drop' for padded slots), gathers
-the sequence's pages, and runs masked attention. On TPU with aligned
-shapes the decode path can dispatch to the production paged-attention
-Pallas kernel; the jnp gather path below is the portable reference and
-handles prefill chunks (q_len > 1) everywhere.
+TPU translation: each layer gathers its sequence's pages (read-only),
+patches the chunk's fresh k/v into the gathered view for attention, and
+emits the small chunk as a scan output; ONE bulk scatter after the layer
+scan writes every layer's k/v into the pools, and the vocab projection
+runs only on each sequence's last valid token (logits_gather, fused).
+The pool slabs deliberately never ride the scan as ys — that would copy
+the whole pool through HBM every step. On TPU with aligned shapes the
+decode path can dispatch to the production paged-attention Pallas kernel;
+the jnp gather path below is the portable reference and handles prefill
+chunks (q_len > 1) everywhere.
 """
 
 from __future__ import annotations
@@ -20,40 +24,40 @@ import numpy as np
 PyTree = dict
 
 
-def scatter_kv(pool: jax.Array, kv: jax.Array, block_table: jax.Array,
-               pos0: jax.Array, true_len: jax.Array):
-    """Write kv [B, S, H, D] for positions [pos0, pos0+S) into the pool
-    [num_blocks, bs, H, D] through block_table [B, max_blocks]; pos0 and
-    true_len are [B]. Slots beyond true_len are dropped (their block id is
-    forced out of bounds). (reference: ragged_ops/linear_blocked_kv_copy)"""
-    nb, bs = pool.shape[0], pool.shape[1]
+def gather_pages(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """[num_blocks, bs, H, D] pool -> contiguous [B, smax, H, D] pages
+    (clamps OOB table slots)."""
+    b, max_blocks = block_tables.shape
+    bs, h, d = pool.shape[1:]
+    safe = jnp.minimum(block_tables, pool.shape[0] - 1)
+    return pool[safe].reshape(b, max_blocks * bs, h, d)
+
+
+def place_in_pages(pages: jax.Array, kv: jax.Array, pos0: jax.Array,
+                  true_len: jax.Array) -> jax.Array:
+    """Overwrite the gathered page view with this chunk's fresh k/v at
+    absolute positions [pos0, pos0+S) (invalid slots dropped). Keeps the
+    pool slabs out of the layer scan: attention sees up-to-date pages
+    while the bulk pool scatter happens once, after all layers."""
     b, s = kv.shape[:2]
-    positions = pos0[:, None] + jnp.arange(s)[None, :]        # [B, S]
-    blk = jnp.take_along_axis(block_table, positions // bs, axis=1)
-    off = positions % bs
-    # invalid slots (i >= true_len) -> OOB block id so the write drops
+    smax = pages.shape[1]
+    positions = pos0[:, None] + jnp.arange(s)[None, :]
     valid = jnp.arange(s)[None, :] < true_len[:, None]
-    blk = jnp.where(valid, blk, nb)
-    return pool.at[blk, off].set(kv.astype(pool.dtype), mode="drop")
+    positions = jnp.where(valid, positions, smax)  # OOB -> dropped
+    return pages.at[jnp.arange(b)[:, None], positions].set(
+        kv.astype(pages.dtype), mode="drop")
 
 
-def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
-                    block_tables: jax.Array, pos0: jax.Array,
+def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    pos0: jax.Array,
                     window: int | None = None):
-    """q: [B, S_new, H, D]; pools [num_blocks, bs, H_kv, D]; block_tables
-    [B, max_blocks]; pos0 [B] tokens already cached before this chunk.
-    Causal over absolute positions; ``window`` restricts lookback
-    (Mistral SWA). (reference: blocked_flash)"""
+    """q: [B, S_new, H, D]; k/v: gathered pages [B, smax, H_kv, D]
+    (already containing this chunk's fresh k/v); pos0 [B] tokens cached
+    before this chunk. Causal over absolute positions; ``window``
+    restricts lookback (Mistral SWA). (reference: blocked_flash)"""
     b, sq, hq, d = q.shape
-    bs = k_pool.shape[1]
-    hkv = k_pool.shape[2]
-    max_blocks = block_tables.shape[1]
-    smax = max_blocks * bs
-
-    # gather pages -> contiguous [B, smax, hkv, d] (clamp OOB table slots)
-    safe = jnp.minimum(block_tables, k_pool.shape[0] - 1)
-    k = k_pool[safe].reshape(b, smax, hkv, d)
-    v = v_pool[safe].reshape(b, smax, hkv, d)
+    smax = k.shape[1]
+    hkv = k.shape[2]
     if hq != hkv:
         rep = hq // hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -78,28 +82,55 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
 
     tokens [B, S]; pos0 [B]; block_tables [B, max_blocks]; true_len [B]
     actual new-token counts (padding beyond is masked). Returns
-    (logits [B, S, V], new_pools).
+    (last-valid-token logits [B, V], new_pools) — the vocab projection
+    runs only on each sequence's last pending token (the reference's
+    logits_gather kernel, fused into the step so continuous-batching
+    decode is one dispatch).
     """
     b, s = tokens.shape
     positions = pos0[:, None] + jnp.arange(s)[None, :]
     x = model.embed(params, tokens, positions=positions)
 
+    # The pool slabs never enter the scan: each layer gathers its pages
+    # (read-only), patches this chunk's fresh k/v into the gathered view
+    # for attention, and emits the small [B, S, H, D] chunk as a scan
+    # output; one bulk scatter after the scan writes all layers. Routing
+    # the [num_blocks, ...] slabs through scan xs/ys would copy the whole
+    # pool through HBM every step (~100x decode slowdown measured).
     def body(x, xs):
         p, k_pool, v_pool = xs
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
-        k_pool = scatter_kv(k_pool, k, block_tables, pos0, true_len)
-        v_pool = scatter_kv(v_pool, v, block_tables, pos0, true_len)
-        a = paged_attention(q, k_pool, v_pool, block_tables, pos0,
+        k_pages = place_in_pages(gather_pages(k_pool, block_tables), k,
+                                 pos0, true_len)
+        v_pages = place_in_pages(gather_pages(v_pool, block_tables), v,
+                                 pos0, true_len)
+        a = paged_attention(q, k_pages, v_pages, pos0,
                             window=model.config.sliding_window)
         if model.config.parallel_residual:
             m, _ = model._mlp(p, h)
-            return x + model._attn_out(p, a) + m, (k_pool, v_pool)
+            return x + model._attn_out(p, a) + m, (k, v)
         x = x + model._attn_out(p, a)
         x, _ = model._mlp_residual(p, x)
-        return x, (k_pool, v_pool)
+        return x, (k, v)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], pools["k"], pools["v"]))
-    logits = model.unembed(params, x)
-    return logits, {"k": new_k, "v": new_v}
+
+    # bulk scatter: all layers' chunk k/v into the pools in one update
+    nb, bs = pools["k"].shape[1], pools["k"].shape[2]
+    blk = jnp.take_along_axis(block_tables, positions // bs, axis=1)
+    off = positions % bs
+    valid = jnp.arange(s)[None, :] < true_len[:, None]
+    blk = jnp.where(valid, blk, nb)                     # OOB -> dropped
+    new_pools = {
+        "k": pools["k"].at[:, blk, off].set(
+            new_k.astype(pools["k"].dtype), mode="drop"),
+        "v": pools["v"].at[:, blk, off].set(
+            new_v.astype(pools["v"].dtype), mode="drop"),
+    }
+    # logits_gather: project only each row's last valid position
+    idx = jnp.clip(true_len - 1, 0, s - 1)
+    x_last = x[jnp.arange(b), idx]                      # [B, D]
+    logits = model.unembed(params, x_last[:, None, :])[:, 0]
+    return logits, new_pools

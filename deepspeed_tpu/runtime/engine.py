@@ -910,11 +910,15 @@ class DeepSpeedEngine:
         if device != "cpu":
             raise ValueError("offload_states supports device='cpu'")
         targets = set(include or ["optimizer_states", "hp_params"])
-        unknown = targets - {"optimizer_states", "hp_params"}
+        # reference OffloadStateTypeEnum members with no persistent
+        # buffers in the compiled-step design: accepted as no-ops
+        noop = {"lp_params", "lp_grads", "contiguous_grad_buffer"}
+        unknown = targets - {"optimizer_states", "hp_params"} - noop
         if unknown:
             raise ValueError(
                 f"offload_states: unknown include entries {sorted(unknown)}"
-                "; supported: optimizer_states, hp_params")
+                "; supported: optimizer_states, hp_params (lp_params/"
+                "lp_grads/contiguous_grad_buffer are no-ops here)")
         moved = {}
         if "optimizer_states" in targets:
             moved["opt_state"] = True
