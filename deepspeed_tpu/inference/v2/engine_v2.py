@@ -174,8 +174,13 @@ class InferenceEngineV2:
     def free_blocks(self) -> int:
         return self.state_manager.allocator.free_blocks
 
-    def flush(self, uid: int) -> None:
-        self.state_manager.flush(uid)
+    def flush(self, uids) -> None:
+        """Release finished sequences' KV blocks; accepts one uid or an
+        iterable (reference: engine_v2.flush:242 takes uids)."""
+        if isinstance(uids, (int, np.integer)):
+            uids = [uids]
+        for u in uids:
+            self.state_manager.flush(int(u))
 
     # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],

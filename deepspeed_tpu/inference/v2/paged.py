@@ -91,12 +91,12 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     positions = pos0[:, None] + jnp.arange(s)[None, :]
     x = model.embed(params, tokens, positions=positions)
 
-    # The pool slabs never enter the scan: each layer gathers its pages
-    # (read-only), patches this chunk's fresh k/v into the gathered view
-    # for attention, and emits the small [B, S, H, D] chunk as a scan
-    # output; one bulk scatter after the scan writes all layers. Routing
-    # the [num_blocks, ...] slabs through scan xs/ys would copy the whole
-    # pool through HBM every step (~100x decode slowdown measured).
+    # The pool slabs enter the scan only as read-only xs (per-layer
+    # slices): each layer gathers its pages, patches this chunk's fresh
+    # k/v into the gathered view for attention, and emits the small
+    # [B, S, H, D] chunk as a scan output; one bulk scatter after the
+    # scan writes all layers. Routing the slabs through the ys stream
+    # would copy the whole pool through HBM every step.
     def body(x, xs):
         p, k_pool, v_pool = xs
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
