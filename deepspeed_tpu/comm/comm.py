@@ -210,6 +210,67 @@ def ppermute(tensor, perm: Sequence[tuple[int, int]], *, group=None):
     return lax.ppermute(tensor, _axes(group), perm)
 
 
+@timed_op
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, *, group=None):
+    """Reduce to index ``dst`` along the group axis; other indices get
+    zeros (SPMD has no 'absent' result; reference: comm.py reduce).
+    Composite of undecorated primitives so the comms log counts it once."""
+    axes = _axes(group)
+    if op == ReduceOp.SUM:
+        full = lax.psum(tensor, axes)
+    elif op == ReduceOp.MAX:
+        full = lax.pmax(tensor, axes)
+    elif op == ReduceOp.MIN:
+        full = lax.pmin(tensor, axes)
+    elif op == ReduceOp.AVG:
+        full = lax.pmean(tensor, axes)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    idx = lax.axis_index(axes)
+    return jnp.where(idx == dst, full, jnp.zeros_like(full))
+
+
+@timed_op
+def gather(tensor, dst: int = 0, *, group=None):
+    """Gather shards to index ``dst`` (others get zeros); the gathered
+    tensor is stacked on a new leading axis (reference: comm.py gather)."""
+    axes = _axes(group)
+    g = lax.all_gather(tensor, axes, axis=0, tiled=False)
+    idx = lax.axis_index(axes)
+    return jnp.where(idx == dst, g, jnp.zeros_like(g))
+
+
+@timed_op
+def scatter(tensor, src: int = 0, *, group=None):
+    """Each index receives slice ``i`` of the leading axis of ``src``'s
+    tensor, which must equal the group size (reference: comm.py
+    scatter)."""
+    axes = _axes(group)
+    n = lax.psum(1, axes)   # static under jit
+    if tensor.shape[0] != n:
+        raise ValueError(
+            f"scatter: leading dim {tensor.shape[0]} != group size {n}")
+    idx = lax.axis_index(axes)
+    t = lax.psum(jnp.where(idx == src, tensor, jnp.zeros_like(tensor)),
+                 axes)
+    return jnp.take(t, idx, axis=0)
+
+
+@timed_op
+def send(tensor, dst: int, *, src: int = 0, group=None):
+    """Point-to-point (reference: comm.py send/recv). Under SPMD both
+    ends run the same program, so send and recv are one ppermute with a
+    single (src, dst) pair: index ``dst`` receives ``src``'s tensor,
+    every other index receives zeros."""
+    return lax.ppermute(tensor, _axes(group), [(src, dst)])
+
+
+@timed_op
+def recv(tensor, src: int, *, dst: int = 0, group=None):
+    """The receiving end of ``send`` (same collective; see send)."""
+    return lax.ppermute(tensor, _axes(group), [(src, dst)])
+
+
 def axis_index(group) -> jax.Array:
     return lax.axis_index(_axes(group))
 

@@ -2,7 +2,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.parallel.mesh import MeshTopology, TopologyConfig
@@ -101,3 +101,30 @@ def test_host_helpers():
     assert dist.get_rank() == 0
     dist.barrier()
     assert dist.host_all_reduce(3.0) == 3.0
+
+
+def test_reduce_gather_scatter_send(devices8):
+    """Extended collective surface (reference: comm.py reduce/gather/
+    scatter/send/recv)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+
+    def body():
+        me = jax.lax.axis_index("dp").astype(jnp.float32)
+        red = dist.reduce(me[None], dst=2, group="dp")     # sum -> idx 2
+        gat = dist.gather(me[None], dst=1, group="dp")     # stack -> idx 1
+        data = jnp.arange(8, dtype=jnp.float32)
+        sca = dist.scatter(data, src=0, group="dp")[None]  # slice i -> i
+        snt = dist.send(me[None], dst=3, src=5, group="dp")  # 5 -> 3
+        return red, gat, sca, snt
+
+    red, gat, sca, snt = shard_map(
+        body, mesh=mesh, in_specs=(),
+        out_specs=(P("dp"), P("dp"), P("dp"), P("dp")), check_vma=False)()
+    red = np.asarray(red)
+    assert red[2] == 28.0 and red[0] == 0.0
+    gat = np.asarray(gat).reshape(8, 8)
+    np.testing.assert_allclose(gat[1], np.arange(8))
+    assert gat[0].sum() == 0
+    np.testing.assert_allclose(np.asarray(sca), np.arange(8))
+    snt = np.asarray(snt)
+    assert snt[3] == 5.0 and snt[0] == 0.0
