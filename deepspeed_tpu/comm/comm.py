@@ -224,6 +224,9 @@ def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM, *, group=None):
         full = lax.pmin(tensor, axes)
     elif op == ReduceOp.AVG:
         full = lax.pmean(tensor, axes)
+    elif op == ReduceOp.PRODUCT:
+        gathered = lax.all_gather(tensor, axes, axis=0, tiled=False)
+        full = jnp.prod(gathered, axis=0)
     else:
         raise ValueError(f"unsupported reduce op {op}")
     idx = lax.axis_index(axes)
@@ -257,18 +260,19 @@ def scatter(tensor, src: int = 0, *, group=None):
 
 
 @timed_op
-def send(tensor, dst: int, *, src: int = 0, group=None):
-    """Point-to-point (reference: comm.py send/recv). Under SPMD both
-    ends run the same program, so send and recv are one ppermute with a
-    single (src, dst) pair: index ``dst`` receives ``src``'s tensor,
-    every other index receives zeros."""
+def send(tensor, *, src: int, dst: int, group=None):
+    """Point-to-point (reference: comm.py send/recv). Under SPMD there is
+    exactly ONE collective for a transfer: every index runs the same
+    ppermute and the RETURN VALUE at index ``dst`` is ``src``'s tensor
+    (zeros elsewhere). Do NOT call send and recv as a pair like eager
+    torch.distributed — ``recv`` is this same function (call either once
+    with the tensor being sent, and use the result); a second call would
+    transfer a second time. ``src``/``dst`` are required: the sender
+    cannot be inferred in a single-program model."""
     return lax.ppermute(tensor, _axes(group), [(src, dst)])
 
 
-@timed_op
-def recv(tensor, src: int, *, dst: int = 0, group=None):
-    """The receiving end of ``send`` (same collective; see send)."""
-    return lax.ppermute(tensor, _axes(group), [(src, dst)])
+recv = send  # SPMD: the same single collective serves both ends
 
 
 def axis_index(group) -> jax.Array:

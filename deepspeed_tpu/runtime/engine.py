@@ -666,6 +666,7 @@ class DeepSpeedEngine:
                 self.state, metrics = self._train_step(self.state, batch)
         self.global_steps += 1
         self.global_samples += self.train_batch_size_
+        self._last_metrics = metrics
         if self.global_steps % self.config.steps_per_print == 0:
             self.tput_timer.stop(sync=metrics["loss"])
             self._report(metrics)
@@ -874,7 +875,10 @@ class DeepSpeedEngine:
 
     # --- accessors (reference parity) ---------------------------------
     def get_global_grad_norm(self):
-        return None  # available in train metrics
+        """Gradient norm of the most recent step (reference:
+        engine.get_global_grad_norm)."""
+        m = getattr(self, "_last_metrics", None)
+        return float(m["grad_norm"]) if m is not None else None
 
     def zero_optimization(self) -> bool:
         return self.zero_stage > 0

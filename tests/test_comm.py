@@ -106,7 +106,7 @@ def test_host_helpers():
 def test_reduce_gather_scatter_send(devices8):
     """Extended collective surface (reference: comm.py reduce/gather/
     scatter/send/recv)."""
-    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("dp",))
+    mesh = Mesh(np.array(devices8).reshape(8), ("dp",))
 
     def body():
         me = jax.lax.axis_index("dp").astype(jnp.float32)
@@ -114,7 +114,7 @@ def test_reduce_gather_scatter_send(devices8):
         gat = dist.gather(me[None], dst=1, group="dp")     # stack -> idx 1
         data = jnp.arange(8, dtype=jnp.float32)
         sca = dist.scatter(data, src=0, group="dp")[None]  # slice i -> i
-        snt = dist.send(me[None], dst=3, src=5, group="dp")  # 5 -> 3
+        snt = dist.send(me[None], src=5, dst=3, group="dp")  # 5 -> 3
         return red, gat, sca, snt
 
     red, gat, sca, snt = shard_map(
