@@ -105,8 +105,15 @@ class ModelBasedTuner(BaseTuner):
             if len(self.records) < self.warmup:
                 out.append(self._untried.pop(0))
                 continue
-            X = np.stack([_features(e) for e, _ in self.records])
-            y = np.array([v for _, v in self.records])
+            # failed trials (e.g. OOM) are recorded as -inf; one
+            # non-finite target makes every lstsq coefficient NaN, so
+            # fit only on finite observations
+            finite = [(e, v) for e, v in self.records if np.isfinite(v)]
+            if len(finite) < self.warmup:
+                out.append(self._untried.pop(0))
+                continue
+            X = np.stack([_features(e) for e, _ in finite])
+            y = np.array([v for _, v in finite])
             coef, *_ = np.linalg.lstsq(X, y, rcond=None)
             preds = [float(_features(e) @ coef) for e in self._untried]
             idx = int(np.argmax(preds))

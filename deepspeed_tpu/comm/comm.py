@@ -259,20 +259,51 @@ def scatter(tensor, src: int = 0, *, group=None):
     return jnp.take(t, idx, axis=0)
 
 
+_p2p_calls_seen: dict = {}
+
+
+def _p2p_pairing_check(kind: str, src, dst, group) -> None:
+    """The eager torch idiom (send() on the source rank, recv() on the
+    destination) issues TWO independent collectives under SPMD — a double
+    transfer whose source-side recv result is zeros. Detect a program
+    that uses both entry points for the SAME transfer endpoints and warn
+    loudly once (send for one edge + recv for a different edge is a
+    legitimate pattern and stays silent)."""
+    key = (src, dst, repr(group))
+    kinds = _p2p_calls_seen.setdefault(key, set())
+    kinds.add(kind)
+    if len(kinds) == 2:
+        from ..utils.logging import warning_once
+        warning_once(
+            f"deepspeed_tpu.comm: both send() and recv() have been called "
+            f"for the same transfer (src={src}, dst={dst}). They are the "
+            f"SAME single SPMD collective — a send/recv pair per transfer "
+            f"(the eager torch.distributed idiom) transfers TWICE and the "
+            f"source-side recv result is zeros. Call exactly one of them "
+            f"per transfer and use its return value at dst.")
+
+
 @timed_op
 def send(tensor, *, src: int, dst: int, group=None):
     """Point-to-point (reference: comm.py send/recv). Under SPMD there is
     exactly ONE collective for a transfer: every index runs the same
     ppermute and the RETURN VALUE at index ``dst`` is ``src``'s tensor
     (zeros elsewhere). Do NOT call send and recv as a pair like eager
-    torch.distributed — ``recv`` is this same function (call either once
-    with the tensor being sent, and use the result); a second call would
-    transfer a second time. ``src``/``dst`` are required: the sender
-    cannot be inferred in a single-program model."""
+    torch.distributed — ``recv`` is this same collective (call either
+    once with the tensor being sent, and use the result); a second call
+    would transfer a second time. ``src``/``dst`` are required: the
+    sender cannot be inferred in a single-program model."""
+    _p2p_pairing_check("send", src, dst, group)
     return lax.ppermute(tensor, _axes(group), [(src, dst)])
 
 
-recv = send  # SPMD: the same single collective serves both ends
+@timed_op
+def recv(tensor, *, src: int, dst: int, group=None):
+    """Receive side of the single SPMD transfer — the SAME collective as
+    ``send``; see its docstring. Provided so destination-side code reads
+    naturally; never call both for one transfer."""
+    _p2p_pairing_check("recv", src, dst, group)
+    return lax.ppermute(tensor, _axes(group), [(src, dst)])
 
 
 def axis_index(group) -> jax.Array:

@@ -24,8 +24,13 @@ class LoRAConfig:
     offload_ratio: float = 0.0
     delay_lora_init: bool = False
     target_mods: List[str] = dataclasses.field(
-        default_factory=lambda: ["q_proj", "k_proj", "v_proj", "o_proj",
-                                 "gate_proj", "up_proj", "down_proj"])
+        default_factory=lambda: [
+            # HF-style names (external checkpoints / flax adapters)
+            "q_proj", "k_proj", "v_proj", "o_proj",
+            "gate_proj", "up_proj", "down_proj",
+            # this repo's DecoderLM weight names (models/transformer.py)
+            "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+        ])
 
 
 @dataclasses.dataclass

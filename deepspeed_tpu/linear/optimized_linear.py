@@ -93,8 +93,11 @@ def _target_paths(params: PyTree, cfg: LoRAConfig) -> list[str]:
     for path, leaf in jax.tree_util.tree_leaves_with_path(
             params, is_leaf=is_quantized):
         name = _path_str(path)
+        # match whole path components so "wq" targets layers/wq but not
+        # the 2-D bias stack layers/wq_b
+        parts = name.split("/")
         if getattr(leaf, "ndim", 0) in (2, 3) and \
-                any(t in name for t in cfg.target_mods):
+                any(t in parts for t in cfg.target_mods):
             out.append(name)
     return out
 
@@ -154,6 +157,17 @@ def lora_transform(params: PyTree, lora_config: LoRAConfig | None = None,
     frozen = jax.tree_util.tree_unflatten(
         jax.tree_util.tree_structure(params, is_leaf=is_quantized),
         [freeze(p, l, k) for (p, l), k in zip(leaves, keys)])
+
+    if not adapters:
+        wanted = (target_regex if target_regex is not None
+                  else cfg.target_mods)
+        raise ValueError(
+            f"lora_transform matched no weights: targets {wanted!r} name "
+            f"no 2-D/3-D leaf in the parameter tree. Training would "
+            f"silently optimize an empty adapter tree. Set "
+            f"LoRAConfig.target_mods (or target_regex) to names that "
+            f"appear in the model, e.g. wq/wk/wv/wo/w_gate/w_up/w_down "
+            f"for this repo's DecoderLM.")
 
     merge = make_merge_fn(cfg, stop_gradient=True)
     return frozen, LoRAState(adapters, cfg), merge

@@ -675,15 +675,15 @@ class DeepSpeedEngine:
         if self.monitor is not None:
             # reference event set (engine.py:2348 _write_monitor): loss,
             # lr, and the loss scale when fp16 is live
-            # lr of the step just applied: the optax count was
-            # global_steps - 1 when tx.update ran (overflow-skipped steps
-            # still advance global_steps, matching the reference's
-            # engine-side accounting)
+            # lr of the step just applied: the optax count only advances
+            # on applied (non-overflow) steps, so read it from the state
+            # rather than global_steps — otherwise the reported lr drifts
+            # ahead of the lr actually used after any skipped step
             events = [
                 ("Train/Samples/train_loss", float(metrics["loss"]),
                  self.global_samples),
                 ("Train/Samples/lr",
-                 float(self.lr_schedule(max(self.global_steps - 1, 0))),
+                 float(self.lr_schedule(max(self._applied_steps() - 1, 0))),
                  self.global_samples),
             ]
             if self.fp16_enabled:
@@ -693,8 +693,15 @@ class DeepSpeedEngine:
             self.monitor.write_events(events)
         return metrics["loss"]
 
+    def _applied_steps(self) -> int:
+        """Number of optimizer steps actually applied (the optax count) —
+        excludes overflow-skipped steps, unlike global_steps. Reads the
+        device counter, so callers should be paths that already sync
+        (monitor writes, user accessors) — not the hot step loop."""
+        return int(self.state["step"])
+
     def _report(self, metrics):
-        lr = float(self.lr_schedule(self.global_steps))
+        lr = float(self.lr_schedule(self._applied_steps()))
         log_dist(
             f"step={self.global_steps} loss={float(metrics['loss']):.4f} "
             f"lr={lr:.3e} grad_norm={float(metrics['grad_norm']):.3f}"
@@ -890,7 +897,7 @@ class DeepSpeedEngine:
         return self.micro_batch_size_
 
     def get_lr(self):
-        return [float(self.lr_schedule(self.global_steps))]
+        return [float(self.lr_schedule(self._applied_steps()))]
 
     @property
     def params(self):
