@@ -269,7 +269,14 @@ def _p2p_pairing_check(kind: str, src, dst, group) -> None:
     that uses both entry points for the SAME transfer endpoints and warn
     loudly once (send for one edge + recv for a different edge is a
     legitimate pattern and stays silent)."""
-    key = (src, dst, repr(group))
+    # key on the resolved axis names, not repr(group): an object repr
+    # embeds the id, so the same logical group built twice would get
+    # distinct keys and the check would silently miss the pair
+    try:
+        group_key = _axes(group)
+    except ValueError:
+        group_key = None
+    key = (src, dst, group_key)
     kinds = _p2p_calls_seen.setdefault(key, set())
     kinds.add(kind)
     if len(kinds) == 2:

@@ -208,6 +208,9 @@ class DecoderLM:
             else:
                 attn_fn = L.dot_product_attention
 
+        if c.remat and c.remat_policy == "segments":
+            return self._block_segmented(p, x, attn_fn, positions)
+
         h = self._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = self._qkv(p, h, positions)
         a = attn_fn(q, k, v, causal=True)
@@ -217,6 +220,48 @@ class DecoderLM:
             return x + self._attn_out(p, a) + m, aux
         x = x + self._attn_out(p, a)
         return self._mlp_residual(p, x)
+
+    def _block_segmented(self, p, x, attn_fn, positions):
+        """Segment remat: attention sits OUTSIDE any jax.checkpoint, so
+        its custom-VJP residuals (q, k, v, o, lse) are stored and the
+        backward never re-runs the forward flash kernel (custom_vjp under
+        remat re-executes its fwd rule — measured ~2ms/layer on v5e at
+        GPT-2 shapes). The projections around it are rematted in two
+        segments:
+
+        - seg_qkv (norm + qkv projection): saves nothing internally; its
+          outputs q/k/v are boundary values (= the flash residuals).
+        - seg_out (output proj + MLP): saves the mid-residual and the
+          pre-activation ffn tensors, so backward recomputes only norms
+          and the activation function — no matmul re-runs.
+
+        Net per-layer saves at [B=24, S=1024, D=768]: ~378MB vs ~302MB
+        for "save_attn_ffn", in exchange for skipping the flash rerun and
+        the attn-proj + up-matmul recomputes (~3.5ms/layer on v5e).
+        """
+        c = self.config
+        from jax.ad_checkpoint import checkpoint_name
+
+        def seg_qkv(p, x):
+            h = self._norm(x, p["ln1_scale"], p.get("ln1_bias"))
+            q, k, v = self._qkv(p, h, positions)
+            return q, k, v, (h if c.parallel_residual else None)
+
+        q, k, v, h = jax.checkpoint(seg_qkv, prevent_cse=False)(p, x)
+        a = attn_fn(q, k, v, causal=True)
+
+        def seg_out(p, x, a, h):
+            if c.parallel_residual:
+                m, aux = self._mlp(p, h)
+                return x + self._attn_out(p, a) + m, aux
+            x2 = x + self._attn_out(p, a)
+            x2 = checkpoint_name(x2, "resid_mid")
+            return self._mlp_residual(p, x2)
+
+        pol = jax.checkpoint_policies.save_only_these_names(
+            "resid_mid", "ffn_pre")
+        return jax.checkpoint(seg_out, prevent_cse=False, policy=pol)(
+            p, x, a, h)
 
     def _window_bias(self, seq_len: int) -> jax.Array:
         """Additive mask for sliding-window attention (Mistral): query i
@@ -232,14 +277,14 @@ class DecoderLM:
         from jax.ad_checkpoint import checkpoint_name
         c = self.config
         if c.activation == "swiglu":
-            gate = h @ p["w_gate"]
-            up = h @ p["w_up"]
+            gate = checkpoint_name(h @ p["w_gate"], "ffn_pre")
+            up = checkpoint_name(h @ p["w_up"], "ffn_pre")
             if c.use_bias:
                 gate = gate + p["w_gate_b"]
                 up = up + p["w_up_b"]
             m = L.silu(gate) * up
         else:
-            up = h @ p["w_up"]
+            up = checkpoint_name(h @ p["w_up"], "ffn_pre")
             if c.use_bias:
                 up = up + p["w_up_b"]
             m = L.gelu(up)
@@ -390,7 +435,10 @@ class DecoderLM:
                                       positions=positions)
             return (x, aux + layer_aux), None
 
-        if c.remat:
+        if c.remat and c.remat_policy != "segments":
+            # "segments" applies selective checkpoints INSIDE block()
+            # (attention outside remat); wrapping the whole body here
+            # would re-introduce the flash fwd rerun it exists to avoid
             body = jax.checkpoint(body, prevent_cse=False,
                                   policy=_remat_policy(c.remat_policy))
         (x, aux), _ = jax.lax.scan(

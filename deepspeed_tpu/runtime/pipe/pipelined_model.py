@@ -83,7 +83,11 @@ class PipelinedDecoderLM:
                 h, aux = carry
                 h, a = model.block(layer_p, h, attn_fn=attn_fn)
                 return (h, aux + a), None
-            if model.config.remat:
+            if model.config.remat and model.config.remat_policy != "segments":
+                # "segments" applies selective checkpoints inside block()
+                # (attention outside remat — keeps the flash residuals);
+                # wrapping the body would discard them and re-run the
+                # flash fwd kernel in backward (models/transformer.py)
                 body = jax.checkpoint(body, prevent_cse=False)
             (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
                                    stage_p)
