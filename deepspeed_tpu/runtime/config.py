@@ -187,6 +187,12 @@ class PipelineConfig(DeepSpeedConfigModel):
     stages: Literal["auto"] | int = "auto"
     partition_method: str = "parameters"
     activation_checkpoint_interval: int = 0
+    # "gpipe": differentiable scan, all-M schedule, per-device activation
+    #   memory ~ flat/pp, no recompute. "1f1b": reference TrainSchedule
+    #   parity (runtime/pipe/schedule.py:189) — in-flight <= pp
+    #   microbatches, stage inputs ring-buffered, backward recomputes the
+    #   stage forward per microbatch (Megatron-style checkpointing).
+    schedule: Literal["gpipe", "1f1b"] = "gpipe"
 
 
 class DataEfficiencyConfig(DeepSpeedConfigModel):

@@ -38,14 +38,21 @@ class PipelineEngine(DeepSpeedEngine):
         if pp <= 1:
             return module
         from .module import _SpecStack
+        from .pipelined_model import PipelinedSpecStack
         if isinstance(module, _SpecStack):
-            raise NotImplementedError(
-                "LayerSpec-list pipelines execute as one GSPMD program "
-                "(mesh.pp=1); stage-manual pipelining (pp>1) needs a "
-                "homogeneous layer stack — pass model=<DecoderLM-family>")
+            if self.config.pipeline.schedule != "gpipe":
+                from ...utils.logging import warning_once
+                warning_once(
+                    "pipeline.schedule=%r is not implemented for "
+                    "LayerSpec-list pipelines; running the gpipe "
+                    "schedule" % self.config.pipeline.schedule)
+            return PipelinedSpecStack(
+                module, self._pipe_module, self.mesh, num_stages=pp,
+                num_microbatches=self.gradient_accumulation_steps_)
         return PipelinedDecoderLM(
             module, self.mesh, num_stages=pp,
-            num_microbatches=self.gradient_accumulation_steps_)
+            num_microbatches=self.gradient_accumulation_steps_,
+            schedule=self.config.pipeline.schedule)
 
     @property
     def num_stages(self) -> int:

@@ -228,8 +228,16 @@ class _SpecStack:
         return params
 
     def apply(self, params, x, **kw):
+        return self.apply_range(params, x, 0,
+                                len(self._module.build_layers()))
+
+    def apply_range(self, params, x, lo: int, hi: int):
+        """Run layers [lo, hi) — the per-stage slice the pipelined
+        executor uses (reference: each rank builds/runs only its
+        partition, module.py:123)."""
         layers = self._module.build_layers()
-        for i, layer in enumerate(layers):
+        for i in range(lo, hi):
+            layer = layers[i]
             if hasattr(layer, "init"):
                 p = dict(params.get(f"layer_{i}", {}))
                 tied = self._module._tied_keys.get(i)

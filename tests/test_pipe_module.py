@@ -94,13 +94,20 @@ def test_tied_layer_specs_share_params():
     assert float(jnp.abs(g["tied_emb"]).max()) > 0
 
 
-def test_spec_pipeline_pp_gt_1_raises(devices8):
+def test_spec_pipeline_builds_at_pp2(devices8):
+    """LayerSpec lists execute stage-manual at pp>1 (reference
+    module.py:391); full numerics parity is covered in
+    test_pipeline.py::test_layerspec_pipeline_pp2."""
+    from deepspeed_tpu.runtime.pipe.pipelined_model import \
+        PipelinedSpecStack
     specs = [LayerSpec(Linear, 8, 8) for _ in range(4)]
     pm = PipelineModule(layers=specs, num_stages=2,
                         loss_fn=lambda y, t: jnp.mean((y - t) ** 2))
-    with pytest.raises(NotImplementedError):
-        ds.initialize(model=pm,
-                      config={"train_batch_size": 16,
-                              "optimizer": {"type": "AdamW",
-                                            "params": {"lr": 1e-3}},
-                              "mesh": {"pp": 2, "fsdp": -1}})
+    e, _, _, _ = ds.initialize(
+        model=pm,
+        config={"train_batch_size": 16,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"pp": 2, "fsdp": -1}})
+    assert isinstance(e.module, PipelinedSpecStack)
+    assert e.module.bounds == [0, 2, 4]
