@@ -65,17 +65,45 @@ class InferenceEngineV2:
         self.state_manager = DSStateManager(
             block_size=bs, num_blocks=config.num_kv_blocks,
             max_blocks_per_seq=max_blocks_per_seq)
+        # logits of sequences finished as a side effect of another
+        # caller's drain loop, held for their owner's next tick()
+        self._finished_stash: dict[int, jnp.ndarray] = {}
         pool_shape = (c.num_layers, config.num_kv_blocks, bs,
                       c.num_kv_heads, c.head_dim)
-        self.pools = {"k": jnp.zeros(pool_shape, self.dtype),
-                      "v": jnp.zeros(pool_shape, self.dtype)}
-        # one jit; XLA caches one executable per bucket shape. put() is
+
+        # TP serving (reference: model_implementations/sharding/): the
+        # KV pools shard over the kv-heads dim of the v1 engine's tp
+        # mesh; params are already tp-sharded by the v1 layer, so GSPMD
+        # propagates head sharding through qkv/attention and inserts the
+        # output-projection all-reduce.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        self.mesh = self._v1.mesh
+        tp = self._v1.topology.model_parallel_size
+        if tp > 1 and c.num_kv_heads % tp != 0:
+            from ...utils.logging import warning_once
+            warning_once(
+                f"inference v2: num_kv_heads {c.num_kv_heads} not "
+                f"divisible by tp={tp}; KV pools stay replicated")
+            pool_spec = P()
+        elif tp > 1:
+            pool_spec = P(None, None, None, "tp", None)
+        else:
+            pool_spec = P()
+        self._pool_sharding = NamedSharding(self.mesh, pool_spec)
+        self.pools = jax.device_put(
+            {"k": jnp.zeros(pool_shape, self.dtype),
+             "v": jnp.zeros(pool_shape, self.dtype)},
+            {"k": self._pool_sharding, "v": self._pool_sharding})
+        # one jit; XLA caches one executable per bucket shape. tick() is
         # one dispatch per scheduler tick (logits_gather fused into the
         # step); for generation loops where per-dispatch latency matters
         # more than admission control, the v1/hybrid engines compile the
         # whole decode loop into a single program instead.
-        self._step = jax.jit(functools.partial(paged_forward, self.model),
-                             donate_argnums=(1,))
+        self._step = jax.jit(
+            functools.partial(paged_forward, self.model),
+            donate_argnums=(1,),
+            out_shardings=(None, {"k": self._pool_sharding,
+                                  "v": self._pool_sharding}))
         # SplitFuse budget, floored to a power of two (bucket shapes must
         # never exceed the configured compute budget)
         self._chunk = 1 << (max(1, config.max_chunk_size).bit_length() - 1)
@@ -118,12 +146,12 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------------
     # reference API
-    def put(self, batch_uids: Sequence[int],
-            batch_tokens: Sequence[Sequence[int]],
-            do_checks: bool = True) -> jnp.ndarray:
-        """Schedule new tokens for the given sequences and run the engine
-        until they are all in-cache; returns last-token logits [n, V]
-        (reference: engine_v2.put:107)."""
+    def schedule(self, batch_uids: Sequence[int],
+                 batch_tokens: Sequence[Sequence[int]],
+                 do_checks: bool = True) -> None:
+        """Admit new tokens into the sequence state (KV blocks reserved,
+        no compute) — the scheduling half of the reference's put():107.
+        Raises before any state mutation if the batch cannot fit."""
         uids = [int(u) for u in batch_uids]
         mgr = self.state_manager
         if do_checks:
@@ -147,16 +175,49 @@ class InferenceEngineV2:
                     "exhausted (flush finished sequences)")
         for u, toks in zip(uids, batch_tokens):
             mgr.extend(u, list(map(int, toks)))
-        # SplitFuse: long prompts run in chunk-sized pieces; collect each
-        # sequence's logits from the chunk in which it finished
-        final: dict[int, jnp.ndarray] = {}
-        run_uids = uids
-        while run_uids:
+
+    def tick(self) -> dict[int, jnp.ndarray]:
+        """ONE scheduler tick (the compute half of the reference's
+        put():107): a single bucketed forward over every sequence with
+        pending tokens — prefill chunks (SplitFuse budget) and the decode
+        batch ride the same pass. Returns {uid: last-token logits} for
+        sequences whose pending tokens finished this tick (including any
+        stashed by a concurrent put() that drained them as a side
+        effect). Callers may schedule() new sequences between ticks —
+        mid-prompt admission, which folding the loop into put() would
+        forfeit."""
+        mgr = self.state_manager
+        out = dict(self._finished_stash)
+        self._finished_stash.clear()
+        run_uids = [u for u, s in mgr.seqs.items() if s.pending]
+        run_uids = run_uids[:self._config.max_ragged_sequence_count]
+        if run_uids:
             logits = self._run(run_uids)
-            for i, u in enumerate(run_uids):
-                if not mgr.seqs[u].pending:
-                    final[u] = logits[i]
-            run_uids = [u for u in run_uids if mgr.seqs[u].pending]
+            out.update({u: logits[i] for i, u in enumerate(run_uids)
+                        if not mgr.seqs[u].pending})
+        return out
+
+    def put(self, batch_uids: Sequence[int],
+            batch_tokens: Sequence[Sequence[int]],
+            do_checks: bool = True) -> jnp.ndarray:
+        """schedule() + tick()-until-drained for the given sequences;
+        returns last-token logits [n, V] in uid order (the reference
+        put():107 plus the caller loop DeepSpeed-MII wraps around it).
+        Use schedule()/tick() directly for inter-tick admission."""
+        uids = [int(u) for u in batch_uids]
+        uid_set = set(uids)
+        self.schedule(uids, batch_tokens, do_checks)
+        mgr = self.state_manager
+        final: dict[int, jnp.ndarray] = {}
+        while any(mgr.seqs[u].pending for u in uids):
+            for u, lg in self.tick().items():
+                if u in uid_set:
+                    final[u] = lg
+                else:
+                    # a sequence someone else schedule()d finished as a
+                    # side effect of our drain: stash its logits for
+                    # that caller's next tick() instead of dropping them
+                    self._finished_stash[u] = lg
         return jnp.stack([final[u] for u in uids])
 
     def query(self, uid: int) -> tuple[int, int]:
@@ -181,14 +242,16 @@ class InferenceEngineV2:
             uids = [uids]
         for u in uids:
             self.state_manager.flush(int(u))
+            self._finished_stash.pop(int(u), None)
 
     # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 32) -> list[list[int]]:
-        """Greedy continuous batching driver: admits prompts as KV blocks
-        free up, decodes all live sequences together each step — what
-        DeepSpeed-MII implements on top of put() (reference:
-        mii serving loop)."""
+        """Greedy continuous batching driver over schedule()/tick():
+        admits prompts as KV blocks free up — including mid-prefill of
+        other prompts, since admission happens between ticks — and
+        decodes all live sequences together each tick. What DeepSpeed-MII
+        implements on top of put() (reference: mii serving loop)."""
         mgr = self.state_manager
         bs = mgr.block_size
         pending = list(enumerate([list(map(int, p)) for p in prompts]))
@@ -200,7 +263,7 @@ class InferenceEngineV2:
         def admit():
             """Admit as many pending prompts as fit, reserving each one's
             worst-case block budget so live sequences can never exhaust
-            the pool mid-decode; admitted prompts prefill as ONE batch."""
+            the pool mid-decode."""
             batch: list[tuple[int, list[int]]] = []
             allocated = sum(len(mgr.seqs[u].blocks) for u in live)
             headroom = (mgr.allocator.free_blocks
@@ -221,10 +284,10 @@ class InferenceEngineV2:
                 reserved[uid] = need
                 batch.append((uid, prompt))
             if batch:
-                logits = self.put([u for u, _ in batch],
-                                  [p for _, p in batch])
-                for i, (uid, _) in enumerate(batch):
-                    live[uid] = [int(jnp.argmax(logits[i]))]
+                self.schedule([u for u, _ in batch],
+                              [p for _, p in batch])
+                for uid, _ in batch:
+                    live[uid] = []
 
         admit()
         while live or pending:
@@ -235,13 +298,25 @@ class InferenceEngineV2:
                         "continuous-batching deadlock: pending prompts "
                         "but nothing admissible")
                 continue
-            uids = sorted(live)
-            logits = self.put(uids, [[live[u][-1]] for u in uids])
-            for i, u in enumerate(uids):
-                live[u].append(int(jnp.argmax(logits[i])))
+            # one tick advances every pending sequence one chunk; a
+            # sequence whose pending drained yields logits -> sample
+            finished = self.tick()
+            decode_uids: list[int] = []
+            for u in sorted(finished):
+                if u not in live:
+                    # not ours (scheduled by another caller): re-stash
+                    self._finished_stash[u] = finished[u]
+                    continue
+                live[u].append(int(jnp.argmax(finished[u])))
                 if len(live[u]) >= max_new_tokens:
                     results[u] = live.pop(u)[:max_new_tokens]
                     reserved.pop(u)
                     self.flush(u)
+                else:
+                    decode_uids.append(u)
+            if decode_uids:
+                self.schedule(decode_uids,
+                              [[live[u][-1]] for u in decode_uids],
+                              do_checks=False)  # blocks pre-reserved
             admit()
         return [results[i] for i in range(len(prompts))]

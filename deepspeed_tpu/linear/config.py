@@ -35,8 +35,11 @@ class LoRAConfig:
 
 @dataclasses.dataclass
 class QuantizationConfig:
-    """reference: linear/config.py:37. q_bits in {4, 6, 8}; group_size is
-    elements per quantization block."""
+    """reference: linear/config.py:37. q_format "int": q_bits in {4,6,8}
+    symmetric int codes; "fp": q_bits in {6,8,12} float formats
+    (ops/fp_quant.py — native float8 at 8 bits, bit-packed fp6/fp12).
+    group_size is elements per quantization block."""
     q_bits: int = 8
     mantissa_bits: int = 3
     group_size: int = 512
+    q_format: str = "int"     # "int" | "fp"

@@ -1,12 +1,20 @@
 """Quantized frozen parameters (reference: deepspeed/linear/quantization.py
-QuantizedParameter + csrc/fp_quantizer — FP6/INT8 weight storage with
-on-the-fly dequantization).
+QuantizedParameter + csrc/fp_quantizer — FP6/FP8/FP12/INT8 weight storage
+with on-the-fly dequantization).
 
-A ``QuantizedParameter`` is a pytree-registered container of int8 codes +
+A ``QuantizedParameter`` is a pytree-registered container of codes +
 per-block scales. It lives inside a parameter tree like a regular leaf
 pair and dequantizes inside jit right before the matmul — XLA fuses the
 dequant into the GEMM prologue, which is the TPU counterpart of the
-reference's fused dequant kernels (fp_quantize.cu selective dequant)."""
+reference's fused dequant kernels (fp_quantize.cu selective dequant).
+
+Two storage families (``QuantizationConfig.q_format``):
+
+- ``"int"`` — symmetric int block quant at 4/6/8 bits (int8 codes).
+- ``"fp"``  — float formats via ops/fp_quant.py: native jnp.float8
+  (e4m3/e5m2) at 8 bits, bit-packed fp6/fp12 otherwise — the reference's
+  FP6-LLM storage (csrc/fp_quantizer/fp_quantize.cu).
+"""
 
 from __future__ import annotations
 
@@ -22,17 +30,20 @@ from .config import QuantizationConfig
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedParameter:
-    """int8/intX block-quantized tensor (reference: quantization.py:27)."""
+    """Block-quantized tensor (reference: quantization.py:27)."""
 
-    codes: jax.Array          # int8 [nblocks, group_size]
+    codes: jax.Array          # int8 [nblocks, group] | float8 | packed u8
     scales: jax.Array         # f32  [nblocks, 1]
     shape: tuple = ()         # original shape (static)
     dtype: Any = jnp.float32  # original dtype (static)
     q_bits: int = 8           # static
+    q_format: str = "int"     # "int" | "fp" (static)
+    mantissa_bits: int = 3    # static; fp formats only
 
     def tree_flatten(self):
         return (self.codes, self.scales), (self.shape, self.dtype,
-                                           self.q_bits)
+                                           self.q_bits, self.q_format,
+                                           self.mantissa_bits)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -42,6 +53,12 @@ class QuantizedParameter:
     def dequantized(self) -> jax.Array:
         """reference: QuantizedParameter.dequantized()"""
         import math
+        if self.q_format == "fp":
+            from ..ops.fp_quant import fp_dequantize
+            return fp_dequantize(
+                self.codes, self.scales, q_bits=self.q_bits,
+                mantissa_bits=self.mantissa_bits, shape=self.shape,
+                dtype=self.dtype)
         x = self.codes.astype(jnp.float32) * self.scales
         n = math.prod(self.shape) if self.shape else 1
         return x.reshape(-1)[:n].reshape(self.shape).astype(self.dtype)
@@ -54,8 +71,16 @@ class QuantizedParameter:
 def quantize_param(x: jax.Array,
                    cfg: QuantizationConfig | None = None
                    ) -> QuantizedParameter:
-    """Symmetric block quantization at cfg.q_bits (8/6/4)."""
+    """Block quantization per cfg: int 4/6/8, or float 6/8/12
+    (q_format="fp")."""
     cfg = cfg or QuantizationConfig()
+    if cfg.q_format == "fp":
+        from ..ops.fp_quant import fp_quantize
+        codes, scales = fp_quantize(
+            x, q_bits=cfg.q_bits, mantissa_bits=cfg.mantissa_bits,
+            group_size=cfg.group_size)
+        return QuantizedParameter(codes, scales, tuple(x.shape), x.dtype,
+                                  cfg.q_bits, "fp", cfg.mantissa_bits)
     if cfg.q_bits not in (4, 6, 8):
         raise ValueError(f"q_bits must be 4, 6 or 8, got {cfg.q_bits}")
     qmax = 2 ** (cfg.q_bits - 1) - 1

@@ -1,0 +1,183 @@
+"""Float quantization formats: fp8 (e4m3/e5m2), packed fp6, packed fp12
+(reference: csrc/fp_quantizer/fp_quantize.{cpp,cu} — FP6-LLM-style weight
+storage with per-block scales, and deepspeed/ops/fp_quantizer/ FP_Quantize
+wrappers).
+
+TPU translation:
+
+- **fp8** uses the native ``jnp.float8_e4m3fn`` / ``float8_e5m2`` dtypes:
+  a block scale maps each block's absmax onto the format's max normal,
+  then a plain dtype cast rounds — storage is a real float8 array XLA can
+  feed directly to dequant-fused matmuls.
+- **fp6 / fp12** have no native dtype; values are rounded to the nearest
+  representable magnitude with a static sorted table + ``searchsorted``
+  (branchless, vectorized — the role of the reference's bit-twiddling
+  device kernels), encoded as sign<<(bits-1) | magnitude-index, and
+  bit-packed: four 6-bit codes into 3 bytes, two 12-bit codes into 3
+  bytes. Dequantization is a table gather + scale multiply, which XLA
+  fuses into the consuming op.
+
+Formats follow the reference's (exp, man) splits: fp6 = e3m2 or e2m3
+(``mantissa_bits``), fp8 = e4m3 or e5m2, fp12 = e4m7.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def fp_magnitude_table(exp_bits: int, man_bits: int) -> np.ndarray:
+    """Sorted non-negative magnitudes of a sign+exp+man minifloat
+    (IEEE-style: subnormals at e_field=0, normals elsewhere, no
+    inf/nan — the reference's formats saturate instead)."""
+    bias = 2 ** (exp_bits - 1) - 1
+    vals = []
+    for e in range(2 ** exp_bits):
+        for m in range(2 ** man_bits):
+            if e == 0:  # subnormal
+                v = (m / 2 ** man_bits) * 2.0 ** (1 - bias)
+            else:
+                v = (1 + m / 2 ** man_bits) * 2.0 ** (e - bias)
+            vals.append(v)
+    return np.asarray(sorted(set(vals)), np.float32)
+
+
+_FORMATS = {  # q_bits -> {mantissa_bits: exp_bits}
+    6: {2: 3, 3: 2},
+    8: {3: 4, 2: 5},
+    12: {7: 4},
+}
+
+
+def _table(q_bits: int, man_bits: int) -> np.ndarray:
+    try:
+        exp_bits = _FORMATS[q_bits][man_bits]
+    except KeyError:
+        raise ValueError(
+            f"unsupported float format: q_bits={q_bits} "
+            f"mantissa_bits={man_bits}; supported: "
+            + ", ".join(f"{b}:{sorted(m)}" for b, m in _FORMATS.items()))
+    return fp_magnitude_table(exp_bits, man_bits)
+
+
+# ------------------------------------------------------------------ pack
+def _pack(codes: jax.Array, q_bits: int) -> jax.Array:
+    """[..., k] int32 codes -> packed uint8. 6-bit: 4 codes/3 bytes;
+    12-bit: 2 codes/3 bytes; 8-bit: identity."""
+    if q_bits == 8:
+        return codes.astype(jnp.uint8)
+    c = codes.astype(jnp.uint32)
+    if q_bits == 6:
+        c4 = c.reshape(*c.shape[:-1], -1, 4)
+        b0 = (c4[..., 0] | (c4[..., 1] << 6)) & 0xFF
+        b1 = ((c4[..., 1] >> 2) | (c4[..., 2] << 4)) & 0xFF
+        b2 = ((c4[..., 2] >> 4) | (c4[..., 3] << 2)) & 0xFF
+        return jnp.stack([b0, b1, b2], axis=-1).reshape(
+            *c.shape[:-1], -1).astype(jnp.uint8)
+    if q_bits == 12:
+        c2 = c.reshape(*c.shape[:-1], -1, 2)
+        b0 = c2[..., 0] & 0xFF
+        b1 = ((c2[..., 0] >> 8) | ((c2[..., 1] & 0xF) << 4)) & 0xFF
+        b2 = (c2[..., 1] >> 4) & 0xFF
+        return jnp.stack([b0, b1, b2], axis=-1).reshape(
+            *c.shape[:-1], -1).astype(jnp.uint8)
+    raise ValueError(f"q_bits {q_bits}")
+
+
+def _unpack(packed: jax.Array, q_bits: int) -> jax.Array:
+    if q_bits == 8:
+        return packed.astype(jnp.int32)
+    b = packed.astype(jnp.uint32).reshape(*packed.shape[:-1], -1, 3)
+    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
+    if q_bits == 6:
+        c0 = b0 & 0x3F
+        c1 = ((b0 >> 6) | (b1 << 2)) & 0x3F
+        c2 = ((b1 >> 4) | (b2 << 4)) & 0x3F
+        c3 = (b2 >> 2) & 0x3F
+        out = jnp.stack([c0, c1, c2, c3], axis=-1)
+    elif q_bits == 12:
+        c0 = (b0 | ((b1 & 0xF) << 8)) & 0xFFF
+        c1 = ((b1 >> 4) | (b2 << 4)) & 0xFFF
+        out = jnp.stack([c0, c1], axis=-1)
+    else:
+        raise ValueError(f"q_bits {q_bits}")
+    return out.reshape(*packed.shape[:-1], -1).astype(jnp.int32)
+
+
+# ------------------------------------------------------------ quantize
+def fp_quantize(x: jax.Array, *, q_bits: int = 8, mantissa_bits: int = 3,
+                group_size: int = 512):
+    """Block-scaled float quantization. Returns (codes, scales):
+
+    - q_bits=8: codes are a native jnp.float8 array [nblocks, group]
+    - q_bits=6/12: codes are packed uint8 [nblocks, group*q_bits/8]
+
+    scales: f32 [nblocks, 1]; each block's absmax maps to the format max.
+    """
+    n = x.size
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, (-n) % group_size))
+    blocks = flat.reshape(-1, group_size)
+    amax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+
+    if q_bits == 8:
+        _table(8, mantissa_bits)   # validate the format before the cast
+        dt = (jnp.float8_e4m3fn if mantissa_bits == 3 else jnp.float8_e5m2)
+        fmax = float(jnp.finfo(dt).max)
+        scales = jnp.maximum(amax / fmax, 1e-12)
+        codes = (blocks / scales).astype(dt)
+        return codes, scales
+
+    table = _table(q_bits, mantissa_bits)
+    fmax = float(table[-1])
+    scales = jnp.maximum(amax / fmax, 1e-12)
+    y = blocks / scales
+    mags = jnp.abs(y)
+    # round-to-nearest over the sorted magnitude table
+    mids = jnp.asarray((table[1:] + table[:-1]) / 2)
+    idx = jnp.searchsorted(mids, mags)
+    sign = (y < 0).astype(jnp.int32)
+    codes = (sign << (q_bits - 1)) | idx.astype(jnp.int32)
+    return _pack(codes, q_bits), scales
+
+
+def fp_dequantize(codes: jax.Array, scales: jax.Array, *, q_bits: int = 8,
+                  mantissa_bits: int = 3, shape=None,
+                  dtype=jnp.float32) -> jax.Array:
+    """Inverse of fp_quantize; `shape` trims the block padding."""
+    if q_bits == 8:
+        x = codes.astype(jnp.float32) * scales
+    else:
+        table = _table(q_bits, mantissa_bits)
+        c = _unpack(codes, q_bits)
+        mag_idx = c & (2 ** (q_bits - 1) - 1)
+        sign = jnp.where((c >> (q_bits - 1)) > 0, -1.0, 1.0)
+        x = sign * jnp.take(jnp.asarray(table), mag_idx) * scales
+    if shape is not None:
+        import math
+        n = math.prod(shape) if shape else 1
+        x = x.reshape(-1)[:n].reshape(shape)
+    return x.astype(dtype)
+
+
+class FP_Quantize:
+    """API-parity wrapper (reference: deepspeed/ops/fp_quantizer/quantize.py
+    FP_Quantize.quantize/dequantize with q_bits 6/8/12)."""
+
+    def __init__(self, group_size: int = 512):
+        self.group_size = group_size
+
+    def quantize(self, x, q_bits: int = 8, q_mantisa_bits: int = 3):
+        return fp_quantize(x, q_bits=q_bits, mantissa_bits=q_mantisa_bits,
+                           group_size=self.group_size)
+
+    def dequantize(self, codes, scales, q_bits: int = 8,
+                   q_mantisa_bits: int = 3, shape=None,
+                   dtype=jnp.float32):
+        return fp_dequantize(codes, scales, q_bits=q_bits,
+                             mantissa_bits=q_mantisa_bits, shape=shape,
+                             dtype=dtype)

@@ -98,19 +98,41 @@ def dequantize_int8(q, s, meta, use_pallas: bool | None = None):
     return x.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
-def quantized_all_gather(x, axes, dim: int = 0):
-    """ZeRO++ qwZ: quantize the local shard, all-gather int8 + scales along
-    mesh ``axes``, dequantize, and reassemble on ``dim``. Must run inside
-    shard_map (reference: partition_parameters.py:761 CUDAQuantizer
-    bracketing the param all-gather)."""
+def quantize_fp8(x):
+    """fp8-e4m3 block quantization: native float8 codes + f32 scales.
+    Same contract as quantize_int8 — a thin meta adapter over
+    ops/fp_quant.fp_quantize (single source of truth for the fp
+    formats; reference analogue: csrc/fp_quantizer/fp_quantize.cu)."""
+    from ..fp_quant import fp_quantize
+    q, s = fp_quantize(x, q_bits=8, mantissa_bits=3, group_size=QBLOCK)
+    return q, s, (x.shape, x.dtype, x.size)
+
+
+def dequantize_fp8(q, s, meta):
+    shape, dtype, n = meta
+    x = q.astype(jnp.float32) * s
+    return x.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def _wire_quantizer(wire_dtype: str):
+    if wire_dtype == "fp8":
+        return quantize_fp8, dequantize_fp8
+    return (lambda x: quantize_int8(x, use_pallas=False),
+            lambda q, s, m: dequantize_int8(q, s, m, use_pallas=False))
+
+
+def quantized_all_gather(x, axes, dim: int = 0, wire_dtype: str = "int8"):
+    """ZeRO++ qwZ: quantize the local shard, all-gather int8/fp8 codes +
+    scales along mesh ``axes``, dequantize, and reassemble on ``dim``.
+    Must run inside shard_map (reference: partition_parameters.py:761
+    CUDAQuantizer bracketing the param all-gather)."""
     from jax import lax
 
-    q, s, meta = quantize_int8(x, use_pallas=False)  # inside shard_map: jnp
+    quant, dequant = _wire_quantizer(wire_dtype)
+    q, s, meta = quant(x)                       # inside shard_map: jnp
     qg = lax.all_gather(q, axes, axis=0, tiled=False)
     sg = lax.all_gather(s, axes, axis=0, tiled=False)
-    pieces = jax.vmap(
-        lambda qq, ss: dequantize_int8(qq, ss, meta, use_pallas=False)
-    )(qg, sg)                                   # [world, *local_shape]
+    pieces = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qg, sg)
     world = pieces.shape[0]
     out = jnp.moveaxis(pieces, 0, dim)          # [..., world, shard, ...]
     shape = list(x.shape)

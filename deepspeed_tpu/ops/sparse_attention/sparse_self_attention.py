@@ -17,7 +17,7 @@ upgrade path.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,7 @@ class SparseSelfAttention:
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
         self._bias_cache: dict[int, jax.Array] = {}
+        self._kernel_cache: dict[tuple, Any] = {}
 
     def _bias(self, seq_len: int) -> jax.Array:
         if seq_len not in self._bias_cache:
@@ -60,12 +61,35 @@ class SparseSelfAttention:
                 layout, self.sparsity_config.block)
         return self._bias_cache[seq_len]
 
+    def _kernel(self, seq_len: int, heads: int, head_dim: int):
+        """Cached block-skipping kernel closure per shape (stable
+        function identity keeps jit caches warm in eager serving
+        loops); None when the kernel path doesn't apply."""
+        key = (seq_len, heads, head_dim)
+        if key not in self._kernel_cache:
+            from .kernels import make_block_sparse_attention, \
+                supports_kernel
+            layout = self.sparsity_config.make_layout(seq_len)[:heads]
+            self._kernel_cache[key] = (
+                make_block_sparse_attention(layout, head_dim)
+                if supports_kernel(layout, seq_len, head_dim) else None)
+        return self._kernel_cache[key]
+
     def __call__(self, query: jax.Array, key: jax.Array, value: jax.Array,
                  rpe: Optional[jax.Array] = None,
                  key_padding_mask: Optional[jax.Array] = None,
                  attn_mask: Optional[jax.Array] = None) -> jax.Array:
-        """q/k/v: [batch, heads, seq, head_dim] (reference layout)."""
+        """q/k/v: [batch, heads, seq, head_dim] (reference layout).
+
+        With no rpe/masks the block-skipping Pallas kernel runs (work
+        proportional to the live blocks — the reference's Triton SDD/DSD
+        path, kernels.py); extra biases/masks fall back to the fused
+        dense+mask form."""
         b, h, s, d = query.shape
+        if rpe is None and key_padding_mask is None and attn_mask is None:
+            fn = self._kernel(s, h, d)
+            if fn is not None:
+                return fn(query, key, value)
         bias = self._bias(s)[:h]
         scores = jnp.einsum("bhqd,bhkd->bhqk", query, key) / jnp.sqrt(d)
         scores = scores + bias[None].astype(scores.dtype)

@@ -71,6 +71,9 @@ class ZeroConfig(DeepSpeedConfigModel):
     zero_hpz_partition_size: int = 1  # ZeRO++ hierarchical partition
     zero_quantized_weights: bool = False  # ZeRO++ qwZ
     zero_quantized_gradients: bool = False  # ZeRO++ qgZ
+    # wire format for qwZ/qgZ payloads: int8 (reference CUDAQuantizer) or
+    # fp8 e4m3 (native float8 dtype; this build's extension)
+    zero_quantized_dtype: Literal["int8", "fp8"] = "int8"
     mics_shard_size: int = -1  # MiCS sub-cluster size (ref zero/config.py)
     mics_hierarchical_params_gather: bool = False
     round_robin_gradients: bool = False

@@ -409,7 +409,8 @@ class DeepSpeedEngine:
         return quantized_value_and_grad(
             micro_loss, self.mesh, self.plan.param_specs,
             self.plan.grad_specs, self.topology.batch_axes(),
-            quantize_weights=qw, quantize_gradients=qg)
+            quantize_weights=qw, quantize_gradients=qg,
+            wire_dtype=zcfg.zero_quantized_dtype)
 
     def _build_train_step(self):
         ga = self._scan_ga or self.gradient_accumulation_steps_

@@ -63,9 +63,10 @@ def _sharded_dims(spec: PartitionSpec) -> list[tuple[int, tuple[str, ...]]]:
 
 
 def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
-                             dim: int) -> jax.Array:
+                             dim: int,
+                             wire_dtype: str = "int8") -> jax.Array:
     """qgZ: chunk `g` (full-size local gradient) along `dim`, quantize each
-    chunk, exchange with one int8 all-to-all, dequantize + sum received
+    chunk, exchange with one int8/fp8 all-to-all, dequantize + sum received
     chunks. Returns this device's gradient shard (SUM semantics). Must run
     inside shard_map.
 
@@ -74,13 +75,18 @@ def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
     TPU the single all-to-all already rides ICI neighbor links, and
     hierarchy comes from the zps mesh split instead.
     """
+    from ..ops.pallas.quantization import quantize_fp8
+
     world = lax.psum(1, axes)  # mesh axis size: static under jit
     # chunk along dim: [world, ...chunk...]; quantize each chunk
     # independently so no block straddles a chunk boundary
     chunks = jnp.stack(jnp.split(g, world, axis=dim), axis=0)
 
     def quant_chunk(c):
-        q, s, _ = quantize_int8(c, use_pallas=False)
+        if wire_dtype == "fp8":
+            q, s, _ = quantize_fp8(c)
+        else:
+            q, s, _ = quantize_int8(c, use_pallas=False)
         return q, s
 
     q, s = jax.vmap(quant_chunk)(chunks.reshape(world, -1))
@@ -92,23 +98,25 @@ def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
     return summed[: int(np.prod(m))].reshape(m).astype(g.dtype)
 
 
-def _gather_param(x, spec, quantized: bool):
+def _gather_param(x, spec, quantized: bool, wire_dtype: str = "int8"):
     """Reassemble a full parameter from its local shard inside shard_map."""
     for dim, axes in _sharded_dims(spec):
         if quantized and x.size >= MIN_QUANT_SIZE:
-            x = quantized_all_gather(x, axes, dim)
+            x = quantized_all_gather(x, axes, dim, wire_dtype=wire_dtype)
         else:
             x = lax.all_gather(x, axes, axis=dim, tiled=True)
     return x
 
 
-def _reduce_grad(g, spec, batch_axes, n_batch, quantized: bool):
+def _reduce_grad(g, spec, batch_axes, n_batch, quantized: bool,
+                 wire_dtype: str = "int8"):
     """Reduce a full-size local gradient to its shard inside shard_map."""
     shard_axes: set[str] = set()
     for dim, axes in _sharded_dims(spec):
         shard_axes.update(axes)
         if quantized and g.size >= MIN_QUANT_SIZE * 4:
-            g = quantized_reduce_scatter(g, axes, dim)
+            g = quantized_reduce_scatter(g, axes, dim,
+                                         wire_dtype=wire_dtype)
         else:
             g = lax.psum_scatter(g, axes, scatter_dimension=dim, tiled=True)
     rest = tuple(a for a in batch_axes if a not in shard_axes)
@@ -121,9 +129,11 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
                              param_specs: PyTree, grad_specs: PyTree,
                              batch_axes: tuple[str, ...], *,
                              quantize_weights: bool,
-                             quantize_gradients: bool) -> Callable:
+                             quantize_gradients: bool,
+                             wire_dtype: str = "int8") -> Callable:
     """Drop-in for ``jax.value_and_grad(micro_loss, has_aux=True)`` in the
-    engine's compiled step, with explicit (optionally int8) collectives.
+    engine's compiled step, with explicit quantized collectives
+    (``wire_dtype``: "int8" or "fp8" e4m3 payloads).
 
     ``micro_loss(params, batch, scale, step) -> (scaled_loss, loss)``;
     returns ``fn(params, batch, scale, step) -> ((scaled, loss), grads)``
@@ -138,7 +148,8 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
     def fn(params, batch, scale, step):
         def body(params_local, batch_local, scale, step):
             full = jax.tree.map(
-                lambda x, s: _gather_param(x, s, quantize_weights),
+                lambda x, s: _gather_param(x, s, quantize_weights,
+                                           wire_dtype),
                 params_local, _as_tree(param_specs, params_local))
 
             def scaled(p):
@@ -150,7 +161,7 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
             g_shard = jax.tree.map(
                 lambda g, s: _reduce_grad(
                     g.astype(jnp.float32), s, batch_axes, n_batch,
-                    quantize_gradients),
+                    quantize_gradients, wire_dtype),
                 g_full, _as_tree(grad_specs, g_full))
             # loss values: mean over the global batch
             sl = lax.pmean(sl, batch_axes)

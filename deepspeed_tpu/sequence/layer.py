@@ -9,8 +9,10 @@ mesh axis inside ``shard_map`` — comm volume O(S/P) per device, riding ICI.
 
 Composes with tensor parallelism: heads may additionally be sharded over
 ``tp`` (in/out specs carry both axes); the all-to-all only trades the sp
-axis. GQA kv-heads that don't divide sp are replicated up front (the
-analogue of the reference's uneven-head support, layer.py:43).
+axis. Uneven head counts (reference layer.py:43): GQA kv-heads that don't
+divide sp are replicated up front, and q-head counts not divisible by sp
+are zero-padded to the next sp multiple and sliced back after the reverse
+all-to-all.
 """
 
 from __future__ import annotations
@@ -75,16 +77,30 @@ class DistributedAttention:
         nq, nkv = q.shape[2], k.shape[2]
         tp = mesh.shape.get(self.tp_axis, 1)
         local_q = nq // tp
-        if local_q % sp != 0:
-            raise ValueError(
-                f"q heads per tp shard ({local_q}) must divide sp={sp}")
-        if (nkv // tp if nkv % tp == 0 else nkv) % sp != 0:
+        if nkv != nq and (nkv // tp if nkv % tp == 0 else nkv) % sp != 0:
             # uneven kv heads: replicate kv up to q heads (reference
             # supports uneven head counts; replication is the TPU-simple
             # equivalent for GQA)
             rep = nq // nkv
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
+        pad = 0
+        if local_q % sp != 0:
+            # uneven q heads (reference layer.py:43 supports head counts
+            # not divisible by the SP degree): pad zero heads up to the
+            # next sp multiple per tp shard; the all-to-alls stay even
+            # and the pad heads are sliced off after the reverse
+            # all-to-all (head order is preserved across the round trip,
+            # so the pad stays at the tail). Overhead = pad/H compute.
+            if k.shape[2] != nq:
+                k = jnp.repeat(k, nq // k.shape[2], axis=2)
+                v = jnp.repeat(v, nq // v.shape[2], axis=2)
+            target = -(-local_q // sp) * sp * tp
+            pad = target - nq
+            widths = [(0, 0), (0, 0), (0, pad), (0, 0)]
+            q = jnp.pad(q, widths)
+            k = jnp.pad(k, widths)
+            v = jnp.pad(v, widths)
 
         def body(q, k, v):
             # local in: [B, S/P, H_local, D]; scatter heads, gather seq
@@ -103,7 +119,8 @@ class DistributedAttention:
                                    scatter_idx=self.gather_idx,
                                    gather_idx=self.scatter_idx)
 
-        return _shard_map_sp(body, mesh, self.sp_axis, 3)(q, k, v)
+        out = _shard_map_sp(body, mesh, self.sp_axis, 3)(q, k, v)
+        return out[:, :, :nq] if pad else out
 
 
 def ulysses_attention(mesh: Mesh, local_attention: Callable | None = None,

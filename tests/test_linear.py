@@ -99,3 +99,57 @@ def test_dequantize_tree():
     np.testing.assert_allclose(np.asarray(out["a"]), np.ones((16, 16)),
                                rtol=1e-3)
     assert out["b"].shape == (3,)
+
+
+def test_fp_quantized_param_roundtrip():
+    """Float formats (reference csrc/fp_quantizer: FP6/FP8/FP12): fp8 is
+    a native float8 array, fp6/fp12 are bit-packed; all roundtrip within
+    their mantissa precision."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 48))
+    # max abs error ~ block_absmax / 2^(mantissa_bits+1); N(0,1) blocks of
+    # 512 have absmax ~3.3
+    cases = [  # (q_bits, mantissa_bits, tol, codes_dtype)
+        (8, 3, 0.25, jnp.float8_e4m3fn),
+        (8, 2, 0.5, jnp.float8_e5m2),
+        (6, 2, 0.5, jnp.uint8),
+        (6, 3, 0.6, jnp.uint8),
+        (12, 7, 0.02, jnp.uint8),
+    ]
+    for bits, man, tol, cdt in cases:
+        qp = quantize_param(x, QuantizationConfig(
+            q_bits=bits, mantissa_bits=man, q_format="fp"))
+        assert qp.codes.dtype == cdt, (bits, man, qp.codes.dtype)
+        err = float(jnp.max(jnp.abs(qp.dequantized() - x)))
+        assert err < tol, (bits, man, err)
+        # packed formats actually shrink: 6 bits -> 3/4 byte per value
+        if bits in (6, 12):
+            assert qp.codes.size == qp.scales.shape[0] * 512 * bits // 8
+
+
+def test_fp_quant_exact_on_representable_values():
+    """Values already on the fp6 grid must survive pack/unpack exactly."""
+    from deepspeed_tpu.ops.fp_quant import (fp_dequantize,
+                                            fp_magnitude_table, fp_quantize)
+    table = fp_magnitude_table(3, 2)       # e3m2
+    vals = np.concatenate([table, -table]).astype(np.float32)
+    vals = np.pad(vals, (0, (-vals.size) % 512))
+    # scale by table max so the block absmax maps back onto the grid
+    codes, scales = fp_quantize(jnp.asarray(vals), q_bits=6,
+                                mantissa_bits=2, group_size=512)
+    out = fp_dequantize(codes, scales, q_bits=6, mantissa_bits=2,
+                        shape=vals.shape)
+    np.testing.assert_allclose(np.asarray(out), vals, rtol=1e-6, atol=1e-7)
+
+
+def test_fp_quantize_api_parity():
+    """FP_Quantize class mirrors the reference wrapper
+    (deepspeed/ops/fp_quantizer/quantize.py)."""
+    from deepspeed_tpu.ops.fp_quant import FP_Quantize
+    q = FP_Quantize(group_size=256)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1024,))
+    codes, scales = q.quantize(x, q_bits=6, q_mantisa_bits=2)
+    back = q.dequantize(codes, scales, q_bits=6, q_mantisa_bits=2,
+                        shape=x.shape)
+    assert float(jnp.max(jnp.abs(back - x))) < 0.5
+    with pytest.raises(ValueError, match="unsupported float format"):
+        q.quantize(x, q_bits=5, q_mantisa_bits=2)

@@ -124,3 +124,28 @@ def test_engine_sequence_parallel_end_to_end(mode, devices8):
         model=Llama(size="tiny"), config=cfg(sp=4))
     l_sp = [float(e_sp.train_batch(batch)) for _ in range(2)]
     np.testing.assert_allclose(l_sp, l_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_uneven_q_heads(devices8):
+    """Head counts not divisible by the SP degree (reference layer.py:43
+    uneven-head support): 6 heads over sp=4 pad to 8 and slice back."""
+    topo = MeshTopology(TopologyConfig(sp=4, dp=2, fsdp=1))
+    q, k, v = rand_qkv(jax.random.PRNGKey(7), hq=6, hkv=6)
+    ref = dot_product_attention(q, k, v, causal=True)
+    attn = ulysses_attention(topo.mesh)
+    out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_uneven_q_heads_gqa(devices8):
+    """Uneven q heads + GQA kv (3 kv heads, sp=4): kv replicates to q
+    count, both pad to the sp multiple."""
+    topo = MeshTopology(TopologyConfig(sp=4, dp=2, fsdp=1))
+    q, k, v = rand_qkv(jax.random.PRNGKey(8), hq=6, hkv=3)
+    ref = dot_product_attention(q, k, v, causal=True)
+    attn = ulysses_attention(topo.mesh)
+    out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
