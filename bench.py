@@ -1,9 +1,16 @@
 """Benchmark: GPT-2 125M training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is MFU / 0.45 — the north-star MFU target from BASELINE.md §9
-(the reference's headline training-efficiency claim class; e.g. Ulysses
-sustains 54% of peak on A100, BASELINE.md §3).
+Prints ONE JSON line on stdout: {"metric", "value", "unit",
+"vs_baseline"}. vs_baseline is MFU / 0.45 — the north-star MFU target
+from BASELINE.md §9 (the reference's headline training-efficiency claim
+class; e.g. Ulysses sustains 54% of peak on A100, BASELINE.md §3).
+
+stderr carries '# '-prefixed tail lines recorded alongside: a
+Llama-family training config (BASELINE configs 2-3 class, scaled to one
+chip) and a kernel smoke section running every Pallas kernel family on
+the real chip (quantize/dequant roundtrips, fused optimizers, norms,
+flash attention, block-sparse attention) so interpret-mode-only test
+coverage can't hide TPU-specific lowering bugs.
 """
 
 import json
@@ -29,6 +36,141 @@ def peak_flops(device) -> float:
         if kind.startswith(k):
             return v
     return 1e12
+
+
+def kernel_smoke() -> dict:
+    """Run every Pallas kernel family once on the live backend; returns
+    {check: max_abs_err} (floats) — a failure surfaces as an exception
+    string instead of an error value."""
+    results: dict = {}
+    key = jax.random.PRNGKey(0)
+
+    def check(name, fn):
+        try:
+            results[name] = round(float(fn()), 8)
+        except Exception as e:   # noqa: BLE001 — report, don't die
+            results[name] = f"FAIL: {type(e).__name__}: {str(e)[:100]}"
+
+    x = jax.random.normal(key, (4096, 1024), jnp.float32)
+
+    def int8_roundtrip():
+        from deepspeed_tpu.ops.pallas.quantization import (dequantize_int8,
+                                                           quantize_int8)
+        q, s, meta = quantize_int8(x)
+        return jnp.max(jnp.abs(dequantize_int8(q, s, meta) - x))
+
+    def fp8_roundtrip():
+        from deepspeed_tpu.ops.fp_quant import fp_dequantize, fp_quantize
+        c, s = fp_quantize(x, q_bits=8, mantissa_bits=3)
+        return jnp.max(jnp.abs(
+            fp_dequantize(c, s, q_bits=8, mantissa_bits=3, shape=x.shape)
+            - x))
+
+    def fp6_roundtrip():
+        from deepspeed_tpu.ops.fp_quant import fp_dequantize, fp_quantize
+        c, s = fp_quantize(x, q_bits=6, mantissa_bits=2)
+        return jnp.max(jnp.abs(
+            fp_dequantize(c, s, q_bits=6, mantissa_bits=2, shape=x.shape)
+            - x))
+
+    def norms_err():
+        from deepspeed_tpu.ops import layers as L
+        from deepspeed_tpu.ops.pallas import norms
+        scale = jnp.ones((1024,)) * 1.5
+        return jnp.max(jnp.abs(norms.rms_norm(x, scale)
+                               - L.rms_norm(x, scale)))
+
+    def fused_adam_err():
+        import optax
+        from deepspeed_tpu.ops.pallas.fused_optimizers import fused_adam
+        p = {"w": x[:64]}
+        g = {"w": jax.random.normal(jax.random.PRNGKey(1), (64, 1024))}
+        tx, ref = fused_adam(1e-3), optax.adam(1e-3)
+        up, _ = tx.update(g, tx.init(p), p)
+        rup, _ = ref.update(g, ref.init(p), p)
+        return jnp.max(jnp.abs(up["w"] - rup["w"]))
+
+    def flash_err():
+        from deepspeed_tpu.ops.layers import dot_product_attention
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (2, 512, 8, 64), jnp.float32)
+        k = jax.random.normal(ks[1], (2, 512, 8, 64), jnp.float32)
+        v = jax.random.normal(ks[2], (2, 512, 8, 64), jnp.float32)
+        return jnp.max(jnp.abs(flash_attention(q, k, v, causal=True)
+                               - dot_product_attention(q, k, v,
+                                                       causal=True)))
+
+    def sparse_err():
+        import numpy as np
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+        from deepspeed_tpu.ops.sparse_attention.kernels import \
+            block_sparse_attention
+        from deepspeed_tpu.ops.sparse_attention.sparse_self_attention \
+            import layout_to_bias
+        cfg = FixedSparsityConfig(num_heads=4, block=128)
+        layout = cfg.make_layout(512)
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (2, 4, 512, 64), jnp.float32)
+        k = jax.random.normal(ks[1], (2, 4, 512, 64), jnp.float32)
+        v = jax.random.normal(ks[2], (2, 4, 512, 64), jnp.float32)
+        bias = layout_to_bias(layout, 128)
+        sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(64.0) + bias[None]
+        ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(sc, -1), v)
+        return jnp.max(jnp.abs(block_sparse_attention(q, k, v, layout)
+                               - ref))
+
+    for name, fn in [("int8_roundtrip", int8_roundtrip),
+                     ("fp8_roundtrip", fp8_roundtrip),
+                     ("fp6_roundtrip", fp6_roundtrip),
+                     ("norms", norms_err),
+                     ("fused_adam", fused_adam_err),
+                     ("flash_attention", flash_err),
+                     ("block_sparse_attention", sparse_err)]:
+        check(name, fn)
+    return results
+
+
+def llama_bench(ds, on_tpu: bool):
+    """Llama-family training config (BASELINE configs 2-3 class, scaled
+    to one chip): ~340M params, GQA d_head=128, RoPE/RMSNorm/SwiGLU,
+    ZeRO-2 + fused Adam at seq 2048."""
+    from deepspeed_tpu.models import Llama
+    seq = 2048 if on_tpu else 128
+    batch = 4 if on_tpu else 2
+    model = (Llama(hidden_size=1024, num_layers=24, num_heads=8,
+                   num_kv_heads=8, intermediate_size=2816,
+                   vocab_size=32000, max_seq_len=seq,
+                   remat_policy="segments", attn_impl="flash")
+             if on_tpu else Llama(size="tiny", max_seq_len=seq))
+    config = {
+        "train_batch_size": batch,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
+                                model.config.vocab_size)
+    data = (tokens[:, :-1], tokens[:, 1:])
+    float(engine.train_batch(data))
+    steps = 10 if on_tpu else 2
+    dt = float("inf")
+    for _ in range(2 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(data)
+        float(loss)
+        dt = min(dt, time.perf_counter() - t0)
+    tps = steps * batch * seq / dt
+    mfu = tps * model.config.flops_per_token(seq) / peak_flops(
+        jax.devices()[0])
+    return {"metric": "llama_340m_train_tokens_per_sec",
+            "value": round(tps, 1), "unit": "tokens/s/chip",
+            "mfu": round(mfu, 4)}
 
 
 def main():
@@ -96,6 +238,13 @@ def main():
     }))
     print(f"# mfu={mfu:.3f} loss={float(loss):.4f} step_ms={dt / steps * 1e3:.1f}",
           file=sys.stderr)
+    try:
+        print("# llama " + json.dumps(llama_bench(ds, on_tpu)),
+              file=sys.stderr)
+    except Exception as e:   # noqa: BLE001
+        print(f"# llama FAIL: {type(e).__name__}: {str(e)[:160]}",
+              file=sys.stderr)
+    print("# kernel_smoke " + json.dumps(kernel_smoke()), file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -36,17 +36,8 @@ MOMENT_NAMES = ["exp_avg", "exp_avg_sq", "exp_moment_3", "exp_moment_4"]
 
 
 def _path_name(path) -> str:
-    parts = []
-    for k in path:
-        if hasattr(k, "key"):
-            parts.append(str(k.key))
-        elif hasattr(k, "name"):
-            parts.append(str(k.name))
-        elif hasattr(k, "idx"):
-            parts.append(str(k.idx))
-        else:
-            parts.append(str(k))
-    return "/".join(parts)
+    from .zero_to_fp32 import _key_str
+    return "/".join(_key_str(k) for k in path)
 
 
 def flatten_with_names(tree: PyTree) -> list[tuple[str, Any]]:
@@ -79,11 +70,32 @@ def _match_moments(opt_state: PyTree, param_names: list[str],
 def ds_to_universal(checkpoint_dir: str, output_dir: str,
                     tag: Optional[str] = None) -> str:
     """Convert a saved engine checkpoint to universal format
-    (reference: ds_to_universal.py main)."""
+    (reference: ds_to_universal.py main).
+
+    Extraction is STREAMED: the state's structure comes from checkpoint
+    metadata and each param/moment leaf is read straight from the
+    OCDBT/zarr store, written and freed one at a time — peak host memory
+    is one leaf, not the full state (the role of the reference's
+    per-param worker pool, ds_to_universal.py:348). The NVMe-offload
+    layout (host-side npz shards) takes the materializing path — those
+    states are host-RAM sized by construction — as does any checkpoint
+    whose store the direct reader can't parse."""
     from .zero_to_fp32 import _find_tag, _restore_numpy
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     tag = _find_tag(checkpoint_dir, tag)
-    state = _restore_numpy(os.path.join(checkpoint_dir, tag, "state"))
+    state_path = os.path.join(checkpoint_dir, tag, "state")
+
+    host_file = os.path.join(checkpoint_dir, tag, "host_opt_rank0.npz")
+    if not os.path.exists(host_file):
+        try:
+            return _ds_to_universal_streamed(checkpoint_dir, output_dir,
+                                             tag, state_path)
+        except Exception as e:   # noqa: BLE001
+            logger.warning(
+                f"streamed extraction failed ({e}); falling back to "
+                f"materializing restore")
+
+    state = _restore_numpy(state_path)
 
     hp = state.get("master") or state["params"]  # fp32 source of truth
     named = flatten_with_names(hp)
@@ -93,7 +105,6 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str,
 
     # NVMe-offload checkpoints keep master + moments in per-rank host
     # files instead of the device state (runtime/offload.py state_dict)
-    host_file = os.path.join(checkpoint_dir, tag, "host_opt_rank0.npz")
     if state.get("master") is None and os.path.exists(host_file):
         import glob
         rank_files = sorted(glob.glob(os.path.join(
@@ -139,11 +150,20 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str,
             np.save(os.path.join(pdir, f"{mname}.npy"),
                     np.asarray(m, dtype=np.float32))
 
+    _write_universal_meta(checkpoint_dir, output_dir, tag,
+                          int(np.asarray(state.get("step", 0))), names,
+                          {n: len(m) for n, m in moments.items()})
+    return output_dir
+
+
+def _write_universal_meta(checkpoint_dir: str, output_dir: str, tag: str,
+                          step: int, names: list[str],
+                          n_moments: dict[str, int]) -> None:
     meta = {
         "tag": tag,
-        "step": int(np.asarray(state.get("step", 0))),
+        "step": step,
         "param_names": names,
-        "n_moments": {n: len(m) for n, m in moments.items()},
+        "n_moments": n_moments,
     }
     src_meta = os.path.join(checkpoint_dir, tag, "ds_meta.json")
     if os.path.exists(src_meta):
@@ -153,6 +173,49 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str,
         json.dump(meta, f)
     log_dist(f"universal checkpoint written to {output_dir} "
              f"({len(names)} params)")
+
+
+def _ds_to_universal_streamed(checkpoint_dir: str, output_dir: str,
+                              tag: str, state_path: str) -> str:
+    """Streamed extraction: structure from checkpoint metadata, one
+    direct store read per leaf — peak host memory is a single leaf."""
+    from .zero_to_fp32 import _leaf_paths, _restore_leaf
+    leaves, _meta_tree = _leaf_paths(state_path)
+    keysets = {k for k, _ in leaves}
+    src = ("master" if any(k and k[0] == "master" for k, _ in leaves)
+           else "params")
+    named_meta = [("/".join(k[1:]), k, m) for k, m in leaves
+                  if k and k[0] == src]
+    names = [n for n, _, _ in named_meta]
+    shapes = {n: tuple(m.shape) for n, _, m in named_meta}
+
+    moment_keys: dict[str, list[tuple[str, ...]]] = {n: [] for n in names}
+    for k, m in leaves:
+        if not k or k[0] != "opt_state":
+            continue
+        nm = "/".join(k[1:])
+        for pname in names:
+            if (nm == pname or nm.endswith("/" + pname)) \
+                    and tuple(m.shape) == shapes[pname]:
+                moment_keys[pname].append(k)
+                break
+
+    zdir = os.path.join(os.path.abspath(output_dir), ZERO_DIR)
+    for name, pkeys, _m in named_meta:
+        pdir = os.path.join(zdir, name)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                _restore_leaf(state_path, pkeys).astype(np.float32))
+        for i, mkeys in enumerate(moment_keys[name]):
+            mname = (MOMENT_NAMES[i] if i < len(MOMENT_NAMES)
+                     else f"moment_{i}")
+            np.save(os.path.join(pdir, f"{mname}.npy"),
+                    _restore_leaf(state_path, mkeys).astype(np.float32))
+
+    step = (int(_restore_leaf(state_path, ("step",)))
+            if ("step",) in keysets else 0)
+    _write_universal_meta(checkpoint_dir, output_dir, tag, step, names,
+                          {n: len(m) for n, m in moment_keys.items()})
     return output_dir
 
 
@@ -179,15 +242,18 @@ def load_universal_checkpoint(engine, universal_dir: str) -> dict:
     with open(os.path.join(universal_dir, META_FILE)) as f:
         meta = json.load(f)
 
+    # mmap the fragments: device_put streams pages straight from disk, so
+    # host RSS never holds the full state (reference loads fragments
+    # lazily per parameter too, universal_checkpoint.py:22)
     fp32 = {}
     moments: dict[str, list[np.ndarray]] = {}
     for name, pdir in _iter_param_files(universal_dir):
-        fp32[name] = np.load(os.path.join(pdir, "fp32.npy"))
+        fp32[name] = np.load(os.path.join(pdir, "fp32.npy"), mmap_mode="r")
         moments[name] = []
         for mname in MOMENT_NAMES:
             mpath = os.path.join(pdir, f"{mname}.npy")
             if os.path.exists(mpath):
-                moments[name].append(np.load(mpath))
+                moments[name].append(np.load(mpath, mmap_mode="r"))
 
     # --- params / master ------------------------------------------------
     def put(tree, shardings, cast_dtype=None):

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import argparse
 import os
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -43,6 +43,49 @@ def _restore_numpy(path: str):
     restore_args = jax.tree.map(
         lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta)
     return ckptr.restore(path, restore_args=restore_args)
+
+
+def _key_str(k) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def _leaf_paths(path: str):
+    """([(key-path, leaf metadata)], metadata tree) of a checkpoint
+    WITHOUT restoring it."""
+    import jax
+    import orbax.checkpoint as ocp
+    meta = ocp.PyTreeCheckpointer().metadata(path).item_metadata
+    return [(tuple(_key_str(k) for k in p), m)
+            for p, m in jax.tree_util.tree_flatten_with_path(meta)[0]], meta
+
+
+def _restore_leaf(path: str, keys: tuple[str, ...]) -> np.ndarray:
+    """Read ONE leaf of an orbax checkpoint straight from its OCDBT/zarr
+    store — peak memory is that leaf, not the whole state. The streamed-
+    extraction analogue of the reference's per-param worker pools
+    (ds_to_universal.py:348 _do_parallel_work).
+
+    (orbax's PyTreeRestore partial_restore can only omit dict keys, so
+    it cannot skip siblings inside optax's tuple-typed chain states —
+    the direct tensorstore read sidesteps the whole trimming machinery.
+    Array names are the dot-joined key paths orbax writes.)"""
+    import tensorstore as ts
+    name = ".".join(keys)
+    base = {"driver": "ocdbt", "base": f"file://{os.path.abspath(path)}"}
+    last_err = None
+    for driver in ("zarr", "zarr3"):
+        try:
+            spec = {"driver": driver,
+                    "kvstore": {**base, "path": name + "/"}}
+            arr = ts.open(spec, open=True).result().read().result()
+            return np.asarray(arr)
+        except Exception as e:   # noqa: BLE001 — caller falls back
+            last_err = e
+    raise RuntimeError(
+        f"direct leaf read failed for {name!r} in {path}: {last_err}")
 
 
 def get_fp32_state_dict_from_zero_checkpoint(

@@ -154,6 +154,11 @@ class InferenceEngineV2:
         Raises before any state mutation if the batch cannot fit."""
         uids = [int(u) for u in batch_uids]
         mgr = self.state_manager
+        for u, toks in zip(uids, batch_tokens):
+            if len(toks) == 0:
+                raise ValueError(
+                    f"sequence {u}: schedule()/put() needs at least one "
+                    f"token (an empty list would never finish a tick)")
         if do_checks:
             # cumulative admission over the whole batch, so a failure
             # raises before any state mutation

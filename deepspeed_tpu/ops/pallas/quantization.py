@@ -109,9 +109,10 @@ def quantize_fp8(x):
 
 
 def dequantize_fp8(q, s, meta):
+    from ..fp_quant import fp_dequantize
     shape, dtype, n = meta
-    x = q.astype(jnp.float32) * s
-    return x.reshape(-1)[:n].reshape(shape).astype(dtype)
+    return fp_dequantize(q, s, q_bits=8, mantissa_bits=3, shape=shape,
+                         dtype=dtype)
 
 
 def _wire_quantizer(wire_dtype: str):

@@ -130,7 +130,8 @@ class DeepSpeedEngine:
         else:
             self.tx = build_optimizer(
                 opt_cfg.type if opt_cfg else "adamw",
-                opt_cfg.params if opt_cfg else {}, self.lr_schedule)
+                opt_cfg.params if opt_cfg else {}, self.lr_schedule,
+                dp_world=self.topology.data_parallel_size)
 
         # --- ZeRO plan ---------------------------------------------------
         zcfg = self.config.zero_optimization

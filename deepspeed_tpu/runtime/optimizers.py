@@ -51,9 +51,12 @@ _NAME_ALIASES = {
 
 
 def build_optimizer(opt_type: str, params: dict[str, Any],
-                    lr_schedule: Callable) -> optax.GradientTransformation:
+                    lr_schedule: Callable,
+                    dp_world: int = 1) -> optax.GradientTransformation:
     """Build the base optimizer from reference-style config params
-    (lr, betas, eps, weight_decay, momentum, ...)."""
+    (lr, betas, eps, weight_decay, momentum, ...). ``dp_world`` sets the
+    1-bit optimizers' compression chunk count (per-worker granularity,
+    see runtime/onebit.py)."""
     name = _NAME_ALIASES.get(opt_type.lower().replace("_", ""))
     if name is None:
         raise ValueError(
@@ -111,11 +114,13 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
         return optax.adagrad(lr_schedule, eps=eps)
     if name == ADAFACTOR_OPTIMIZER:
         return optax.adafactor(lr_schedule)
+    nc = int(p.pop("num_chunks", dp_world))
     if name == ONEBIT_ADAM_OPTIMIZER:
         from .onebit import onebit_adam
         return onebit_adam(lr_schedule, b1=betas[0], b2=betas[1], eps=eps,
                            weight_decay=wd,
-                           freeze_step=int(p.pop("freeze_step", 100000)))
+                           freeze_step=int(p.pop("freeze_step", 100000)),
+                           num_chunks=nc)
     if name == ZERO_ONE_ADAM_OPTIMIZER:
         from .onebit import zero_one_adam
         return zero_one_adam(
@@ -123,12 +128,14 @@ def build_optimizer(opt_type: str, params: dict[str, Any],
             var_freeze_step=int(p.pop("var_freeze_step", 100000)),
             var_update_scaler=int(p.pop("var_update_scaler", 16)),
             local_step_scaler=int(p.pop("local_step_scaler", 32678)),
-            local_step_clipper=int(p.pop("local_step_clipper", 16)))
+            local_step_clipper=int(p.pop("local_step_clipper", 16)),
+            num_chunks=nc)
     if name == ONEBIT_LAMB_OPTIMIZER:
         from .onebit import onebit_lamb
         return onebit_lamb(
             lr_schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd,
             freeze_step=int(p.pop("freeze_step", 100000)),
             max_coeff=float(p.pop("max_coeff", 10.0)),
-            min_coeff=float(p.pop("min_coeff", 0.01)))
+            min_coeff=float(p.pop("min_coeff", 0.01)),
+            num_chunks=nc)
     raise AssertionError(name)
