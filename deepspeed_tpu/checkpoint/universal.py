@@ -46,22 +46,27 @@ def flatten_with_names(tree: PyTree) -> list[tuple[str, Any]]:
     return [(_path_name(p), v) for p, v in leaves]
 
 
+def _is_moment_of(leaf_name: str, leaf_shape: tuple, pname: str,
+                  param_shapes: dict[str, tuple]) -> bool:
+    """An opt-state leaf whose path *ends with* a param's path and whose
+    shape matches is a moment of that param (optax moment trees mirror
+    the param tree: e.g. ScaleByAdamState.mu/<param path>). Shared by the
+    streamed and materializing extraction paths so they can never
+    diverge."""
+    return (leaf_name == pname or leaf_name.endswith("/" + pname)) \
+        and tuple(leaf_shape) == param_shapes[pname]
+
+
 def _match_moments(opt_state: PyTree, param_names: list[str],
                    param_shapes: dict[str, tuple]) -> dict[str, list]:
-    """Find optimizer-state leaves that are per-param moments.
-
-    An opt-state leaf whose path *ends with* a param's path and whose shape
-    matches is a moment of that param (optax moment trees mirror the param
-    tree: e.g. ScaleByAdamState.mu/<param path>). Order of appearance
-    determines exp_avg vs exp_avg_sq — same convention the reference uses
-    when mapping fragments (ds_to_universal.py:112).
-    """
+    """Find optimizer-state leaves that are per-param moments. Order of
+    appearance determines exp_avg vs exp_avg_sq — same convention the
+    reference uses when mapping fragments (ds_to_universal.py:112)."""
     moments: dict[str, list] = {n: [] for n in param_names}
     for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
         name = _path_name(path)
         for pname in param_names:
-            if (name == pname or name.endswith("/" + pname)) \
-                    and tuple(np.shape(leaf)) == param_shapes[pname]:
+            if _is_moment_of(name, np.shape(leaf), pname, param_shapes):
                 moments[pname].append((name, leaf))
                 break
     return moments
@@ -195,8 +200,7 @@ def _ds_to_universal_streamed(checkpoint_dir: str, output_dir: str,
             continue
         nm = "/".join(k[1:])
         for pname in names:
-            if (nm == pname or nm.endswith("/" + pname)) \
-                    and tuple(m.shape) == shapes[pname]:
+            if _is_moment_of(nm, m.shape, pname, shapes):
                 moment_keys[pname].append(k)
                 break
 

@@ -20,6 +20,7 @@ import numpy as np
 from ..utils.logging import log_dist
 
 LATEST_FILE = "latest"
+_STORE_DRIVERS: dict[str, str] = {}  # store path -> working zarr driver
 
 
 def _find_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
@@ -74,13 +75,18 @@ def _restore_leaf(path: str, keys: tuple[str, ...]) -> np.ndarray:
     Array names are the dot-joined key paths orbax writes.)"""
     import tensorstore as ts
     name = ".".join(keys)
-    base = {"driver": "ocdbt", "base": f"file://{os.path.abspath(path)}"}
+    abspath = os.path.abspath(path)
+    base = {"driver": "ocdbt", "base": f"file://{abspath}"}
     last_err = None
-    for driver in ("zarr", "zarr3"):
+    # probe the array codec once per store, then stick with it
+    cached = _STORE_DRIVERS.get(abspath)
+    drivers = (cached,) if cached else ("zarr", "zarr3")
+    for driver in drivers:
         try:
             spec = {"driver": driver,
                     "kvstore": {**base, "path": name + "/"}}
             arr = ts.open(spec, open=True).result().read().result()
+            _STORE_DRIVERS[abspath] = driver
             return np.asarray(arr)
         except Exception as e:   # noqa: BLE001 — caller falls back
             last_err = e

@@ -124,8 +124,11 @@ def test_universal_streamed_extraction_bounded_memory(tmp_path):
     subprocess; the RSS high-water delta must stay far below the state
     size."""
     import json as _json
+    import os
     import subprocess
     import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     ckpt = tmp_path / "ckpt"
     tag = "global_step7"
@@ -147,8 +150,7 @@ state = {{
 ocp.PyTreeCheckpointer().save(os.path.join({str(ckpt)!r}, {tag!r}, "state"), state)
 open(os.path.join({str(ckpt)!r}, "latest"), "w").write({tag!r})
 """
-    subprocess.run([sys.executable, "-c", build], check=True,
-                   cwd="/root/repo")
+    subprocess.run([sys.executable, "-c", build], check=True, cwd=repo)
 
     out = tmp_path / "uni"
     convert = f"""
@@ -157,7 +159,7 @@ def hwm():
     for line in open("/proc/self/status"):
         if line.startswith("VmHWM"):
             return int(line.split()[1])  # KiB
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, {repo!r})
 import jax; jax.config.update("jax_platforms", "cpu")
 from deepspeed_tpu.checkpoint.universal import ds_to_universal
 base = hwm()
@@ -165,7 +167,7 @@ ds_to_universal({str(ckpt)!r}, {str(out)!r})
 print(json.dumps({{"base_kib": base, "final_kib": hwm()}}))
 """
     res = subprocess.run([sys.executable, "-c", convert], check=True,
-                         cwd="/root/repo", capture_output=True, text=True)
+                         cwd=repo, capture_output=True, text=True)
     stats = _json.loads(res.stdout.strip().splitlines()[-1])
     delta_mib = (stats["final_kib"] - stats["base_kib"]) / 1024
     # state is ~512 MiB; one leaf is 16 MiB. Materializing restore would
