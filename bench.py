@@ -38,6 +38,36 @@ def peak_flops(device) -> float:
     return 1e12
 
 
+
+def _train_tput(ds, model, config_extra: dict, batch: int, seq: int,
+                steps: int, windows: int = 1):
+    """Shared throughput harness: build an engine, warm up, run best-of-
+    `windows` timed loops with a device->host sync (float(loss)) per
+    window. Returns (tokens/s, engine-free)."""
+    config = {
+        "train_batch_size": batch,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+        **config_extra,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
+                                model.config.vocab_size)
+    data = (tokens[:, :-1], tokens[:, 1:])
+    float(engine.train_batch(data))
+    dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(data)
+        float(loss)  # device->host copy = reliable sync under the tunnel
+        dt = min(dt, time.perf_counter() - t0)
+    return steps * batch * seq / dt
+
+
 def kernel_smoke() -> dict:
     """Run every Pallas kernel family once on the live backend; returns
     {check: max_abs_err} (floats) — a failure surfaces as an exception
@@ -143,29 +173,9 @@ def llama_bench(ds, on_tpu: bool):
                    vocab_size=32000, max_seq_len=seq,
                    remat_policy="segments", attn_impl="flash")
              if on_tpu else Llama(size="tiny", max_seq_len=seq))
-    config = {
-        "train_batch_size": batch,
-        "optimizer": {"type": "FusedAdam",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "gradient_clipping": 1.0,
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2},
-        "steps_per_print": 10 ** 9,
-    }
-    engine, _, _, _ = ds.initialize(model=model, config=config)
-    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
-                                model.config.vocab_size)
-    data = (tokens[:, :-1], tokens[:, 1:])
-    float(engine.train_batch(data))
-    steps = 10 if on_tpu else 2
-    dt = float("inf")
-    for _ in range(2 if on_tpu else 1):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = engine.train_batch(data)
-        float(loss)
-        dt = min(dt, time.perf_counter() - t0)
-    tps = steps * batch * seq / dt
+    tps = _train_tput(ds, model, {"gradient_clipping": 1.0}, batch, seq,
+                      steps=10 if on_tpu else 2,
+                      windows=2 if on_tpu else 1)
     mfu = tps * model.config.flops_per_token(seq) / peak_flops(
         jax.devices()[0])
     return {"metric": "llama_340m_train_tokens_per_sec",
@@ -186,25 +196,8 @@ def longctx_bench(ds, on_tpu: bool):
                    remat_policy="segments", attn_impl="flash",
                    loss_chunk=2048)
              if on_tpu else Llama(size="tiny", max_seq_len=seq))
-    config = {
-        "train_batch_size": 1,
-        "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2},
-        "steps_per_print": 10 ** 9,
-    }
-    engine, _, _, _ = ds.initialize(model=model, config=config)
-    tokens = jax.random.randint(jax.random.PRNGKey(0), (1, seq + 1), 0,
-                                model.config.vocab_size)
-    data = (tokens[:, :-1], tokens[:, 1:])
-    float(engine.train_batch(data))
-    steps = 4 if on_tpu else 1
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(data)
-    float(loss)
-    dt = time.perf_counter() - t0
-    tps = steps * seq / dt
+    tps = _train_tput(ds, model, {}, batch=1, seq=seq,
+                      steps=4 if on_tpu else 1)
     mfu = tps * model.config.flops_per_token(seq) / peak_flops(
         jax.devices()[0])
     return {"metric": "llama_32k_seq_train_tokens_per_sec",
@@ -225,27 +218,10 @@ def moe_bench(ds, on_tpu: bool):
                      max_seq_len=seq, remat_policy="segments",
                      attn_impl="flash")
              if on_tpu else Mixtral(size="tiny", max_seq_len=seq))
-    config = {
-        "train_batch_size": batch,
-        "optimizer": {"type": "FusedAdam", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2},
-        "steps_per_print": 10 ** 9,
-    }
-    engine, _, _, _ = ds.initialize(model=model, config=config)
-    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1), 0,
-                                model.config.vocab_size)
-    data = (tokens[:, :-1], tokens[:, 1:])
-    float(engine.train_batch(data))
-    steps = 8 if on_tpu else 1
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(data)
-    float(loss)
-    dt = time.perf_counter() - t0
+    tps = _train_tput(ds, model, {}, batch, seq,
+                      steps=8 if on_tpu else 1)
     return {"metric": "mixtral_8e_top2_train_tokens_per_sec",
-            "value": round(steps * batch * seq / dt, 1),
-            "unit": "tokens/s/chip"}
+            "value": round(tps, 1), "unit": "tokens/s/chip"}
 
 
 def offload_smoke(ds, on_tpu: bool):

@@ -78,9 +78,12 @@ def _restore_leaf(path: str, keys: tuple[str, ...]) -> np.ndarray:
     abspath = os.path.abspath(path)
     base = {"driver": "ocdbt", "base": f"file://{abspath}"}
     last_err = None
-    # probe the array codec once per store, then stick with it
+    # probe the array codec once per store, then prefer it — but keep the
+    # other driver as fallback (a store could be rewritten or mixed)
     cached = _STORE_DRIVERS.get(abspath)
-    drivers = (cached,) if cached else ("zarr", "zarr3")
+    drivers = ("zarr", "zarr3")
+    if cached:
+        drivers = (cached,) + tuple(d for d in drivers if d != cached)
     for driver in drivers:
         try:
             spec = {"driver": driver,

@@ -191,17 +191,16 @@ class DecoderLM:
                 "sequence-parallel wrapper) is in use; the window mask is "
                 "NOT applied by the wrapper — attention is full-causal")
         if attn_fn is None:
-            if c.attn_impl == "flash" and c.sliding_window is None:
+            if c.attn_impl == "flash":
+                import functools
+
                 from ..ops.pallas.flash_attention import flash_attention
-                attn_fn = flash_attention
+                attn_fn = (functools.partial(flash_attention,
+                                             window=c.sliding_window)
+                           if c.sliding_window is not None
+                           else flash_attention)
             elif c.sliding_window is not None:
                 import functools
-                if c.attn_impl == "flash":
-                    from ..utils.logging import warning_once
-                    warning_once(
-                        "sliding_window set: flash attention kernel has no "
-                        "window support yet; using the masked reference "
-                        "attention (O(S^2) memory)")
                 attn_fn = functools.partial(
                     L.dot_product_attention,
                     bias=self._window_bias(x.shape[1]))
@@ -264,12 +263,7 @@ class DecoderLM:
             p, x, a, h)
 
     def _window_bias(self, seq_len: int) -> jax.Array:
-        """Additive mask for sliding-window attention (Mistral): query i
-        sees keys in (i - window, i]."""
-        w = self.config.sliding_window
-        qi = jnp.arange(seq_len)[:, None]
-        ki = jnp.arange(seq_len)[None, :]
-        return jnp.where(qi - ki < w, 0.0, -1e30)[None, None]
+        return L.window_bias(seq_len, self.config.sliding_window)
 
     def _mlp(self, p: PyTree, h: jax.Array):
         """Dense FFN. Returns (out, aux_loss) — MoE subclasses override

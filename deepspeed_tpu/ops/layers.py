@@ -72,6 +72,15 @@ def apply_rotary(x, cos, sin, positions=None):
     return out.astype(x.dtype)
 
 
+def window_bias(seq_len: int, window: int):
+    """Additive mask for sliding-window attention (Mistral SWA): query i
+    sees keys in (i - window, i]. Single source for the model path and
+    the flash-kernel fallback."""
+    qi = jnp.arange(seq_len)[:, None]
+    ki = jnp.arange(seq_len)[None, :]
+    return jnp.where(qi - ki < window, 0.0, -1e30)[None, None]
+
+
 def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
                           segment_ids=None, softmax_scale: float | None = None):
     """Reference attention: q,k,v [B, S, H, D] (k/v may have fewer heads —

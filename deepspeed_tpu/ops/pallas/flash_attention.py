@@ -51,6 +51,11 @@ NEG_INF = -1e30
 # within the 128M VMEM of v5e/v5p next to the ~4M of block temporaries.
 _RESIDENT_MAX_SEQ = 16384
 
+# the row-resident kernels hold [S, D] slabs (q/do/dq + temps) in VMEM;
+# Mosaic's default 16MB scoped-vmem ceiling trips at long seq x D=128 —
+# raise it (v5e/v5p have 128MB)
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -66,12 +71,13 @@ def _block(s: int) -> int:
 
 
 # ---------------------------------------------------------------- forward
-def _flash_fwd(q, k, v, *, causal: bool, sc: float):
+def _flash_fwd(q, k, v, *, causal: bool, sc: float,
+               window: int | None = None):
     bh, s, d = q.shape
     bq = bk = _block(s)
     grid = (bh, s // bq)
     kernel = functools.partial(_fwd_kernel, sc=sc, bq=bq, bk=bk,
-                               nk=s // bk, causal=causal)
+                               nk=s // bk, causal=causal, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -93,14 +99,18 @@ def _flash_fwd(q, k, v, *, causal: bool, sc: float):
             jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
     )(q, k, v)
     return o.astype(q.dtype), lse
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sc, bq, bk, nk,
-                causal):
-    """Online-softmax forward: q block vs the VMEM-resident k/v row."""
+                causal, window):
+    """Online-softmax forward: q block vs the VMEM-resident k/v row.
+    ``window`` (Mistral SWA): query r sees keys in (r - window, r] — the
+    kv sweep starts at the window's first live block and the in-block
+    mask drops the tail."""
     i = pl.program_id(1)
     q = q_ref[0]
     d = q.shape[-1]
@@ -110,10 +120,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sc, bq, bk, nk,
         kj = k_ref[0, pl.ds(j * bk, bk), :]
         vj = v_ref[0, pl.ds(j * bk, bk), :]
         s = jnp.dot(q, kj.T, preferred_element_type=jnp.float32) * sc
-        if causal:
+        if causal or window is not None:
             qi = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
             ki = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(qi >= ki, s, NEG_INF)
+            live = qi >= ki if causal else (qi == qi)
+            if window is not None:
+                live &= qi - ki < window
+            s = jnp.where(live, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -122,10 +135,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sc, bq, bk, nk,
                                        preferred_element_type=jnp.float32)
         return o_acc, m_new, l
 
-    # causal: q block i attends kv blocks [0, i] (bq == bk)
+    # causal: q block i attends kv blocks [0, i] (bq == bk); a window
+    # additionally floors the sweep at its first live block
     hi = (i + 1) if causal else nk
+    lo = (jnp.maximum(0, (i * bq - window + 1) // bk)
+          if window is not None else 0)
     o_acc, m, l = jax.lax.fori_loop(
-        0, hi, body,
+        lo, hi, body,
         (jnp.zeros((bq, d), jnp.float32),
          jnp.full((bq, 1), NEG_INF, jnp.float32),
          jnp.zeros((bq, 1), jnp.float32)))
@@ -136,7 +152,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sc, bq, bk, nk,
 
 # ---------------------------------------------------------------- backward
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, *, sc, bq, bk, nq, causal):
+                      dq_ref, dk_ref, dv_ref, *, sc, bq, bk, nq, causal,
+                      window):
     """One-pass backward: kv block j vs the VMEM-resident q/do row. dq
     accumulates into the full-[S, D] VMEM-resident output slab (index map
     depends only on the bh grid axis; the sequential grid makes the
@@ -158,10 +175,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0, pl.ds(i * bq, bq)][:, None]       # [bq, 1]
         delta = delta_ref[0, 0, pl.ds(i * bq, bq)][:, None]
         s = jnp.dot(qi_, k.T, preferred_element_type=jnp.float32) * sc
-        if causal:
+        if causal or window is not None:
             qi = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
             ki = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(qi >= ki, s, NEG_INF)
+            live = qi >= ki if causal else (qi == qi)
+            if window is not None:
+                live &= qi - ki < window
+            s = jnp.where(live, s, NEG_INF)
         p = jnp.exp(s - lse).astype(k.dtype)
         dv_acc += jnp.dot(p.T, doi, preferred_element_type=jnp.float32)
         dp = jnp.dot(doi, v.T, preferred_element_type=jnp.float32)
@@ -172,16 +192,20 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 preferred_element_type=jnp.float32) * sc
         return dk_acc, dv_acc
 
-    # causal: kv block j is attended by q blocks [j, nq) (bq == bk)
+    # causal: kv block j is attended by q blocks [j, nq) (bq == bk); a
+    # window additionally caps the sweep at its last live block
     lo = j if causal else 0
+    hi = (jnp.minimum(nq, (j * bk + bk - 1 + window - 1) // bq + 1)
+          if window is not None else nq)
     dk_acc, dv_acc = jax.lax.fori_loop(
-        lo, nq, body,
+        lo, hi, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
     dk_ref[0] = dk_acc
     dv_ref[0] = dv_acc
 
 
-def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, sc: float):
+def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, sc: float,
+               window: int | None = None):
     bh, s, d = q.shape
     bq = bk = _block(s)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -195,57 +219,66 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, sc: float):
                            memory_space=pltpu.VMEM)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sc=sc, bq=bq, bk=bk,
-                          nq=s // bq, causal=causal),
+                          nq=s // bq, causal=causal, window=window),
         grid=(bh, s // bk),
         in_specs=[rowfull, kspec, kspec, rowfull, rowstat, rowstat],
         out_specs=[rowfull, kspec, kspec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, s, d), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------- public
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash(q, k, v, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, window):
     sc = 1.0 / np.sqrt(q.shape[-1])
-    o, _ = _flash_fwd(q, k, v, causal=causal, sc=sc)
+    o, _ = _flash_fwd(q, k, v, causal=causal, sc=sc, window=window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal):
+def _flash_fwd_rule(q, k, v, causal, window):
     sc = 1.0 / np.sqrt(q.shape[-1])
-    o, lse = _flash_fwd(q, k, v, causal=causal, sc=sc)
+    o, lse = _flash_fwd(q, k, v, causal=causal, sc=sc, window=window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, res, do):
+def _flash_bwd_rule(causal, window, res, do):
     q, k, v, o, lse = res
     sc = 1.0 / np.sqrt(q.shape[-1])
-    return _flash_bwd(q, k, v, o, lse, do, causal=causal, sc=sc)
+    return _flash_bwd(q, k, v, o, lse, do, causal=causal, sc=sc,
+                      window=window)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, **_kw):
+def flash_attention(q, k, v, *, causal: bool = True,
+                    window: int | None = None, **_kw):
     """Drop-in attn_fn: q [B, S, Hq, D], k/v [B, S, Hkv, D] (GQA repeats
-    kv), matches ops.layers.dot_product_attention numerics.
+    kv), matches ops.layers.dot_product_attention numerics. ``window``
+    restricts each query to its last `window` positions (Mistral sliding
+    window; kernel skips blocks fully outside the band).
 
     Dispatches to the in-repo one-pass kernel (see module docstring); for
     sequences past the VMEM residency cap it falls back to the stock
-    two-pass jax.experimental kernel on TPU.
+    two-pass jax.experimental kernel on TPU (full-causal only — a window
+    there falls back to the exact masked form).
     """
     b, s, hq, d = q.shape
     hkv = k.shape[2]
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (Mistral SWA)")
     if s > 128 and s % 128 != 0:
         # the blocked kernels require 128-aligned sequence lengths; an
         # unaligned tail would be silently dropped by the grid floor
         # division — use the exact (unfused) path instead
-        from ..layers import dot_product_attention
-        return dot_product_attention(q, k, v, causal=causal)
+        from ..layers import dot_product_attention, window_bias
+        bias = window_bias(s, window) if window is not None else None
+        return dot_product_attention(q, k, v, causal=causal, bias=bias)
     if hq != hkv:
         rep = hq // hkv
         k = jnp.repeat(k, rep, axis=2)
@@ -253,12 +286,21 @@ def flash_attention(q, k, v, *, causal: bool = True, **_kw):
     from jax.ad_checkpoint import checkpoint_name
     bhsd = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
     if jax.default_backend() == "tpu" and s > _RESIDENT_MAX_SEQ:
-        if d % 8 != 0:
-            # the stock kernel needs 8-aligned head dims and the resident
-            # kernel's VMEM budget is sized for s <= _RESIDENT_MAX_SEQ —
-            # neither fused path is safe here
-            from ..layers import dot_product_attention
-            return dot_product_attention(q, k, v, causal=causal)
+        if d % 8 != 0 or window is not None:
+            # the stock kernel needs 8-aligned head dims and supports no
+            # window, and the resident kernel's VMEM budget is sized for
+            # s <= _RESIDENT_MAX_SEQ — use the exact masked form
+            from ..layers import dot_product_attention, window_bias
+            from ...utils.logging import warning_once
+            warning_once(
+                f"flash attention falling back to the exact masked form "
+                f"(O(S^2) memory) at seq {s}: "
+                + ("sliding windows are only fused up to seq "
+                   f"{_RESIDENT_MAX_SEQ}" if window is not None
+                   else f"head_dim {d} is not 8-aligned"))
+            bias = window_bias(s, window) if window is not None else None
+            return dot_product_attention(q, k, v, causal=causal,
+                                         bias=bias)
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             BlockSizes, flash_attention as tpu_flash)
         blk = _block(s)
@@ -272,6 +314,6 @@ def flash_attention(q, k, v, *, causal: bool = True, **_kw):
         return checkpoint_name(
             o.transpose(0, 2, 1, 3).astype(q.dtype), "attn_out")
     to_bh = lambda x: bhsd(x).reshape(b * hq, s, d)  # noqa: E731
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, window)
     return checkpoint_name(
         o.reshape(b, hq, s, d).transpose(0, 2, 1, 3), "attn_out")

@@ -181,3 +181,51 @@ def test_pallas_norms_match_reference():
     g = jax.grad(lambda x: jnp.sum(norms.rms_norm(x, s) ** 2))(x)
     g_ref = jax.grad(lambda x: jnp.sum(L.rms_norm(x, s) ** 2))(x)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5)
+
+
+def test_flash_attention_sliding_window():
+    """Windowed flash (Mistral SWA; reference masks via layout) matches
+    the exact masked form, forward and gradients — the kernel skips
+    blocks fully outside the band instead of masking O(S^2)."""
+    from deepspeed_tpu.ops.layers import dot_product_attention
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    for s, w in [(256, 64), (256, 16), (384, 100), (128, 200)]:
+        ks = jax.random.split(key, 3)
+        q = jax.random.normal(ks[0], (2, s, 4, 64), jnp.float32)
+        k = jax.random.normal(ks[1], (2, s, 4, 64), jnp.float32)
+        v = jax.random.normal(ks[2], (2, s, 4, 64), jnp.float32)
+        qi = jnp.arange(s)[:, None]
+        ki = jnp.arange(s)[None, :]
+        bias = jnp.where(qi - ki < w, 0.0, -1e30)[None, None]
+        ref = dot_product_attention(q, k, v, causal=True, bias=bias)
+        out = flash_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        g1 = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, window=w) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: jnp.sum(dot_product_attention(
+            q, k, v, causal=True, bias=bias) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+
+def test_mistral_sliding_window_uses_flash():
+    """Mistral's sliding_window rides the flash kernel (no O(S^2) masked
+    fallback) and matches the reference attention implementation."""
+    from deepspeed_tpu.models import Mistral
+
+    m_flash = Mistral(size="tiny", sliding_window=16, attn_impl="flash",
+                      max_seq_len=128)
+    m_ref = Mistral(size="tiny", sliding_window=16,
+                    attn_impl="reference", max_seq_len=128)
+    p = m_flash.init(jax.random.PRNGKey(0))
+    t = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                           m_flash.config.vocab_size)
+    np.testing.assert_allclose(np.asarray(m_flash.apply(p, t)),
+                               np.asarray(m_ref.apply(p, t)),
+                               atol=2e-5, rtol=2e-5)
