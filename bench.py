@@ -43,7 +43,8 @@ def _train_tput(ds, model, config_extra: dict, batch: int, seq: int,
                 steps: int, windows: int = 1):
     """Shared throughput harness: build an engine, warm up, run best-of-
     `windows` timed loops with a device->host sync (float(loss)) per
-    window. Returns (tokens/s, engine-free)."""
+    window. Returns (tokens/s, last loss). The engine is freed when this
+    frame returns (main() gc.collect()s between sections)."""
     config = {
         "train_batch_size": batch,
         "optimizer": {"type": "FusedAdam",
@@ -63,9 +64,9 @@ def _train_tput(ds, model, config_extra: dict, batch: int, seq: int,
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = engine.train_batch(data)
-        float(loss)  # device->host copy = reliable sync under the tunnel
+        last = float(loss)  # device->host copy = reliable sync (tunnel)
         dt = min(dt, time.perf_counter() - t0)
-    return steps * batch * seq / dt
+    return steps * batch * seq / dt, last
 
 
 def kernel_smoke() -> dict:
@@ -173,9 +174,9 @@ def llama_bench(ds, on_tpu: bool):
                    vocab_size=32000, max_seq_len=seq,
                    remat_policy="segments", attn_impl="flash")
              if on_tpu else Llama(size="tiny", max_seq_len=seq))
-    tps = _train_tput(ds, model, {"gradient_clipping": 1.0}, batch, seq,
-                      steps=10 if on_tpu else 2,
-                      windows=2 if on_tpu else 1)
+    tps, _ = _train_tput(ds, model, {"gradient_clipping": 1.0}, batch,
+                         seq, steps=10 if on_tpu else 2,
+                         windows=2 if on_tpu else 1)
     mfu = tps * model.config.flops_per_token(seq) / peak_flops(
         jax.devices()[0])
     return {"metric": "llama_340m_train_tokens_per_sec",
@@ -196,8 +197,8 @@ def longctx_bench(ds, on_tpu: bool):
                    remat_policy="segments", attn_impl="flash",
                    loss_chunk=2048)
              if on_tpu else Llama(size="tiny", max_seq_len=seq))
-    tps = _train_tput(ds, model, {}, batch=1, seq=seq,
-                      steps=4 if on_tpu else 1)
+    tps, _ = _train_tput(ds, model, {}, batch=1, seq=seq,
+                         steps=4 if on_tpu else 1)
     mfu = tps * model.config.flops_per_token(seq) / peak_flops(
         jax.devices()[0])
     return {"metric": "llama_32k_seq_train_tokens_per_sec",
@@ -218,8 +219,8 @@ def moe_bench(ds, on_tpu: bool):
                      max_seq_len=seq, remat_policy="segments",
                      attn_impl="flash")
              if on_tpu else Mixtral(size="tiny", max_seq_len=seq))
-    tps = _train_tput(ds, model, {}, batch, seq,
-                      steps=8 if on_tpu else 1)
+    tps, _ = _train_tput(ds, model, {}, batch, seq,
+                         steps=8 if on_tpu else 1)
     return {"metric": "mixtral_8e_top2_train_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/s/chip"}
 
@@ -276,41 +277,15 @@ def main():
     model = (GPT2(size=size, vocab_size=50304,
                   remat_policy="segments", attn_impl="flash")
              if on_tpu else GPT2(size=size, max_seq_len=seq))
-    config = {
-        "train_batch_size": batch,
-        "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "FusedAdam",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "gradient_clipping": 1.0,
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2},
-        "steps_per_print": 10 ** 9,
-    }
-    engine, _, _, _ = ds.initialize(model=model, config=config)
-
-    key = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(key, (batch, seq + 1), 0,
-                                model.config.vocab_size)
-    data = (tokens[:, :-1], tokens[:, 1:])
-
-    # warmup/compile (float() forces a device->host sync; plain
-    # block_until_ready can return early under the remote-tunnel backend)
-    float(engine.train_batch(data))
-
     # best-of-3 windows: the remote-tunnel backend occasionally serves a
     # cold/slow first window (observed 2.7x on otherwise identical runs);
     # min over windows reports steady-state device throughput
-    steps = 10 if on_tpu else 3
-    windows = 3 if on_tpu else 1
-    dt = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = engine.train_batch(data)
-        loss = float(loss)  # device->host copy = reliable sync
-        dt = min(dt, time.perf_counter() - t0)
-
-    tokens_per_sec = steps * batch * seq / dt
+    tokens_per_sec, loss = _train_tput(
+        ds, model,
+        {"gradient_clipping": 1.0, "gradient_accumulation_steps": 1},
+        batch, seq, steps=10 if on_tpu else 3,
+        windows=3 if on_tpu else 1)
+    dt_steps = batch * seq / tokens_per_sec      # seconds per step
     flops_per_token = model.config.flops_per_token(seq)
     achieved = tokens_per_sec * flops_per_token
     mfu = achieved / peak_flops(jax.devices()[0])
@@ -321,12 +296,12 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
     }))
-    print(f"# mfu={mfu:.3f} loss={float(loss):.4f} step_ms={dt / steps * 1e3:.1f}",
+    print(f"# mfu={mfu:.3f} loss={loss:.4f} step_ms={dt_steps * 1e3:.1f}",
           file=sys.stderr)
     # free the headline engine's HBM before the tail sections — each
-    # builds its own engine and the states would otherwise accumulate
+    # builds its own engine inside _train_tput and the states would
+    # otherwise accumulate
     import gc
-    del engine, data, tokens, loss
     gc.collect()
     for name, fn in [("llama", llama_bench), ("longctx", longctx_bench),
                      ("moe", moe_bench), ("offload", offload_smoke)]:

@@ -239,10 +239,6 @@ class DeepSpeedEngine:
 
             self._loss_fn_dev_step = _loss_on_device_step
         if self._nvme_offload:
-            if self._is_pipeline:
-                raise ValueError(
-                    "offload_optimizer device=nvme is not supported with "
-                    "pipeline parallelism")
             from .offload import NVMeOffloadOptimizer
             self._offload_opt = NVMeOffloadOptimizer(self)
             self._train_step = self._build_grads_step()
