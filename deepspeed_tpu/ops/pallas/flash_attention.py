@@ -72,7 +72,10 @@ def _block(s: int) -> int:
 
 # ---------------------------------------------------------------- forward
 def _flash_fwd(q, k, v, *, causal: bool, sc: float,
-               window: int | None = None):
+               window: int | None = None, rep: int = 1):
+    """``rep``: GQA group size — q rows are [B*Hq, S, D], k/v rows
+    [B*Hkv, S, D]; the kv index maps divide the q-head grid index by
+    ``rep`` instead of materializing repeated k/v."""
     bh, s, d = q.shape
     bq = bk = _block(s)
     grid = (bh, s // bq)
@@ -84,9 +87,9 @@ def _flash_fwd(q, k, v, *, causal: bool, sc: float,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, s, d), lambda b, i: (b // rep, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, s, d), lambda b, i: (b // rep, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -154,6 +157,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sc, bq, bk, nk,
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dk_ref, dv_ref, *, sc, bq, bk, nq, causal,
                       window):
+    # dk/dv are emitted PER Q-HEAD (summed over the GQA group outside —
+    # cheap XLA reduce); k/v rows are indexed b // rep by the caller
     """One-pass backward: kv block j vs the VMEM-resident q/do row. dq
     accumulates into the full-[S, D] VMEM-resident output slab (index map
     depends only on the bh grid axis; the sequential grid makes the
@@ -205,7 +210,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, sc: float,
-               window: int | None = None):
+               window: int | None = None, rep: int = 1):
     bh, s, d = q.shape
     bq = bk = _block(s)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -213,44 +218,52 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal: bool, sc: float,
 
     rowfull = pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0),
                            memory_space=pltpu.VMEM)
-    kspec = pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
-                         memory_space=pltpu.VMEM)
+    kin = pl.BlockSpec((1, bk, d), lambda b, j: (b // rep, j, 0),
+                       memory_space=pltpu.VMEM)
+    kout = pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0),
+                        memory_space=pltpu.VMEM)
     rowstat = pl.BlockSpec((1, 1, s), lambda b, j: (b, 0, 0),
                            memory_space=pltpu.VMEM)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sc=sc, bq=bq, bk=bk,
                           nq=s // bq, causal=causal, window=window),
         grid=(bh, s // bk),
-        in_specs=[rowfull, kspec, kspec, rowfull, rowstat, rowstat],
-        out_specs=[rowfull, kspec, kspec],
+        in_specs=[rowfull, kin, kin, rowfull, rowstat, rowstat],
+        out_specs=[rowfull, kout, kout],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, s, d), jnp.float32)],
         compiler_params=_COMPILER_PARAMS,
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
+    if rep > 1:
+        # per-q-head dk/dv -> per-kv-head (consecutive q heads share kv)
+        dk = dk.reshape(bh // rep, rep, s, d).sum(axis=1)
+        dv = dv.reshape(bh // rep, rep, s, d).sum(axis=1)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------- public
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, window, rep):
     sc = 1.0 / np.sqrt(q.shape[-1])
-    o, _ = _flash_fwd(q, k, v, causal=causal, sc=sc, window=window)
+    o, _ = _flash_fwd(q, k, v, causal=causal, sc=sc, window=window,
+                      rep=rep)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, window):
+def _flash_fwd_rule(q, k, v, causal, window, rep):
     sc = 1.0 / np.sqrt(q.shape[-1])
-    o, lse = _flash_fwd(q, k, v, causal=causal, sc=sc, window=window)
+    o, lse = _flash_fwd(q, k, v, causal=causal, sc=sc, window=window,
+                        rep=rep)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, window, res, do):
+def _flash_bwd_rule(causal, window, rep, res, do):
     q, k, v, o, lse = res
     sc = 1.0 / np.sqrt(q.shape[-1])
     return _flash_bwd(q, k, v, o, lse, do, causal=causal, sc=sc,
-                      window=window)
+                      window=window, rep=rep)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -258,8 +271,11 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     window: int | None = None, **_kw):
-    """Drop-in attn_fn: q [B, S, Hq, D], k/v [B, S, Hkv, D] (GQA repeats
-    kv), matches ops.layers.dot_product_attention numerics. ``window``
+    """Drop-in attn_fn: q [B, S, Hq, D], k/v [B, S, Hkv, D], matches
+    ops.layers.dot_product_attention numerics. GQA is native: the
+    kernels index the shared kv head per q-head group, so repeated k/v
+    are never materialized (and remat residuals store unrepeated k/v —
+    rep x smaller than the repeat-then-attend form). ``window``
     restricts each query to its last `window` positions (Mistral sliding
     window; kernel skips blocks fully outside the band).
 
@@ -272,6 +288,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     hkv = k.shape[2]
     if window is not None and not causal:
         raise ValueError("window requires causal=True (Mistral SWA)")
+    if hq % hkv != 0:
+        raise ValueError(f"q heads {hq} must be a multiple of kv heads "
+                         f"{hkv}")
+    rep = hq // hkv
     if s > 128 and s % 128 != 0:
         # the blocked kernels require 128-aligned sequence lengths; an
         # unaligned tail would be silently dropped by the grid floor
@@ -279,13 +299,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
         from ..layers import dot_product_attention, window_bias
         bias = window_bias(s, window) if window is not None else None
         return dot_product_attention(q, k, v, causal=causal, bias=bias)
-    if hq != hkv:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
     from jax.ad_checkpoint import checkpoint_name
     bhsd = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
     if jax.default_backend() == "tpu" and s > _RESIDENT_MAX_SEQ:
+        if rep > 1:
+            # fallback paths take per-q-head kv (dot_product_attention
+            # repeats internally; the stock kernel needs equal heads)
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         if d % 8 != 0 or window is not None:
             # the stock kernel needs 8-aligned head dims and supports no
             # window, and the resident kernel's VMEM budget is sized for
@@ -313,7 +334,11 @@ def flash_attention(q, k, v, *, causal: bool = True,
                       sm_scale=1.0 / np.sqrt(d), block_sizes=bs_)
         return checkpoint_name(
             o.transpose(0, 2, 1, 3).astype(q.dtype), "attn_out")
-    to_bh = lambda x: bhsd(x).reshape(b * hq, s, d)  # noqa: E731
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, window)
+    # GQA-native: k/v stay per-kv-head ([B*Hkv, S, D]); the kernels index
+    # kv rows at q_head_idx // rep, so repeated k/v are never
+    # materialized — and the custom-VJP residuals (what remat stores per
+    # layer) hold the UNREPEATED k/v
+    to_bh = lambda x: bhsd(x).reshape(-1, s, d)  # noqa: E731
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), causal, window, rep)
     return checkpoint_name(
         o.reshape(b, hq, s, d).transpose(0, 2, 1, 3), "attn_out")

@@ -30,11 +30,15 @@ def test_flash_non_causal():
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_grads_match_reference():
+@pytest.mark.parametrize("hq,hkv", [(2, 2), (8, 2), (4, 1)])
+def test_flash_grads_match_reference(hq, hkv):
+    """Gradients vs the exact reference, including GQA/MQA head ratios —
+    the GQA-native backward emits per-q-head dk/dv and group-sums them
+    (kernel indexes shared kv at q_head // rep; no repeated kv exists)."""
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(2), 3)
-    q = jax.random.normal(k1, (1, 128, 2, 32))
-    k = jax.random.normal(k2, (1, 128, 2, 32))
-    v = jax.random.normal(k3, (1, 128, 2, 32))
+    q = jax.random.normal(k1, (1, 256, hq, 32))
+    k = jax.random.normal(k2, (1, 256, hkv, 32))
+    v = jax.random.normal(k3, (1, 256, hkv, 32))
 
     def f_flash(q, k, v):
         return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
@@ -45,8 +49,9 @@ def test_flash_grads_match_reference():
     gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
+        assert a.shape == b.shape
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   atol=5e-5, rtol=5e-5)
+                                   atol=2e-4, rtol=2e-4)
 
 
 def test_flash_bf16():
