@@ -27,8 +27,8 @@ Design notes (why this beats the stock two-pass kernel at model shapes):
 Layout: wrapper takes [B, S, H, D] (model convention), kernels run on
 [B*H, S, D]. The log-sum-exp is carried as [BH, 1, S] so every block
 spec is TPU-legal ((1, 1, bq) blocks). VMEM residency caps the supported
-sequence length (_RESIDENT_MAX_SEQ); past it the wrapper falls back to
-the stock two-pass jax.experimental kernel.
+sequence length per head dim (_resident_max_seq); past it the wrapper
+falls back to the stock two-pass jax.experimental kernel.
 
 On non-TPU backends the kernels run in Pallas interpret mode (tests), so
 the same code path is exercised everywhere.
@@ -46,10 +46,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-# k/v (fwd) and q/do/dq (bwd) are VMEM-resident per (batch*head) row:
-# at 16k x 128 that is ~4M bf16 per operand + a 8M f32 dq slab, well
-# within the 128M VMEM of v5e/v5p next to the ~4M of block temporaries.
-_RESIDENT_MAX_SEQ = 16384
+# k/v (fwd) and q/do/dq (bwd) are VMEM-resident per (batch*head) row, so
+# the working set scales with s*d: at 32k x 128 that is ~8M bf16 per
+# operand + a 16M f32 dq slab — ~45M total against the raised
+# _COMPILER_PARAMS ceiling (v5e/v5p have 128M). The dispatch gates on
+# s*d (64k at d=64, 32k at d=128, 16k at d=256). Measured at seq 32768
+# x d128 on v5e: 1.38x the stock two-pass kernel's training throughput
+# (bench.py longctx section).
+_RESIDENT_MAX_ELEMS = 32768 * 128
+
+
+def _resident_max_seq(d: int) -> int:
+    return _RESIDENT_MAX_ELEMS // max(d, 1)
 
 # the row-resident kernels hold [S, D] slabs (q/do/dq + temps) in VMEM;
 # Mosaic's default 16MB scoped-vmem ceiling trips at long seq x D=128 —
@@ -301,7 +309,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
         return dot_product_attention(q, k, v, causal=causal, bias=bias)
     from jax.ad_checkpoint import checkpoint_name
     bhsd = lambda x: x.transpose(0, 2, 1, 3)  # noqa: E731
-    if jax.default_backend() == "tpu" and s > _RESIDENT_MAX_SEQ:
+    if jax.default_backend() == "tpu" and s > _resident_max_seq(d):
         if rep > 1:
             # fallback paths take per-q-head kv (dot_product_attention
             # repeats internally; the stock kernel needs equal heads)
@@ -310,14 +318,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
         if d % 8 != 0 or window is not None:
             # the stock kernel needs 8-aligned head dims and supports no
             # window, and the resident kernel's VMEM budget is sized for
-            # s <= _RESIDENT_MAX_SEQ — use the exact masked form
+            # s <= _resident_max_seq(d) — use the exact masked form
             from ..layers import dot_product_attention, window_bias
             from ...utils.logging import warning_once
             warning_once(
                 f"flash attention falling back to the exact masked form "
                 f"(O(S^2) memory) at seq {s}: "
                 + ("sliding windows are only fused up to seq "
-                   f"{_RESIDENT_MAX_SEQ}" if window is not None
+                   f"{_resident_max_seq(d)} at head_dim {d}"
+                   if window is not None
                    else f"head_dim {d} is not 8-aligned"))
             bias = window_bias(s, window) if window is not None else None
             return dot_product_attention(q, k, v, causal=causal,
