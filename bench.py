@@ -225,6 +225,65 @@ def moe_bench(ds, on_tpu: bool):
             "value": round(tps, 1), "unit": "tokens/s/chip"}
 
 
+def serving_bench(ds, on_tpu: bool):
+    """Serving class (BASELINE configs 1-2 / FastGen): greedy batch
+    decode on the Llama-340M-class model. Reports the v1 engine's
+    compiled decode loop (the CUDA-graph analogue — one dispatch per
+    batch); the v2 per-tick scheduler is dispatch-bound through this
+    harness's remote tunnel (~100ms RTT per tick), so its wall-clock
+    here reflects the tunnel, not the engine — its tick RTT is reported
+    for the record."""
+    import numpy as np
+    from deepspeed_tpu.models import Llama
+    if on_tpu:
+        model = Llama(hidden_size=1024, num_layers=12, num_heads=8,
+                      num_kv_heads=8, intermediate_size=2816,
+                      vocab_size=32000, max_seq_len=2048)
+        B, P, N = 24, 256, 64
+    else:
+        model = Llama(size="tiny", max_seq_len=256)
+        B, P, N = 2, 16, 4
+    e = ds.init_inference(model, dtype="bfloat16" if on_tpu else "float32",
+                          max_out_tokens=1024 if on_tpu else 64)
+    rng = np.random.default_rng(0)
+    prompts = jnp.asarray(rng.integers(0, model.config.vocab_size,
+                                       size=(B, P)))
+    np.asarray(e.generate(prompts, max_new_tokens=N))   # warmup/compile
+    reps = 3 if on_tpu else 1
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = e.generate(prompts, max_new_tokens=N)
+    np.asarray(out)
+    dt = (time.perf_counter() - t0) / reps
+    # v2 scheduler tick RTT (one bucketed decode tick through put())
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    e2 = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="bfloat16" if on_tpu else "float32", kv_block_size=64,
+        num_kv_blocks=128, max_chunk_size=256))
+    n = min(4, B)
+    uids = list(range(n))
+    e2.put(uids, [prompts[i, :16].tolist() for i in range(n)])
+
+    def one_tick():
+        e2.schedule(uids, [[1]] * n, do_checks=False)
+        res = e2.tick()
+        # decode ticks finish every sequence's single pending token, so
+        # res is non-empty; the float() forces a device->host sync
+        # (block_until_ready can return early under the remote tunnel)
+        float(jnp.sum(next(iter(res.values()))))
+
+    one_tick()                  # warm the decode bucket's executable
+    t1 = time.perf_counter()
+    for _ in range(8):
+        one_tick()
+    tick_ms = (time.perf_counter() - t1) / 8 * 1e3
+    return {"metric": "serving_decode_tokens_per_sec",
+            "value": round(B * N / dt, 1), "unit": "tokens/s/chip",
+            "batch": B, "with_prefill": round(B * (N + P) / dt, 1),
+            "v2_tick_rtt_ms": round(tick_ms, 1)}
+
+
 def offload_smoke(ds, on_tpu: bool):
     """ZeRO-Offload tier on real hardware: master weights + optimizer
     state live in pinned_host memory inside the compiled step
@@ -304,7 +363,8 @@ def main():
     import gc
     gc.collect()
     for name, fn in [("llama", llama_bench), ("longctx", longctx_bench),
-                     ("moe", moe_bench), ("offload", offload_smoke)]:
+                     ("moe", moe_bench), ("serving", serving_bench),
+                     ("offload", offload_smoke)]:
         try:
             print(f"# {name} " + json.dumps(fn(ds, on_tpu)),
                   file=sys.stderr)

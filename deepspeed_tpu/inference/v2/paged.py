@@ -48,6 +48,120 @@ def place_in_pages(pages: jax.Array, kv: jax.Array, pos0: jax.Array,
         kv.astype(pages.dtype), mode="drop")
 
 
+def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
+                           pos0, true_len, *, window: int | None = None):
+    """Blocked-flash Pallas kernel (reference:
+    inference/v2/kernels/ragged_ops/blocked_flash): attention reads KV
+    pages straight from the pool through scalar-prefetched block tables —
+    no gathered [B, smax, H, D] materialization — and folds this chunk's
+    fresh k/v in at the end (their pool slots are written after the layer
+    scan, so pages and fresh tokens never overlap).
+
+    Grid is (batch, page-slot); blocks carry ALL heads (full-head block
+    dims equal the array dims, keeping every BlockSpec TPU-legal) and a
+    static Python loop handles the per-head matmuls — GQA indexes the
+    shared kv head directly. Forward-only (inference).
+
+    q/k_new/v_new: [B, S_new, H(q/kv), D]; pools [nb, bs, Hkv, D];
+    block_tables [B, max_blocks] (entries clamped here); pos0/true_len
+    [B]. Returns [B, S_new, Hq, D].
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, hq, d = q.shape
+    hkv = k_new.shape[2]
+    rep = hq // hkv
+    nb, bs = k_pool.shape[0], k_pool.shape[1]
+    max_blocks = block_tables.shape[1]
+    counts = (-(-jnp.asarray(pos0, jnp.int32) // bs)).astype(jnp.int32)
+    tables = jnp.minimum(block_tables, nb - 1).astype(jnp.int32)
+    sc = 1.0 / np.sqrt(d)
+
+    def kernel(counts_ref, tables_ref, pos0_ref, tlen_ref, q_ref, kn_ref,
+               vn_ref, kp_ref, vp_ref, o_ref, m_s, l_s):
+        bi = pl.program_id(0)
+        t = pl.program_id(1)
+        count = counts_ref[bi]
+        p0 = pos0_ref[bi]
+        tl = tlen_ref[bi]
+
+        @pl.when(t == 0)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+            m_s[:] = jnp.full_like(m_s, -1e30)
+            l_s[:] = jnp.zeros_like(l_s)
+
+        def fold(k_ref_, v_ref_, base, limit):
+            """Accumulate one kv block whose rows sit at absolute
+            positions base+[0, blk); positions >= limit are dead."""
+            for h in range(hq):
+                qv = q_ref[0, :, h, :]                      # [sq, d]
+                kblk = k_ref_[0, :, h // rep, :]            # [blk, d]
+                vblk = v_ref_[0, :, h // rep, :]
+                s = jnp.dot(qv, kblk.T,
+                            preferred_element_type=jnp.float32) * sc
+                qpos = p0 + jax.lax.broadcasted_iota(jnp.int32, s.shape,
+                                                     0)
+                kpos = base + jax.lax.broadcasted_iota(jnp.int32,
+                                                       s.shape, 1)
+                live = (kpos <= qpos) & (kpos < limit) \
+                    & (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                       < tl)
+                if window is not None:
+                    live &= qpos - kpos < window
+                s = jnp.where(live, s, -1e30)
+                rows = pl.ds(h * sq, sq)
+                m_prev = m_s[rows, :1]
+                l_prev = l_s[rows, :1]
+                m_new = jnp.maximum(
+                    m_prev, jnp.max(s, axis=-1, keepdims=True))
+                p = jnp.exp(s - m_new)
+                corr = jnp.exp(m_prev - m_new)
+                l_s[rows, :1] = l_prev * corr + jnp.sum(
+                    p, axis=-1, keepdims=True)
+                o_ref[0, :, h, :] = (o_ref[0, :, h, :] * corr
+                                     + jnp.dot(
+                                         p.astype(kblk.dtype), vblk,
+                                         preferred_element_type=jnp
+                                         .float32))
+                m_s[rows, :1] = m_new
+
+        @pl.when(t < count)
+        def _():
+            fold(kp_ref, vp_ref, t * bs, p0)
+
+        @pl.when(t == jnp.maximum(count - 1, 0))
+        def _():
+            fold(kn_ref, vn_ref, p0, p0 + tl)
+            for h in range(hq):
+                l = jnp.maximum(l_s[pl.ds(h * sq, sq), :1], 1e-30)
+                o_ref[0, :, h, :] = o_ref[0, :, h, :] / l
+
+    grid = (b, max_blocks)
+    qspec = pl.BlockSpec((1, sq, hq, d),
+                         lambda b, t, c, tb, p, tl: (b, 0, 0, 0))
+    nspec = pl.BlockSpec((1, sq, hkv, d),
+                         lambda b, t, c, tb, p, tl: (b, 0, 0, 0))
+    pspec = pl.BlockSpec((1, bs, hkv, d),
+                         lambda b, t, c, tb, p, tl: (tb[b, t], 0, 0, 0))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[qspec, nspec, nspec, pspec, pspec],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((hq * sq, 128), jnp.float32),
+                            pltpu.VMEM((hq * sq, 128), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, sq, hq, d), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(counts, tables, jnp.asarray(pos0, jnp.int32),
+      jnp.asarray(true_len, jnp.int32), q, k_new, v_new, k_pool, v_pool)
+    return out.astype(q.dtype)
+
+
 def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     pos0: jax.Array,
                     window: int | None = None):
@@ -101,12 +215,20 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         p, k_pool, v_pool = xs
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
-        k_pages = place_in_pages(gather_pages(k_pool, block_tables), k,
-                                 pos0, true_len)
-        v_pages = place_in_pages(gather_pages(v_pool, block_tables), v,
-                                 pos0, true_len)
-        a = paged_attention(q, k_pages, v_pages, pos0,
-                            window=model.config.sliding_window)
+        bs_ = k_pool.shape[1]
+        if q.shape[-1] % 8 == 0 and bs_ % 8 == 0:
+            # blocked-flash kernel: reads pages via the block table, no
+            # gathered [B, smax, H, D] materialization
+            a = paged_attention_kernel(
+                q, k, v, k_pool, v_pool, block_tables, pos0, true_len,
+                window=model.config.sliding_window)
+        else:
+            k_pages = place_in_pages(gather_pages(k_pool, block_tables),
+                                     k, pos0, true_len)
+            v_pages = place_in_pages(gather_pages(v_pool, block_tables),
+                                     v, pos0, true_len)
+            a = paged_attention(q, k_pages, v_pages, pos0,
+                                window=model.config.sliding_window)
         if model.config.parallel_residual:
             m, _ = model._mlp(p, h)
             return x + model._attn_out(p, a) + m, (k, v)

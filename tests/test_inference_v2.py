@@ -209,3 +209,43 @@ def test_put_preserves_other_callers_finished_logits(devices8):
     np.testing.assert_allclose(np.asarray(done[0]),
                                np.asarray(f_long[0, -1]),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_paged_kernel_sliding_window(devices8):
+    """The blocked-flash kernel's sliding-window mask (Mistral SWA) must
+    match the jnp paged_attention reference over pages + fresh chunk at
+    unaligned cache offsets."""
+    from deepspeed_tpu.inference.v2.paged import (gather_pages,
+                                                  paged_attention,
+                                                  paged_attention_kernel,
+                                                  place_in_pages)
+
+    key = jax.random.PRNGKey(0)
+    B, SQ, H, D, NB, BS, W = 2, 8, 4, 32, 16, 8, 11
+    ks = jax.random.split(key, 5)
+    q = jax.random.normal(ks[0], (B, SQ, H, D))
+    k_new = jax.random.normal(ks[1], (B, SQ, H, D))
+    v_new = jax.random.normal(ks[2], (B, SQ, H, D))
+    k_pool = jax.random.normal(ks[3], (NB, BS, H, D))
+    v_pool = jax.random.normal(ks[4], (NB, BS, H, D))
+    tables = jnp.asarray(np.random.default_rng(1).permutation(NB)[:B * 6]
+                         .reshape(B, 6))
+    pos0 = jnp.asarray([13, 0])        # unaligned offset + empty cache
+    true_len = jnp.asarray([SQ, 5])
+
+    out = paged_attention_kernel(q, k_new, v_new, k_pool, v_pool,
+                                 tables, pos0, true_len, window=W)
+    k_pages = place_in_pages(gather_pages(k_pool, tables), k_new, pos0,
+                             true_len)
+    v_pages = place_in_pages(gather_pages(v_pool, tables), v_new, pos0,
+                             true_len)
+    # reference sees the gathered view; positions past pos0+true_len in
+    # the pages are garbage — mask them the way paged_forward's callers
+    # guarantee (pool slots beyond the cache are never attended because
+    # qpos < pos0 + true_len for every valid query)
+    ref = paged_attention(q, k_pages, v_pages, pos0, window=W)
+    for b in range(B):
+        tl = int(true_len[b])
+        np.testing.assert_allclose(np.asarray(out[b, :tl]),
+                                   np.asarray(ref[b, :tl]),
+                                   atol=2e-5, rtol=2e-5)
