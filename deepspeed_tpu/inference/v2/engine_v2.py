@@ -99,8 +99,13 @@ class InferenceEngineV2:
         # step); for generation loops where per-dispatch latency matters
         # more than admission control, the v1/hybrid engines compile the
         # whole decode loop into a single program instead.
+        # the blocked-flash kernel is an opaque custom call GSPMD cannot
+        # partition: with tp>1 it would force pool gathers — use the jnp
+        # paged path there (sharding-transparent); shard_map-wrapping the
+        # kernel per tp shard is the follow-up
         self._step = jax.jit(
-            functools.partial(paged_forward, self.model),
+            functools.partial(paged_forward, self.model,
+                              use_kernel=(tp <= 1)),
             donate_argnums=(1,),
             out_shardings=(None, {"k": self._pool_sharding,
                                   "v": self._pool_sharding}))

@@ -127,7 +127,14 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                                          .float32))
                 m_s[rows, :1] = m_new
 
-        @pl.when(t < count)
+        page_live = t < count
+        if window is not None:
+            # pages entirely older than the window contribute nothing —
+            # skip their compute (their DMA is also elided: the index
+            # map clamps dead slots onto a live page)
+            page_live &= (t + 1) * bs > p0 - window
+
+        @pl.when(page_live)
         def _():
             fold(kp_ref, vp_ref, t * bs, p0)
 
@@ -143,8 +150,18 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                          lambda b, t, c, tb, p, tl: (b, 0, 0, 0))
     nspec = pl.BlockSpec((1, sq, hkv, d),
                          lambda b, t, c, tb, p, tl: (b, 0, 0, 0))
-    pspec = pl.BlockSpec((1, bs, hkv, d),
-                         lambda b, t, c, tb, p, tl: (tb[b, t], 0, 0, 0))
+
+    def page_idx(b, t, c, tb, p, tl):
+        # clamp dead grid slots (t >= count, or pages older than the
+        # window) onto a live page: consecutive identical block indices
+        # let Pallas elide the DMA, so short sequences don't pay
+        # full-table page traffic every tick
+        hi = jnp.maximum(c[b] - 1, 0)
+        lo = (jnp.maximum((p[b] - window) // bs, 0)
+              if window is not None else 0)
+        return (tb[b, jnp.clip(t, lo, hi)], 0, 0, 0)
+
+    pspec = pl.BlockSpec((1, bs, hkv, d), page_idx)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -191,7 +208,7 @@ def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
                   pos0: jax.Array, block_tables: jax.Array,
-                  true_len: jax.Array):
+                  true_len: jax.Array, use_kernel: bool = True):
     """Full model pass over a (padded) chunk of new tokens with paged KV.
 
     tokens [B, S]; pos0 [B]; block_tables [B, max_blocks]; true_len [B]
@@ -216,7 +233,7 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
         bs_ = k_pool.shape[1]
-        if q.shape[-1] % 8 == 0 and bs_ % 8 == 0:
+        if use_kernel and q.shape[-1] % 8 == 0 and bs_ % 8 == 0:
             # blocked-flash kernel: reads pages via the block table, no
             # gathered [B, smax, H, D] materialization
             a = paged_attention_kernel(
