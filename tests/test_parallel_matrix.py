@@ -46,3 +46,27 @@ def test_composed_parallelism_trains(name, model_fn, mesh, zero, devices8):
     losses = [float(engine.train_batch(batch(8))) for _ in range(3)]
     assert all(np.isfinite(losses)), (name, losses)
     assert losses[-1] < losses[0], (name, losses)
+
+
+def test_windowed_flash_x_pipeline_x_fsdp(devices8):
+    """Round-2 composition: Mistral sliding-window flash attention under
+    the 1F1B pipeline with fsdp sharding — windowed kernel, hand-
+    scheduled pipeline, and ZeRO sharding in one compiled program."""
+    from deepspeed_tpu.models import Mistral
+    from deepspeed_tpu.runtime.pipe import PipelineModule
+
+    model = Mistral(size="tiny", num_layers=4, sliding_window=16,
+                    attn_impl="flash", max_seq_len=128)
+    engine, _, _, _ = ds.initialize(
+        model=PipelineModule(model=model),
+        config={"train_batch_size": 16,
+                "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "mesh": {"pp": 2, "fsdp": -1},
+                "pipeline": {"schedule": "1f1b"},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 100})
+    losses = [float(engine.train_batch(batch(16, seq=64)))
+              for _ in range(4)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
