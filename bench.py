@@ -39,6 +39,11 @@ def peak_flops(device) -> float:
 
 
 
+def _cpu_batch(per_dev: int = 2) -> int:
+    """CPU-smoke batch: must divide the (possibly virtual) dp world."""
+    return per_dev * len(jax.devices())
+
+
 def _train_tput(ds, model, config_extra: dict, batch: int, seq: int,
                 steps: int, windows: int = 1):
     """Shared throughput harness: build an engine, warm up, run best-of-
@@ -168,7 +173,7 @@ def llama_bench(ds, on_tpu: bool):
     ZeRO-2 + fused Adam at seq 2048."""
     from deepspeed_tpu.models import Llama
     seq = 2048 if on_tpu else 128
-    batch = 4 if on_tpu else 2
+    batch = 4 if on_tpu else _cpu_batch()
     model = (Llama(hidden_size=1024, num_layers=24, num_heads=8,
                    num_kv_heads=8, intermediate_size=2816,
                    vocab_size=32000, max_seq_len=seq,
@@ -197,8 +202,9 @@ def longctx_bench(ds, on_tpu: bool):
                    remat_policy="segments", attn_impl="flash",
                    loss_chunk=2048)
              if on_tpu else Llama(size="tiny", max_seq_len=seq))
-    tps, _ = _train_tput(ds, model, {}, batch=1, seq=seq,
-                         steps=4 if on_tpu else 1)
+    tps, _ = _train_tput(ds, model, {},
+                         batch=1 if on_tpu else _cpu_batch(1),
+                         seq=seq, steps=4 if on_tpu else 1)
     mfu = tps * model.config.flops_per_token(seq) / peak_flops(
         jax.devices()[0])
     return {"metric": "llama_32k_seq_train_tokens_per_sec",
@@ -212,7 +218,7 @@ def moe_bench(ds, on_tpu: bool):
     this measures the routed-expert compute path on real hardware."""
     from deepspeed_tpu.models import Mixtral
     seq = 1024 if on_tpu else 64
-    batch = 8 if on_tpu else 2
+    batch = 8 if on_tpu else _cpu_batch()
     model = (Mixtral(hidden_size=512, num_layers=8, num_heads=8,
                      num_kv_heads=8, intermediate_size=1408,
                      num_experts=8, moe_top_k=2, vocab_size=32000,
@@ -292,8 +298,9 @@ def offload_smoke(ds, on_tpu: bool):
     from deepspeed_tpu.models import GPT2
     model = (GPT2(size="125m", vocab_size=50304, max_seq_len=256)
              if on_tpu else GPT2(size="tiny", max_seq_len=256))
+    batch = 4 if on_tpu else _cpu_batch(1)
     config = {
-        "train_batch_size": 4,
+        "train_batch_size": batch,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 2,
@@ -304,7 +311,7 @@ def offload_smoke(ds, on_tpu: bool):
     kinds = {getattr(s.sharding, "memory_kind", None)
              for s in jax.tree.leaves(engine.state["opt_state"])
              if hasattr(s, "sharding")}
-    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 257), 0,
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, 257), 0,
                                 model.config.vocab_size)
     data = (tokens[:, :-1], tokens[:, 1:])
     float(engine.train_batch(data))
@@ -324,7 +331,7 @@ def main():
 
     on_tpu = jax.devices()[0].platform != "cpu"
     seq = 1024 if on_tpu else 128
-    batch = 24 if on_tpu else 2
+    batch = 24 if on_tpu else _cpu_batch()
     size = "125m" if on_tpu else "tiny"
 
     # vocab padded to a multiple of 128 lanes: GPT-2's 50257 fragments the
