@@ -185,6 +185,14 @@ class InferenceEngineV2:
                     "exhausted (flush finished sequences)")
         for u, toks in zip(uids, batch_tokens):
             mgr.extend(u, list(map(int, toks)))
+            # re-admission invalidates any logits stashed when this uid
+            # finished during another caller's drain: the stashed value
+            # is from the old position and tick() must not surface it
+            # while the uid has pending tokens again (mirrors flush()).
+            # Popped only after extend() succeeds — a failed admission
+            # (do_checks=False + exhausted pool) must leave the stash
+            # intact for the original caller.
+            self._finished_stash.pop(u, None)
 
     def tick(self) -> dict[int, jnp.ndarray]:
         """ONE scheduler tick (the compute half of the reference's

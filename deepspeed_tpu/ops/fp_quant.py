@@ -119,6 +119,12 @@ def fp_quantize(x: jax.Array, *, q_bits: int = 8, mantissa_bits: int = 3,
 
     scales: f32 [nblocks, 1]; each block's absmax maps to the format max.
     """
+    codes_per_3_bytes = {6: 4, 12: 2}.get(q_bits)
+    if codes_per_3_bytes and group_size % codes_per_3_bytes != 0:
+        raise ValueError(
+            f"fp{q_bits} packs {codes_per_3_bytes} codes per 3 bytes: "
+            f"group_size must be a multiple of {codes_per_3_bytes} "
+            f"(got {group_size})")
     n = x.size
     flat = jnp.pad(x.reshape(-1).astype(jnp.float32), (0, (-n) % group_size))
     blocks = flat.reshape(-1, group_size)

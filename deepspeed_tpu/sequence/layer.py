@@ -76,14 +76,24 @@ class DistributedAttention:
 
         nq, nkv = q.shape[2], k.shape[2]
         tp = mesh.shape.get(self.tp_axis, 1)
+        if nq % tp != 0:
+            raise ValueError(
+                f"DistributedAttention: q heads ({nq}) must be divisible "
+                f"by the tensor-parallel degree ({tp}); the uneven-head "
+                f"padding only supports head counts uneven in sp")
         local_q = nq // tp
-        if nkv != nq and (nkv // tp if nkv % tp == 0 else nkv) % sp != 0:
-            # uneven kv heads: replicate kv up to q heads (reference
-            # supports uneven head counts; replication is the TPU-simple
-            # equivalent for GQA)
-            rep = nq // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if nkv != nq:
+            if nq % nkv != 0:
+                raise ValueError(
+                    f"DistributedAttention: GQA needs q heads ({nq}) to "
+                    f"be a multiple of kv heads ({nkv})")
+            if nkv % tp != 0 or (nkv // tp) % sp != 0:
+                # kv heads don't shard evenly over tp*sp: replicate kv
+                # up to the q head count (reference supports uneven head
+                # counts; full replication is the TPU-simple equivalent
+                # for GQA, and nq is already tp-divisible)
+                k = jnp.repeat(k, nq // nkv, axis=2)
+                v = jnp.repeat(v, nq // nkv, axis=2)
         pad = 0
         if local_q % sp != 0:
             # uneven q heads (reference layer.py:43 supports head counts

@@ -211,6 +211,27 @@ def test_put_preserves_other_callers_finished_logits(devices8):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_readmission_invalidates_stashed_logits(devices8):
+    """If caller A's sequence was finished by caller B's put() drain and
+    A then schedule()s MORE tokens for that uid before its next tick(),
+    the stale stashed logits (old position) must not surface — the uid
+    is pending again and only the fresh drain's logits count."""
+    model = Llama(size="tiny")
+    e = _engine(model)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(7), (12,), 0, 512)).tolist()
+    e.schedule([0], [prompt])          # caller A
+    e.put([1], [list(range(20))])      # B's drain finishes A's seq too
+    extra = [3, 1, 4]
+    e.schedule([0], [extra])           # A re-admits BEFORE its tick()
+    done = e.tick()                    # must be fresh logits, not stash
+    assert 0 in done
+    full = model.apply(e.params, jnp.asarray([prompt + extra]))
+    np.testing.assert_allclose(np.asarray(done[0]),
+                               np.asarray(full[0, -1]),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_paged_kernel_sliding_window(devices8):
     """The blocked-flash kernel's sliding-window mask (Mistral SWA) must
     match the jnp paged_attention reference over pages + fresh chunk at

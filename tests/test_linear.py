@@ -153,3 +153,16 @@ def test_fp_quantize_api_parity():
     assert float(jnp.max(jnp.abs(back - x))) < 0.5
     with pytest.raises(ValueError, match="unsupported float format"):
         q.quantize(x, q_bits=5, q_mantisa_bits=2)
+
+
+def test_fp_quantize_validates_group_size_alignment():
+    """fp6 packs 4 codes / 3 bytes, fp12 packs 2: a misaligned
+    group_size must fail with a format message, not a reshape error."""
+    from deepspeed_tpu.ops.fp_quant import fp_quantize
+    x = jax.random.normal(jax.random.PRNGKey(2), (512,))
+    with pytest.raises(ValueError, match="multiple of 4"):
+        fp_quantize(x, q_bits=6, mantissa_bits=2, group_size=510)
+    with pytest.raises(ValueError, match="multiple of 2"):
+        fp_quantize(x, q_bits=12, mantissa_bits=7, group_size=511)
+    # fp8 has no packing constraint
+    fp_quantize(x, q_bits=8, mantissa_bits=3, group_size=511)

@@ -139,6 +139,34 @@ def test_ulysses_uneven_q_heads(devices8):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_ulysses_rejects_heads_not_divisible_by_tp(devices8):
+    """q heads not divisible by tp floor local_q, so the uneven-head pad
+    logic can size the all-to-all for fewer heads than exist (or skip
+    padding entirely when local_q % sp == 0), leaving a head count the
+    sp*tp all-to-alls cannot split; the layer must raise a clear
+    ValueError up front instead."""
+    import pytest
+    topo = MeshTopology(TopologyConfig(sp=2, tp=4, dp=1, fsdp=1))
+    q, k, v = rand_qkv(jax.random.PRNGKey(9), hq=6, hkv=6)
+    attn = ulysses_attention(topo.mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        attn(q, k, v, causal=True)
+
+
+def test_ulysses_gqa_kv_not_divisible_by_tp(devices8):
+    """kv heads that don't shard over tp (nq=8, nkv=2, tp=4) must be
+    replicated to the q head count rather than slipping through to an
+    invalid per-device GQA grouping."""
+    topo = MeshTopology(TopologyConfig(sp=2, tp=2, dp=1, fsdp=2))
+    q, k, v = rand_qkv(jax.random.PRNGKey(10), hq=8, hkv=2)
+    ref = dot_product_attention(q, jnp.repeat(k, 4, axis=2),
+                                jnp.repeat(v, 4, axis=2), causal=True)
+    attn = ulysses_attention(topo.mesh)
+    out = jax.jit(lambda q, k, v: attn(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
 def test_ulysses_uneven_q_heads_gqa(devices8):
     """Uneven q heads + GQA kv (3 kv heads, sp=4): kv replicates to q
     count, both pad to the sp multiple."""
