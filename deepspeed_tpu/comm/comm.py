@@ -68,7 +68,9 @@ def init_distributed(dist_backend: str = "xla",
     import os
     if coordinator_address is None:
         coordinator_address = os.environ.get("DS_COORDINATOR_ADDR")
-    if coordinator_address is not None:
+    # the launcher (launcher/launch.py:100) may have already done the
+    # rendezvous in this process — initialize() raises on a second call
+    if coordinator_address is not None and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes or int(os.environ.get("DS_NUM_PROCESSES", "1")),

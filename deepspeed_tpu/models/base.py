@@ -100,11 +100,31 @@ class ModelConfig:
         final_norm = d + (d if self.norm_type == "layernorm" else 0)
         return embed + pos + L * per_layer + final_norm
 
-    def flops_per_token(self, seq_len: int) -> float:
-        """Training FLOPs/token (fwd+bwd ~= 6*N + attention term),
-        the standard MFU accounting (used for BASELINE.md §9 MFU)."""
+    def flops_per_token(self, seq_len: int, causal: bool = True) -> float:
+        """Training FLOPs/token (fwd+bwd ~= 6*N + attention term), the
+        standard MFU accounting (BASELINE.md §9).
+
+        ``causal=True`` (default — the PRIMARY number for every reported
+        MFU) counts only the attention work a causal model performs: the
+        average attended context is (s+1)/2, or bounded by the sliding
+        window when one is configured. ``causal=False`` is the
+        conventional full-attention accounting some frameworks report;
+        at long sequence it flatters MFU ~2x and is kept only as a
+        secondary figure.
+        """
         n = self.num_params()
-        attn_flops = 12 * self.num_layers * self.hidden_size * seq_len
+        s = seq_len
+        if causal:
+            w = self.sliding_window
+            if w and w < s:
+                # mean_i min(i+1, w): first w positions grow linearly,
+                # the rest are window-bounded
+                ctx = (w * (w + 1) / 2 + (s - w) * w) / s
+            else:
+                ctx = (s + 1) / 2
+        else:
+            ctx = s
+        attn_flops = 12 * self.num_layers * self.hidden_size * ctx
         return 6 * n + attn_flops
 
 

@@ -38,6 +38,50 @@ def test_cpu_offload_matches_baseline(devices8):
     np.testing.assert_allclose(l_off, l_ref, rtol=1e-4, atol=1e-4)
 
 
+def test_twin_flow_partial_offload_ratio(devices8):
+    """Twin-Flow / Offload++ `ratio` (reference offload_config.py:93):
+    ratio=0.5 must leave a genuine mix — the largest optimizer-tier
+    leaves in pinned_host, the rest in device memory — and numerics must
+    be unaffected."""
+    import jax
+    ref = _engine()
+    off = _engine({"offload_optimizer": {"device": "cpu", "ratio": 0.5}})
+    kinds = {getattr(l.sharding, "memory_kind", None)
+             for l in jax.tree.leaves(off.state["opt_state"])
+             if hasattr(l, "sharding")}
+    assert "pinned_host" in kinds and len(kinds) > 1, kinds
+    # requested host fraction lands in [0.5, 0.5 + largest-leaf slack];
+    # the report reads the REQUESTED shardings only before a fallback, so
+    # measure from state_shardings (CPU emulation falls back on compute)
+    from jax.sharding import NamedSharding
+    total = host = 0
+    for sh, leaf in zip(
+            jax.tree.leaves(off.state_shardings["opt_state"],
+                            is_leaf=lambda x: isinstance(x, NamedSharding)),
+            jax.tree.leaves(off.state["opt_state"])):
+        b = int(leaf.size) * leaf.dtype.itemsize
+        total += b
+        if getattr(sh, "memory_kind", None) == "pinned_host":
+            host += b
+    assert 0.5 <= host / total < 0.95, host / total
+    l_ref = run_steps(ref, n=3)
+    l_off = run_steps(off, n=3)
+    np.testing.assert_allclose(l_off, l_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_offload_ratio_zero_stays_on_device(devices8):
+    """ratio=0.0 disables the host tier entirely."""
+    import jax
+    off = _engine({"offload_optimizer": {"device": "cpu", "ratio": 0.0}})
+    assert not off._uses_host_memory
+    kinds = {getattr(l.sharding, "memory_kind", None)
+             for l in jax.tree.leaves(off.state["opt_state"])
+             if hasattr(l, "sharding")}
+    assert "pinned_host" not in kinds
+    rpt = off.host_memory_report()
+    assert rpt["host_fraction"] == 0.0
+
+
 def test_param_offload_cpu(devices8):
     off = _engine({"stage": 3, "offload_param": {"device": "cpu"}})
     p = off.state["params"]["embed"]["tokens"]
