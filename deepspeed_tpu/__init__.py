@@ -54,6 +54,31 @@ def initialize(args=None,
             training_data=training_data, lr_scheduler=lr_scheduler,
             collate_fn=collate_fn, mpu=mpu or model.topology(), args=args)
     else:
+        zc = config.zero_optimization
+        stream = zc.offload_param.stream
+        auto = stream is None
+        if auto:
+            import jax as _jax
+            # auto only when the caller didn't hand us objects the
+            # streamed engine can't take over
+            stream = (zc.stage == 3 and zc.offload_param.device == "cpu"
+                      and len(_jax.devices()) == 1
+                      and optimizer is None and training_data is None)
+        if stream:
+            # models larger than HBM on one chip: layer-streamed params
+            # + optimizer through pinned_host (ZeRO-Infinity capability;
+            # reference stage3.py:1926 + swap_tensor/)
+            from .runtime.infinity import StreamedZeroEngine
+            try:
+                engine = StreamedZeroEngine(model, config,
+                                            lr_scheduler=lr_scheduler)
+                return engine, None, None, engine.lr_schedule
+            except (NotImplementedError, ValueError):
+                if not auto:
+                    raise
+                # auto mode: configs the streamed engine doesn't cover
+                # (ga>1, fp16, non-Adam, non-DecoderLM) keep the sharded
+                # whole-tree-fetch path that served them before
         engine_cls = DeepSpeedEngine
         if config.hybrid_engine.enabled:
             from .runtime.hybrid_engine import DeepSpeedHybridEngine

@@ -34,12 +34,17 @@ class ModelConfig:
     # architecture switches
     norm_type: str = "layernorm"        # layernorm | rmsnorm
     activation: str = "gelu"            # gelu | swiglu
-    position_embedding: str = "learned"  # learned | rope
+    position_embedding: str = "learned"  # learned | rope | alibi (Bloom)
     use_bias: bool = True
     attn_qkv_bias: bool = False     # qkv biases even when use_bias=False
     #                                 (Qwen-style)
+    mlp_bias: bool | None = None    # None -> use_bias; GPT-J: attn
+    #                                 unbiased but fc_in/fc_out biased
     parallel_residual: bool = False  # Falcon/Phi-2: x + attn(h) + mlp(h)
     #                                  with a single input norm (no ln2)
+    parallel_dual_norm: bool = False  # GPT-NeoX: parallel residual but
+    #                                   attn/mlp each get their own norm
+    embed_layernorm: bool = False   # Bloom: LayerNorm after word embed
     rotary_pct: float = 1.0         # partial rotary (GPT-NeoX/Phi-2)
     sliding_window: int | None = None  # Mistral windowed attention
     # MoE (0 experts = dense; reference: deepspeed/moe)
@@ -69,6 +74,12 @@ class ModelConfig:
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
 
+    @property
+    def effective_mlp_bias(self) -> bool:
+        """mlp_bias falls back to use_bias — the single source of truth
+        for init / forward / num_params (GPT-J splits them)."""
+        return self.use_bias if self.mlp_bias is None else self.mlp_bias
+
     def num_params(self) -> int:
         """Analytic parameter count (embedding + layers + final norm),
         matching the trees DecoderLM.init builds exactly."""
@@ -84,18 +95,23 @@ class ModelConfig:
                 # shared experts fused into one n-times-wider swiglu MLP
                 # plus the sigmoid gate proj (d -> 1)
                 mlp += 3 * d * f * self.moe_num_shared_experts + d
-        n_norms = 1 if self.parallel_residual else 2
+        n_norms = (1 if self.parallel_residual
+                   and not self.parallel_dual_norm else 2)
+        mlp_bias = self.effective_mlp_bias
         per_layer = attn + mlp + n_norms * d  # + ln scales
         if self.use_bias or self.attn_qkv_bias:
             per_layer += nh_d + 2 * kv      # qkv biases
         if self.use_bias:
             per_layer += d                  # wo bias
+        if mlp_bias:
             per_layer += f + d              # w_up_b, w_down_b
             if self.activation == "swiglu":
                 per_layer += f              # w_gate_b
         if self.norm_type == "layernorm":
             per_layer += n_norms * d        # ln biases
         embed = v * d + (0 if self.tie_embeddings else v * d)
+        if self.embed_layernorm:
+            embed += 2 * d
         pos = self.max_seq_len * d if self.position_embedding == "learned" else 0
         final_norm = d + (d if self.norm_type == "layernorm" else 0)
         return embed + pos + L * per_layer + final_norm

@@ -53,6 +53,15 @@ class DecoderLM:
         else:
             self._rot_dim = 0
             self._rope = None
+        self._alibi_slopes = (L.alibi_slopes(config.num_heads)
+                              if config.position_embedding == "alibi"
+                              else None)
+        if self._alibi_slopes is not None and config.attn_impl == "flash":
+            raise ValueError(
+                "attn_impl='flash' does not support ALiBi yet — the "
+                "kernel has no per-head additive-bias path; use the "
+                "default attention (O(S^2) bias) or rope/learned "
+                "positions with flash")
 
     # ---------------- init ----------------
     def init(self, rng: jax.Array) -> PyTree:
@@ -77,13 +86,14 @@ class DecoderLM:
             "w_up": layer_stack(lk[4], (d, f), std),
             "w_down": layer_stack(lk[5], (f, d), resid_std),
         }
-        if not c.parallel_residual:  # parallel blocks share ln1
+        has_ln2 = not c.parallel_residual or c.parallel_dual_norm
+        if has_ln2:  # single-norm parallel blocks share ln1
             layers["ln2_scale"] = jnp.ones((c.num_layers, d), dt)
         if c.activation == "swiglu":
             layers["w_gate"] = layer_stack(lk[6], (d, f), std)
         if c.norm_type == "layernorm":
             layers["ln1_bias"] = jnp.zeros((c.num_layers, d), dt)
-            if not c.parallel_residual:
+            if has_ln2:
                 layers["ln2_bias"] = jnp.zeros((c.num_layers, d), dt)
         if c.use_bias or c.attn_qkv_bias:
             layers.update({
@@ -92,8 +102,9 @@ class DecoderLM:
                 "wv_b": jnp.zeros((c.num_layers, nkv * hd), dt),
             })
         if c.use_bias:
+            layers["wo_b"] = jnp.zeros((c.num_layers, d), dt)
+        if c.effective_mlp_bias:
             layers.update({
-                "wo_b": jnp.zeros((c.num_layers, d), dt),
                 "w_up_b": jnp.zeros((c.num_layers, f), dt),
                 "w_down_b": jnp.zeros((c.num_layers, d), dt),
             })
@@ -107,6 +118,9 @@ class DecoderLM:
         if c.position_embedding == "learned":
             params["embed"]["positions"] = _dense_init(
                 keys[2], (c.max_seq_len, d), std, dt)
+        if c.embed_layernorm:   # Bloom: LayerNorm after word embeddings
+            params["embed"]["ln_scale"] = jnp.ones((d,), dt)
+            params["embed"]["ln_bias"] = jnp.zeros((d,), dt)
         if c.norm_type == "layernorm":
             params["final_norm"]["bias"] = jnp.zeros((d,), dt)
         if not c.tie_embeddings:
@@ -131,6 +145,9 @@ class DecoderLM:
             if positions is None:
                 positions = jnp.arange(tokens.shape[-1])[None, :]
             x = x + jnp.take(params["embed"]["positions"], positions, axis=0)
+        if c.embed_layernorm:
+            x = L.layer_norm(x, params["embed"]["ln_scale"],
+                             params["embed"]["ln_bias"], c.norm_eps)
         return x
 
     def _qkv(self, p: PyTree, h: jax.Array,
@@ -191,7 +208,14 @@ class DecoderLM:
                 "sequence-parallel wrapper) is in use; the window mask is "
                 "NOT applied by the wrapper — attention is full-causal")
         if attn_fn is None:
-            if c.attn_impl == "flash":
+            if c.position_embedding == "alibi":
+                # ALiBi rides the exact path as a per-head additive bias
+                # (Bloom; reference bloom containers add it in-kernel)
+                import functools
+                attn_fn = functools.partial(
+                    L.dot_product_attention,
+                    bias=L.alibi_bias(self._alibi_slopes, x.shape[1]))
+            elif c.attn_impl == "flash":
                 import functools
 
                 from ..ops.pallas.flash_attention import flash_attention
@@ -214,8 +238,11 @@ class DecoderLM:
         q, k, v = self._qkv(p, h, positions)
         a = attn_fn(q, k, v, causal=True)
         if c.parallel_residual:
-            # Falcon/Phi-2: attention and MLP read the same normed input
-            m, aux = self._mlp(p, h)
+            # Falcon/Phi-2: attention and MLP read the same normed input;
+            # GPT-NeoX (parallel_dual_norm): MLP gets its own LayerNorm
+            h_mlp = (self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+                     if c.parallel_dual_norm else h)
+            m, aux = self._mlp(p, h_mlp)
             return x + self._attn_out(p, a) + m, aux
         x = x + self._attn_out(p, a)
         return self._mlp_residual(p, x)
@@ -251,7 +278,9 @@ class DecoderLM:
 
         def seg_out(p, x, a, h):
             if c.parallel_residual:
-                m, aux = self._mlp(p, h)
+                h_mlp = (self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+                         if c.parallel_dual_norm else h)
+                m, aux = self._mlp(p, h_mlp)
                 return x + self._attn_out(p, a) + m, aux
             x2 = x + self._attn_out(p, a)
             x2 = checkpoint_name(x2, "resid_mid")
@@ -270,21 +299,22 @@ class DecoderLM:
         (aux carries the router load-balancing loss)."""
         from jax.ad_checkpoint import checkpoint_name
         c = self.config
+        mlp_bias = c.effective_mlp_bias
         if c.activation == "swiglu":
             gate = checkpoint_name(h @ p["w_gate"], "ffn_pre")
             up = checkpoint_name(h @ p["w_up"], "ffn_pre")
-            if c.use_bias:
+            if mlp_bias:
                 gate = gate + p["w_gate_b"]
                 up = up + p["w_up_b"]
             m = L.silu(gate) * up
         else:
             up = checkpoint_name(h @ p["w_up"], "ffn_pre")
-            if c.use_bias:
+            if mlp_bias:
                 up = up + p["w_up_b"]
             m = L.gelu(up)
         m = checkpoint_name(m, "ffn")
         m = m @ p["w_down"]
-        if c.use_bias:
+        if mlp_bias:
             m = m + p["w_down_b"]
         return m, jnp.zeros((), jnp.float32)
 
@@ -316,7 +346,8 @@ class DecoderLM:
         v_cache = jax.lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), index, axis=1)
         a = L.cached_attention(q, k_cache, v_cache, index,
-                               window=self.config.sliding_window)
+                               window=self.config.sliding_window,
+                               alibi_slopes=self._alibi_slopes)
         if self.config.parallel_residual:
             m, _ = self._mlp(p, h)
             return x + self._attn_out(p, a) + m, k_cache, v_cache

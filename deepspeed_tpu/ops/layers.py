@@ -81,6 +81,35 @@ def window_bias(seq_len: int, window: int):
     return jnp.where(qi - ki < window, 0.0, -1e30)[None, None]
 
 
+def alibi_slopes(num_heads: int):
+    """ALiBi per-head slopes (reference: Bloom containers /
+    deepspeed/module_inject — the original train-short-test-long
+    geometric schedule). Power-of-two head counts get 2^(-8i/n); others
+    interleave the doubled-count schedule like the paper's released
+    code."""
+    import math
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * start ** i for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        s = pow2(num_heads)
+    else:
+        closest = 2 ** int(math.floor(math.log2(num_heads)))
+        s = pow2(closest) + pow2(2 * closest)[0::2][: num_heads - closest]
+    return jnp.asarray(s, jnp.float32)
+
+
+def alibi_bias(slopes, seq_len: int):
+    """[H, S, S] additive attention bias: slope_h * (k - q) (zero on the
+    diagonal, increasingly negative into the past; future positions are
+    handled by the causal mask)."""
+    pos = jnp.arange(seq_len)
+    rel = pos[None, :] - pos[:, None]            # k - q
+    return slopes[:, None, None] * rel[None].astype(jnp.float32)
+
+
 def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
                           segment_ids=None, softmax_scale: float | None = None):
     """Reference attention: q,k,v [B, S, H, D] (k/v may have fewer heads —
@@ -124,7 +153,8 @@ def dot_product_attention(q, k, v, *, causal: bool = True, bias=None,
     return out
 
 
-def cached_attention(q, k_cache, v_cache, index, *, window: int | None = None):
+def cached_attention(q, k_cache, v_cache, index, *,
+                     window: int | None = None, alibi_slopes=None):
     """Decode-time attention against a static KV cache (reference:
     csrc/transformer/inference softmax + attention over the
     inference_context.h KV buffers).
@@ -149,6 +179,9 @@ def cached_attention(q, k_cache, v_cache, index, *, window: int | None = None):
     mask = kpos <= qpos                           # causal over the cache
     if window is not None:
         mask &= kpos > qpos - window
+    if alibi_slopes is not None:
+        rel = (kpos - qpos).astype(jnp.float32)   # [sq, smax]
+        logits = logits + alibi_slopes[None, :, None, None] * rel[None, None]
     logits = jnp.where(mask[None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache)

@@ -44,12 +44,22 @@ class OffloadOptimizerConfig(DeepSpeedConfigModel):
     nvme_path: Optional[str] = None
     pin_memory: bool = False
     ratio: float = 1.0
+    # TPU extension (streamed/Infinity tier): storage dtype of the Adam
+    # moments in host memory. bfloat16 halves the host-memory footprint
+    # and the per-step device<->host traffic of m/v; the update math
+    # still runs in fp32 on device (master stays fp32 regardless).
+    moment_dtype: Literal["float32", "bfloat16"] = "float32"
 
 
 class OffloadParamConfig(DeepSpeedConfigModel):
     device: Literal["cpu", "nvme", "none"] = "none"
     nvme_path: Optional[str] = None
     pin_memory: bool = False
+    # TPU extension: layer-streamed params (runtime/infinity.py). None =
+    # auto (stage 3 + device=cpu + single chip); True forces the
+    # streamed engine (CPU tests), False forces the whole-tree-fetch
+    # sharded path.
+    stream: Optional[bool] = None
 
 
 class ZeroConfig(DeepSpeedConfigModel):

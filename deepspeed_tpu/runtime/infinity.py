@@ -1,0 +1,596 @@
+"""Single-chip ZeRO-Infinity: layer-streamed parameters + optimizer.
+
+The reference makes 7B-class models trainable on one device by swapping
+parameters and optimizer state between GPU, pinned CPU memory and NVMe
+(reference: runtime/zero/stage3.py:1926 optimizer-state swap,
+runtime/swap_tensor/partitioned_param_swapper.py
+AsyncPartitionedParameterSwapper, runtime/zero/offload_config.py). The
+TPU-native equivalent keeps the whole training step COMPILED and lets
+XLA's memory-space support do the swapping:
+
+- the fp32 master copy of every transformer layer (plus Adam moments)
+  lives in ``pinned_host`` memory on the TPU host — model size is bounded
+  by host RAM, not HBM;
+- the forward pass is a ``lax.scan`` over the stacked ``[L, ...]`` layer
+  leaves whose body explicitly ``device_put``s one layer's slice into
+  HBM — XLA turns that into a per-layer H2D DMA pipelined against
+  compute, so HBM holds ~one layer at a time (measured: 16 MB of compiled
+  temp for a 1 GiB host-resident stack);
+- the backward is a HAND-ROLLED reverse scan (``jax.vjp`` per layer with
+  in-scan recompute) whose per-layer grads are written straight back to
+  pinned_host as scan outputs. Autodiff-of-scan is deliberately avoided:
+  its transposed accumulation materializes the full stacked grad buffer
+  in HBM (measured: 1.16 GiB temp for the same stack);
+- the optimizer is a second compiled scan that streams (grads, master,
+  m, v) per layer through HBM, runs Adam on device, and writes the
+  updated state back to pinned_host. Embedding/head/final-norm leaves are
+  small and stay device-resident with the same Adam math.
+
+Everything runs inside jit on the TPU host's PCIe — nothing round-trips
+through the client process (which may be far from the chip).
+
+Scope (documented limits, enforced at dispatch in ``initialize``):
+single-replica (one chip per model instance — the multi-chip paths use
+the sharded engine), decoder models built on models/transformer.py
+DecoderLM, gradient_accumulation_steps == 1, bf16 or fp32 compute (fp16
+loss-scaling is a sharded-engine feature), Adam/AdamW.
+
+On non-TPU backends the memory-kind annotations are skipped (single
+memory space) but the identical streaming program runs, so CPU tests
+exercise the exact scan/vjp structure that runs on hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import SingleDeviceSharding
+
+from ..utils.logging import log_dist, logger
+from .config import DeepSpeedConfig
+from .lr_schedules import build_schedule
+
+PyTree = Any
+
+
+def _is_streamable_module(module) -> bool:
+    """Stacked-layer decoder contract: embed/block/_norm/_project_vocab
+    plus params['layers'] leaves carrying a leading L dim."""
+    return all(hasattr(module, a) for a in
+               ("embed", "block", "_norm", "_project_vocab", "config"))
+
+
+class StreamedZeroEngine:
+    """ZeRO-3 + offload_param=cpu for models larger than HBM, one chip.
+
+    API: a subset of DeepSpeedEngine — train_batch / eval_batch /
+    host_memory_report / save_checkpoint / load_checkpoint / params.
+    """
+
+    def __init__(self, module, config: DeepSpeedConfig,
+                 lr_scheduler=None):
+        if not _is_streamable_module(module):
+            raise ValueError(
+                "param streaming needs a DecoderLM-style module "
+                "(embed/block/_norm/_project_vocab)")
+        self.module = module
+        self.config = config
+        self.model_config = module.config
+
+        tb, mb, ga = config.resolve_batch_sizes(1)
+        if ga > 1:
+            raise NotImplementedError(
+                "param streaming supports gradient_accumulation_steps=1 "
+                "(accumulating a host-resident grad stack would double "
+                "the PCIe traffic per micro-batch)")
+        if config.fp16.enabled:
+            raise NotImplementedError(
+                "param streaming supports bf16/fp32; fp16 loss scaling "
+                "is a sharded-engine feature")
+        self.train_batch_size_ = tb
+        self.micro_batch_size_ = mb
+        self.gradient_accumulation_steps_ = 1
+        self.compute_dtype = (jnp.bfloat16 if config.bf16.enabled
+                              else jnp.float32)
+        self._mixed = config.bf16.enabled
+
+        # --- optimizer hyperparameters (Adam/AdamW only) ---------------
+        opt_cfg = config.optimizer
+        name = (opt_cfg.type if opt_cfg else "adamw").lower().replace("_", "")
+        if name not in ("adam", "adamw", "fusedadam", "fusedadamw",
+                        "cpuadam", "deepspeedcpuadam"):
+            raise NotImplementedError(
+                f"param streaming implements Adam/AdamW (got {name!r})")
+        p = dict(opt_cfg.params) if opt_cfg else {}
+        self._b1, self._b2 = p.get("betas", (0.9, 0.999))
+        self._eps = p.get("eps", 1e-8)
+        self._wd = p.get("weight_decay", 0.0)
+        # reference FusedAdam defaults to decoupled (adamw-style) decay
+        self._adamw_mode = bool(p.get("adam_w_mode", True)) \
+            or name in ("adamw", "fusedadamw")
+        if not self._adamw_mode and self._wd:
+            raise NotImplementedError(
+                "param streaming implements decoupled (adamw-style) "
+                "weight decay only; adam_w_mode=false with weight_decay "
+                "would need pre-moment L2 folding")
+        sched_cfg = config.scheduler
+        self.lr_schedule = (lr_scheduler if callable(lr_scheduler)
+                            else build_schedule(
+                                sched_cfg.type if sched_cfg else None,
+                                sched_cfg.params if sched_cfg else {},
+                                p.get("lr", 1e-3)))
+
+        self._moment_dtype = jnp.dtype(
+            config.zero_optimization.offload_optimizer.moment_dtype)
+        dev = jax.devices()[0]
+        on_tpu = jax.default_backend() == "tpu"
+        self._dev_sh = SingleDeviceSharding(dev)
+        self._host_sh = (SingleDeviceSharding(dev, memory_kind="pinned_host")
+                         if on_tpu else self._dev_sh)
+
+        self._init_state()
+        self._phase_a = None
+        self._phase_b = None
+        self._eval_jit = None
+        self.global_steps = 0
+        self.global_samples = 0
+        self.skipped_steps = 0
+        self._last_metrics = None
+        n = self.model_config.num_params()
+        state_gib = (4 + 2 * self._moment_dtype.itemsize) \
+            * self._n_layer_params / 2 ** 30
+        log_dist(f"StreamedZeroEngine: {n/1e9:.2f}B params, "
+                 f"layers master+moments in "
+                 f"{'pinned_host' if on_tpu else 'device (cpu test rig)'} "
+                 f"({state_gib:.1f} GiB host state, moments "
+                 f"{self._moment_dtype.name}), "
+                 f"dtype={jnp.dtype(self.compute_dtype).name}")
+
+    # ------------------------------------------------------------------
+    def _init_state(self):
+        """fp32 master + zero moments, layer stacks in pinned_host.
+
+        Init runs as one jit whose layer outputs go straight to host
+        memory — device high-water is the full tree transiently, so this
+        path supports models up to ~HBM at init while training supports
+        ~host-RAM. (Per-leaf init jits would lift the init bound too;
+        not needed for the 7B target.)
+        """
+        rng = jax.random.PRNGKey(self.config.seed)
+
+        def init32(rng):
+            params = self.module.init(rng)
+            return jax.tree.map(lambda x: x.astype(jnp.float32), params)
+
+        abstract = jax.eval_shape(init32, rng)
+        # only rank>=3 stacked leaves (per-layer MATRICES — the O(L*D^2)
+        # bytes) stream through pinned_host; per-layer vectors (norm
+        # scales, biases: [L, D]) stay device-resident — the TPU host-DMA
+        # emitter requires multi-sublane slices, and their total size is
+        # negligible anyway. Partition is by leaf PATH so nested layer
+        # trees (MoE expert stacks) split correctly.
+        from ..checkpoint.universal import flatten_with_names
+        named = flatten_with_names(abstract["layers"])
+        self._layer_treedef = jax.tree.structure(abstract["layers"])
+        self._layer_names = [n for n, _ in named]
+        self._stream_names = sorted(
+            n for n, l in named if l.ndim >= 3)
+        stream = set(self._stream_names)
+        small_names = [n for n in self._layer_names if n not in stream]
+
+        def split_flat(layers_tree):
+            flat = dict(flatten_with_names(layers_tree))
+            return ({n: flat[n] for n in self._stream_names},
+                    {n: flat[n] for n in small_names})
+
+        self._split_flat = split_flat
+
+        fp32_bytes = sum(int(np.prod(l.shape)) * 4
+                         for _, l in flatten_with_names(abstract))
+        if fp32_bytes < 6 * 2 ** 30:
+            # small model: one init jit, big leaves straight to host
+            out_sh = jax.tree.map(lambda _: self._dev_sh, abstract)
+            sh_flat = dict(flatten_with_names(out_sh["layers"]))
+            out_sh["layers"] = jax.tree.unflatten(
+                self._layer_treedef,
+                [self._host_sh if n in stream else sh_flat[n]
+                 for n in self._layer_names])
+            params32 = jax.jit(init32, out_shardings=out_sh)(rng)
+            big, small = split_flat(params32["layers"])
+            dev_rest = {k: v for k, v in params32.items()
+                        if k != "layers"}
+        else:
+            # model bigger than a fraction of HBM: init ONE streamed
+            # leaf per jit — XLA dead-code-eliminates every other leaf's
+            # init math, so device high-water is one fp32 leaf, not the
+            # tree (the zero.Init role at Infinity scale)
+            big = {}
+            for name in self._stream_names:
+                def pick(rng, _n=name):
+                    flat = dict(flatten_with_names(init32(rng)["layers"]))
+                    return flat[_n]
+                big[name] = jax.jit(
+                    pick, out_shardings=self._host_sh)(rng)
+                big[name].block_until_ready()
+
+            def rest(rng):
+                p = init32(rng)
+                _, small = split_flat(p["layers"])
+                return {**{k: v for k, v in p.items() if k != "layers"},
+                        "layers_small": small}
+
+            dev_all = jax.jit(rest)(rng)
+            small = dev_all.pop("layers_small")
+            dev_rest = dev_all
+
+        self.master_layers = big                            # fp32, host
+        self.dev_master = dev_rest                          # fp32, device
+        self.dev_master["layers_small"] = small
+        self.dev_params = jax.tree.map(
+            lambda x: x.astype(self.compute_dtype), self.dev_master)
+
+        mdt = self._moment_dtype
+        zeros_like_host = jax.jit(
+            lambda t: jax.tree.map(
+                lambda x: jnp.zeros(x.shape, mdt), t),
+            out_shardings=jax.tree.map(lambda _: self._host_sh,
+                                       jax.eval_shape(lambda t: t, big)))
+        self.m_layers = zeros_like_host(self.master_layers)
+        self.v_layers = zeros_like_host(self.master_layers)
+        self.dev_m = jax.tree.map(jnp.zeros_like, self.dev_master)
+        self.dev_v = jax.tree.map(jnp.zeros_like, self.dev_master)
+        self.step_count = 0
+        self._n_layer_params = sum(
+            int(np.prod(l.shape)) for n, l in named if n in stream)
+
+    # ------------------------------------------------------------------
+    def _assemble_layer(self, big_flat: dict, small_flat: dict) -> PyTree:
+        """Rebuild the nested layers tree from the two flat name->leaf
+        dicts (works for per-layer slices and full stacks alike)."""
+        merged = {**small_flat, **big_flat}
+        return jax.tree.unflatten(
+            self._layer_treedef,
+            [merged[n] for n in self._layer_names])
+
+    @property
+    def params(self) -> PyTree:
+        """Full parameter tree view; the streamed layer matrices are the
+        HOST-RESIDENT fp32 master (reads are fine, they stream)."""
+        out = {k: v for k, v in self.dev_params.items()
+               if k != "layers_small"}
+        out["layers"] = self._assemble_layer(
+            self.master_layers, self.dev_params["layers_small"])
+        return out
+
+    def host_memory_report(self) -> dict:
+        out = {"pinned_host": 0, "device": 0}
+        for leaf in jax.tree.leaves([self.master_layers, self.m_layers,
+                                     self.v_layers]):
+            kind = getattr(leaf.sharding, "memory_kind", None)
+            out["pinned_host" if kind == "pinned_host" else "device"] += \
+                int(leaf.size) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves([self.dev_master, self.dev_m,
+                                     self.dev_v]):
+            out["device"] += int(leaf.size) * leaf.dtype.itemsize
+        total = out["pinned_host"] + out["device"]
+        out["host_fraction"] = out["pinned_host"] / total if total else 0.0
+        return out
+
+    # ------------------------------------------------------------------
+    def _to_dev(self, t):
+        return jax.device_put(t, self._dev_sh)
+
+    def _to_host(self, t):
+        return jax.device_put(t, self._host_sh)
+
+    def _build_phase_a(self):
+        """grads: streamed fwd scan + manual reverse vjp scan.
+
+        Returns (loss, grads_layers[host, compute-dtype], dev_grads[f32],
+        grad_sq, finite).
+        """
+        module = self.module
+        cdt = self.compute_dtype
+        aux_coef = module.aux_loss_coef()
+
+        def fetch(lh):
+            # one layer's fp32 master slice -> HBM -> compute dtype
+            return jax.tree.map(
+                lambda t: self._to_dev(t).astype(cdt), lh)
+
+        from ..models.transformer import _unpack_batch
+        from ..ops.layers import cross_entropy_loss
+
+        def head_loss(dev_params, x_last, targets):
+            x = module._norm(x_last,
+                             dev_params["final_norm"]["scale"],
+                             dev_params["final_norm"].get("bias"))
+            logits = module._project_vocab(dev_params, x)
+            return cross_entropy_loss(logits, targets)
+
+        split = self._split_flat
+        assemble = self._assemble_layer
+
+        def phase_a(master_layers, dev_params, batch):
+            tokens, targets = _unpack_batch(batch)
+            small_stack = dev_params["layers_small"]
+
+            def embed_fn(dp):
+                return module.embed(dp, tokens)
+
+            x0, embed_vjp = jax.vjp(embed_fn, dev_params)
+
+            def fbody(carry, xs):
+                x, aux = carry
+                lh, small = xs
+                y, la = module.block(assemble(fetch(lh), small), x)
+                return (y, aux + la), x          # ys: layer input acts
+
+            (xL, aux), acts = jax.lax.scan(
+                fbody, (x0, jnp.zeros((), jnp.float32)),
+                (master_layers, small_stack))
+
+            ce, head_vjp = jax.vjp(
+                functools.partial(head_loss, targets=targets),
+                dev_params, xL)
+            loss = ce + aux_coef * aux
+            d_head_dev, dxL = head_vjp(jnp.ones((), ce.dtype))
+
+            def bbody(carry, xs):
+                g, sq, finite = carry
+                lh, small, x_in = xs
+
+                def layer(lp, x):
+                    return module.block(lp, x)
+
+                lp = assemble(fetch(lh), small)
+                _, vjp = jax.vjp(layer, lp, x_in)
+                dlp, dx = vjp((g, jnp.asarray(aux_coef, jnp.float32)))
+                for t in jax.tree.leaves(dlp):
+                    sq += jnp.sum(jnp.square(t.astype(jnp.float32)))
+                    finite &= jnp.isfinite(t).all()
+                dbig, dsmall = split(dlp)
+                dsmall = jax.tree.map(
+                    lambda t: t.astype(jnp.float32), dsmall)
+                return (dx, sq, finite), (
+                    jax.tree.map(self._to_host, dbig), dsmall)
+
+            (dx0, sq, finite), (dlayers, dsmall_stack) = jax.lax.scan(
+                bbody,
+                (dxL, jnp.zeros((), jnp.float32), jnp.array(True)),
+                (master_layers, small_stack, acts), reverse=True)
+
+            (d_embed_dev,) = embed_vjp(dx0)
+            dev_grads = jax.tree.map(
+                lambda a, b: (a.astype(jnp.float32)
+                              + b.astype(jnp.float32)),
+                d_head_dev, d_embed_dev)
+            for t in jax.tree.leaves(
+                    {k: v for k, v in dev_grads.items()
+                     if k != "layers_small"}):
+                sq += jnp.sum(jnp.square(t))
+                finite &= jnp.isfinite(t).all()
+            # per-layer small grads were already counted in the scan;
+            # embed/head contribute zeros for them
+            dev_grads["layers_small"] = jax.tree.map(
+                jnp.add, dev_grads["layers_small"], dsmall_stack)
+            return loss, dlayers, dev_grads, jnp.sqrt(sq), finite
+
+        host = self._host_sh
+        dev = self._dev_sh
+        abstract = jax.eval_shape(
+            lambda t: jax.tree.map(lambda x: x, t), self.master_layers)
+        grads_sh = jax.tree.map(lambda _: host, abstract)
+        return jax.jit(
+            phase_a,
+            out_shardings=(dev, grads_sh, None, dev, dev))
+
+    def _build_phase_b(self):
+        """Streamed Adam: scan (g, master, m, v) per layer through HBM;
+        device-resident leaves update in the same program."""
+        b1, b2, eps, wd = self._b1, self._b2, self._eps, self._wd
+        adamw = self._adamw_mode
+        cdt = self.compute_dtype
+
+        def adam_leaf(mst, m, v, g, t, lr, coef):
+            mdt, vdt = m.dtype, v.dtype   # storage dtype (moment_dtype)
+            g = g.astype(jnp.float32) * coef
+            m = b1 * m.astype(jnp.float32) + (1 - b1) * g
+            v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            u = mhat / (jnp.sqrt(vhat) + eps)
+            if adamw and wd:
+                # decoupled decay only; __init__ rejects L2-mode decay
+                u = u + wd * mst
+            return mst - lr * u, m.astype(mdt), v.astype(vdt)
+
+        def phase_b(master_layers, m_layers, v_layers, grads_layers,
+                    dev_master, dev_m, dev_v, dev_grads, t, lr, coef):
+            def body(_, xs):
+                mst, m, v, g = xs
+                mst, m, v, g = jax.tree.map(self._to_dev, (mst, m, v, g))
+                out = jax.tree.map(
+                    lambda a, b_, c, d: adam_leaf(a, b_, c, d, t, lr,
+                                                  coef),
+                    mst, m, v, g,
+                    is_leaf=lambda x: isinstance(x, jax.Array))
+                mst2 = jax.tree.map(lambda o: o[0], out,
+                                    is_leaf=lambda x: isinstance(x, tuple))
+                m2 = jax.tree.map(lambda o: o[1], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+                v2 = jax.tree.map(lambda o: o[2], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+                return (), tuple(jax.tree.map(self._to_host, x)
+                                 for x in (mst2, m2, v2))
+
+            _, (mst2, m2, v2) = jax.lax.scan(
+                body, (), (master_layers, m_layers, v_layers,
+                           grads_layers))
+
+            out = jax.tree.map(
+                lambda a, b_, c, d: adam_leaf(a, b_, c, d, t, lr, coef),
+                dev_master, dev_m, dev_v, dev_grads,
+                is_leaf=lambda x: isinstance(x, jax.Array))
+            dmst2 = jax.tree.map(lambda o: o[0], out,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+            dm2 = jax.tree.map(lambda o: o[1], out,
+                               is_leaf=lambda x: isinstance(x, tuple))
+            dv2 = jax.tree.map(lambda o: o[2], out,
+                               is_leaf=lambda x: isinstance(x, tuple))
+            dev_params2 = jax.tree.map(lambda x: x.astype(cdt), dmst2)
+            return mst2, m2, v2, dmst2, dm2, dv2, dev_params2
+
+        host, dev = self._host_sh, self._dev_sh
+        habs = jax.eval_shape(lambda t: t, self.master_layers)
+        hsh = jax.tree.map(lambda _: host, habs)
+        return jax.jit(
+            phase_b,
+            out_shardings=(hsh, hsh, hsh, None, None, None, None),
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+
+    # ------------------------------------------------------------------
+    def train_batch(self, batch=None, data_iter=None):
+        if batch is None:
+            if data_iter is None:
+                raise ValueError("train_batch needs a batch or data_iter")
+            batch = next(data_iter)
+        if self._phase_a is None:
+            self._phase_a = self._build_phase_a()
+            self._phase_b = self._build_phase_b()
+        batch = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._dev_sh), batch)
+        t0 = time.perf_counter()
+        loss, grads_layers, dev_grads, norm, finite = self._phase_a(
+            self.master_layers, self.dev_params, batch)
+        metrics = {"loss": loss, "grad_norm": norm,
+                   "loss_scale": jnp.ones(()), "overflow": ~finite}
+        if bool(finite):
+            lr = float(self.lr_schedule(self.step_count))
+            clip = self.config.gradient_clipping
+            coef = 1.0
+            if clip and clip > 0:
+                coef = min(1.0, clip / (float(norm) + 1e-6))
+            t = self.step_count + 1
+            (self.master_layers, self.m_layers, self.v_layers,
+             self.dev_master, self.dev_m, self.dev_v,
+             self.dev_params) = self._phase_b(
+                self.master_layers, self.m_layers, self.v_layers,
+                grads_layers, self.dev_master, self.dev_m, self.dev_v,
+                dev_grads, jnp.asarray(t, jnp.float32),
+                jnp.asarray(lr, jnp.float32),
+                jnp.asarray(coef, jnp.float32))
+            self.step_count = t
+        else:
+            self.skipped_steps += 1
+        del grads_layers
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size_
+        self._last_metrics = metrics
+        if self.global_steps % self.config.steps_per_print == 0:
+            dt = time.perf_counter() - t0
+            logger.info(f"[streamed] step {self.global_steps} "
+                        f"loss={float(loss):.4f} "
+                        f"norm={float(norm):.3f} {dt*1e3:.0f}ms")
+        return metrics["loss"]
+
+    def _build_eval(self):
+        """Forward-only streamed loss — no backward scan, no grad D2H
+        (the slow direction), ~1/3 the FLOPs of phase A."""
+        module = self.module
+        cdt = self.compute_dtype
+        aux_coef = module.aux_loss_coef()
+        assemble = self._assemble_layer
+        from ..models.transformer import _unpack_batch
+        from ..ops.layers import cross_entropy_loss
+
+        def fwd(master_layers, dev_params, batch):
+            tokens, targets = _unpack_batch(batch)
+            x = module.embed(dev_params, tokens)
+
+            def body(carry, xs):
+                x, aux = carry
+                lh, small = xs
+                lp = assemble(jax.tree.map(
+                    lambda t: self._to_dev(t).astype(cdt), lh), small)
+                y, la = module.block(lp, x)
+                return (y, aux + la), ()
+
+            (xL, aux), _ = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)),
+                (master_layers, dev_params["layers_small"]))
+            xn = module._norm(xL, dev_params["final_norm"]["scale"],
+                              dev_params["final_norm"].get("bias"))
+            logits = module._project_vocab(dev_params, xn)
+            return cross_entropy_loss(logits, targets) + aux_coef * aux
+
+        return jax.jit(fwd, out_shardings=self._dev_sh)
+
+    def eval_batch(self, batch):
+        if getattr(self, "_eval_jit", None) is None:
+            self._eval_jit = self._build_eval()
+        batch = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._dev_sh), batch)
+        return self._eval_jit(self.master_layers, self.dev_params, batch)
+
+    def get_global_grad_norm(self):
+        m = self._last_metrics
+        return float(m["grad_norm"]) if m is not None else None
+
+    # ------------------------------------------------------------------
+    # checkpointing: host state pulls through the client process — fine
+    # on a real pod host, slow through a remote tunnel (documented)
+    def save_checkpoint(self, save_dir, tag=None, **_kw):
+        import os
+        from ..checkpoint.universal import flatten_with_names
+        tag = tag or f"global_step{self.step_count}"
+        path = os.path.join(save_dir, tag)
+        os.makedirs(path, exist_ok=True)
+        arrays = {}
+        for prefix, tree in (("master", self.master_layers),
+                             ("m", self.m_layers), ("v", self.v_layers),
+                             ("dev_master", self.dev_master),
+                             ("dev_m", self.dev_m),
+                             ("dev_v", self.dev_v)):
+            for name, leaf in flatten_with_names(tree):
+                arrays[f"{prefix}::{name}"] = np.asarray(leaf)
+        arrays["__step__"] = np.asarray(self.step_count)
+        np.savez(os.path.join(path, "streamed_state.npz"), **arrays)
+        with open(os.path.join(save_dir, "latest"), "w") as f:
+            f.write(tag)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, **_kw):
+        import os
+        if tag is None:
+            with open(os.path.join(load_dir, "latest")) as f:
+                tag = f.read().strip()
+        data = np.load(os.path.join(load_dir, tag, "streamed_state.npz"))
+        from ..checkpoint.universal import flatten_with_names
+
+        def restore(prefix, tree, sharding):
+            leaves = []
+            for name, leaf in flatten_with_names(tree):
+                arr = jnp.asarray(data[f"{prefix}::{name}"],
+                                  dtype=leaf.dtype)
+                leaves.append(jax.device_put(arr, sharding))
+            flat, treedef = jax.tree.flatten(tree)
+            return jax.tree.unflatten(treedef, leaves)
+
+        self.master_layers = restore("master", self.master_layers,
+                                     self._host_sh)
+        self.m_layers = restore("m", self.m_layers, self._host_sh)
+        self.v_layers = restore("v", self.v_layers, self._host_sh)
+        self.dev_master = restore("dev_master", self.dev_master,
+                                  self._dev_sh)
+        self.dev_m = restore("dev_m", self.dev_m, self._dev_sh)
+        self.dev_v = restore("dev_v", self.dev_v, self._dev_sh)
+        self.dev_params = jax.tree.map(
+            lambda x: x.astype(self.compute_dtype), self.dev_master)
+        self.step_count = int(data["__step__"])
+        return load_dir, {}

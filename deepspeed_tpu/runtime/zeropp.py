@@ -41,7 +41,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
-from ..ops.pallas.quantization import (quantize_int8, quantized_all_gather)
+from ..ops.pallas.quantization import (QBLOCK, quantize_int8,
+                                       quantized_all_gather)
 
 PyTree = Any
 
@@ -98,12 +99,26 @@ def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
     return summed[: int(np.prod(m))].reshape(m).astype(g.dtype)
 
 
+def _log_wire(op: str, nbytes: int) -> None:
+    """Trace-time wire accounting: these collectives are traced once per
+    compile, so the comms logger (utils/comms_logging.py) records each
+    op's per-step payload exactly once — the TPU analogue of the
+    reference's per-call logging (comm.py:101 log_summary)."""
+    from .. import comm
+    lg = comm.get_comms_logger()
+    if lg is not None:
+        lg.append(op, int(nbytes))
+
+
 def _gather_param(x, spec, quantized: bool, wire_dtype: str = "int8"):
     """Reassemble a full parameter from its local shard inside shard_map."""
     for dim, axes in _sharded_dims(spec):
         if quantized and x.size >= MIN_QUANT_SIZE:
+            _log_wire(f"quantized_all_gather({wire_dtype})",
+                      x.size * 1 + x.size // QBLOCK * 4)
             x = quantized_all_gather(x, axes, dim, wire_dtype=wire_dtype)
         else:
+            _log_wire("all_gather", x.size * x.dtype.itemsize)
             x = lax.all_gather(x, axes, axis=dim, tiled=True)
     return x
 
@@ -115,12 +130,16 @@ def _reduce_grad(g, spec, batch_axes, n_batch, quantized: bool,
     for dim, axes in _sharded_dims(spec):
         shard_axes.update(axes)
         if quantized and g.size >= MIN_QUANT_SIZE * 4:
+            _log_wire(f"quantized_reduce_scatter({wire_dtype})",
+                      g.size * 1 + g.size // QBLOCK * 4)
             g = quantized_reduce_scatter(g, axes, dim,
                                          wire_dtype=wire_dtype)
         else:
+            _log_wire("reduce_scatter", g.size * g.dtype.itemsize)
             g = lax.psum_scatter(g, axes, scatter_dimension=dim, tiled=True)
     rest = tuple(a for a in batch_axes if a not in shard_axes)
     if rest:
+        _log_wire("all_reduce", g.size * g.dtype.itemsize)
         g = lax.psum(g, rest)
     return g / n_batch
 

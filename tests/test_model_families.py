@@ -8,12 +8,12 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu as ds
-from deepspeed_tpu.models import (GPT2, OPT, Falcon, Llama, Mistral,
-                                  Mixtral, Phi, Phi3, Qwen, Qwen2, Qwen2MoE,
-                                  get_model_class)
+from deepspeed_tpu.models import (GPT2, OPT, Bloom, Falcon, GPTJ, GPTNeoX,
+                                  Llama, Mistral, Mixtral, Phi, Phi3, Qwen,
+                                  Qwen2, Qwen2MoE, get_model_class)
 
 FAMILIES = [GPT2, Llama, Mistral, Mixtral, Falcon, OPT, Phi, Phi3, Qwen,
-            Qwen2, Qwen2MoE]
+            Qwen2, Qwen2MoE, Bloom, GPTJ, GPTNeoX]
 
 
 def tiny(cls):
@@ -44,8 +44,46 @@ def test_family_init_loss_decode(cls):
 
 def test_registry_covers_reference_families():
     for name in ("gpt2", "llama", "mistral", "mixtral", "falcon", "opt",
-                 "phi", "phi3", "qwen", "qwen2", "qwen2_moe"):
+                 "phi", "phi3", "qwen", "qwen2", "qwen2_moe", "bloom",
+                 "gptj", "gptneox"):
         assert get_model_class(name) is not None
+
+
+def test_bloom_alibi_extends_past_train_length():
+    """ALiBi's point: no learned/rotary position table, so a model
+    scored at a longer context than tiny's 128 still produces finite,
+    position-sensitive logits, and nearby keys dominate far ones."""
+    model = Bloom(size="tiny", max_seq_len=256)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 200), 0, 512)
+    logits = model.apply(params, tokens)
+    assert bool(jnp.isfinite(logits).all())
+    # perturbing a FAR token moves the last position's logits less than
+    # perturbing a NEAR token (the linear-bias recency prior)
+    far = tokens.at[0, 0].set((tokens[0, 0] + 7) % 512)
+    near = tokens.at[0, 198].set((tokens[0, 198] + 7) % 512)
+    d_far = float(jnp.max(jnp.abs(
+        model.apply(params, far)[0, -1] - logits[0, -1])))
+    d_near = float(jnp.max(jnp.abs(
+        model.apply(params, near)[0, -1] - logits[0, -1])))
+    assert d_near > d_far
+
+
+def test_gptneox_dual_norm_parallel_residual():
+    """NeoX: attention and MLP read DIFFERENT norms of the same input;
+    scaling ln2 must change the output while a single-norm parallel
+    model (GPT-J) has no ln2 at all."""
+    model = GPTNeoX(size="tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    assert "ln2_scale" in params["layers"]
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 512)
+    base = model.apply(params, tokens)
+    params["layers"]["ln2_scale"] = params["layers"]["ln2_scale"] * 2.0
+    assert float(jnp.max(jnp.abs(model.apply(params, tokens) - base))) > 0
+    gptj = GPTJ(size="tiny").init(jax.random.PRNGKey(0))
+    assert "ln2_scale" not in gptj["layers"]
+    # GPT-J bias layout: unbiased attention, biased MLP
+    assert "wq_b" not in gptj["layers"] and "w_up_b" in gptj["layers"]
 
 
 def test_mistral_sliding_window_masks_far_keys():

@@ -141,3 +141,65 @@ def test_onebit_adam_converges_vs_exact_adam_on_mesh(devices8):
     np.testing.assert_allclose(onebit[:3], exact[:3], rtol=1e-4)
     for a, b in zip(onebit[3:], exact[3:]):
         assert abs(a - b) / b < 0.15, (onebit, exact)
+
+
+def test_onebit_with_qgz_wire_bytes(devices8):
+    """VERDICT r2 item 9: OnebitAdam composes with
+    zero_quantized_gradients — the 1-bit numerics ride qgZ's int8 wire,
+    and the comms logger must show the gradient reduce-scatter payload
+    dropping ~4x vs the fp32 wire (reference: runtime/comm/nccl.py:51
+    compressed allreduce payload)."""
+    from types import SimpleNamespace
+
+    from deepspeed_tpu import comm
+    from deepspeed_tpu.runtime.zeropp import MIN_QUANT_SIZE
+
+    comm.configure_comms_logger(SimpleNamespace(
+        enabled=True, verbose=False, prof_all=True, prof_ops=[]))
+    try:
+        engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config={
+            "train_batch_size": 16,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 2}},
+            "steps_per_print": 100,
+            "mesh": {"fsdp": -1},
+            # qwZ off: isolate the gradient wire
+            "zero_optimization": {"stage": 2,
+                                  "zero_quantized_gradients": True},
+        })
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (16, 17), 0,
+                                    512)
+        batch = (tokens[:, :-1], tokens[:, 1:])
+        losses = [float(engine.train_batch(batch)) for _ in range(4)]
+        # steps 1-2 are exact-Adam warmup, step 3+ ride the compressed
+        # momentum — loss must fall through warmup and stay finite
+        # through the compressed steps (one-step jitter at the freeze
+        # boundary is expected 1-bit behavior)
+        assert losses[2] < losses[0], losses
+        assert all(np.isfinite(losses)), losses
+        lg = comm.get_comms_logger()
+        q_bytes = sum(size * cnt
+                      for op, sizes in lg.comms_dict.items()
+                      if op.startswith("quantized_reduce_scatter")
+                      for size, cnt in sizes.items())
+        assert q_bytes > 0, dict(lg.comms_dict)
+        # independent fp32 wire for the SAME leaves, from the engine's
+        # own grad shapes: every fsdp-sharded leaf big enough to
+        # quantize would have sent 4 bytes/elem
+        exact_bytes = sum(
+            int(np.prod(l.shape)) * 4
+            for l, spec in zip(
+                jax.tree.leaves(
+                    jax.tree.map(lambda x: x, engine.state["params"])),
+                jax.tree.leaves(engine.plan.grad_specs,
+                                is_leaf=lambda s: hasattr(s, "index")
+                                or s is None or hasattr(s, "_asdict")
+                                or isinstance(s, tuple)))
+            if int(np.prod(l.shape)) >= MIN_QUANT_SIZE * 4
+            and any(a is not None for a in (spec or ())))
+        assert exact_bytes > 0
+        # measured quantized payload must be ~4x smaller than the fp32
+        # payload the same leaves would otherwise ship
+        assert q_bytes < 0.3 * exact_bytes, (q_bytes, exact_bytes)
+    finally:
+        comm.configure_comms_logger(SimpleNamespace(enabled=False))
