@@ -5,14 +5,23 @@
 //
 // TPU build: C ABI handle (ctypes-loaded) with the same operation set —
 // async pread/pwrite, blocked into `block_size` chunks spread over
-// `num_threads` workers, plus a synchronous path. Uses plain
-// pread/pwrite syscalls (portable; O_DIRECT is an open flag away and the
-// thread pool already gives queue-depth parallelism an io_uring backend
-// would).
+// `num_threads` workers, plus a synchronous path. `use_direct` opens
+// files with O_DIRECT so sweeps measure the DEVICE, not the page cache
+// (reference: deepspeed_py_aio_handle.cpp runs libaio on O_DIRECT fds):
+// each worker keeps a reusable 4 KiB-aligned bounce buffer (the caller's
+// numpy memory has arbitrary alignment) — full aligned chunks go through
+// the direct fd, the unaligned tail through a buffered fd. The thread
+// pool gives the queue-depth parallelism io_submit's ring would, and the
+// per-worker bounce buffers double-buffer transfers against compute.
+
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE   // O_DIRECT
+#endif
 
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <functional>
@@ -26,17 +35,48 @@
 
 namespace {
 
+constexpr int64_t kDirectAlign = 4096;
+
 struct Task {
     std::function<void()> fn;
 };
 
+// per-worker aligned bounce buffer, sized on first use and freed at
+// thread exit (a raw thread_local pointer would leak per destroyed
+// handle's worker threads)
+struct Bounce {
+    char* p = nullptr;
+    int64_t len = 0;
+    ~Bounce() { std::free(p); }
+};
+thread_local Bounce tls_bounce;
+
+char* bounce_buffer(int64_t len) {
+    if (tls_bounce.len < len) {
+        std::free(tls_bounce.p);
+        if (posix_memalign(reinterpret_cast<void**>(&tls_bounce.p),
+                           kDirectAlign, (size_t)len) != 0) {
+            tls_bounce.p = nullptr;
+            tls_bounce.len = 0;
+            return nullptr;
+        }
+        tls_bounce.len = len;
+    }
+    return tls_bounce.p;
+}
+
 class AioHandle {
    public:
-    AioHandle(int64_t block_size, int num_threads)
+    AioHandle(int64_t block_size, int num_threads, bool use_direct)
         : block_size_(block_size > 0 ? block_size : (1 << 20)),
+          use_direct_(use_direct),
           stop_(false),
           pending_(0),
           errors_(0) {
+        if (use_direct_ && (block_size_ % kDirectAlign) != 0) {
+            block_size_ = ((block_size_ + kDirectAlign - 1) / kDirectAlign)
+                          * kDirectAlign;
+        }
         int n = num_threads > 0 ? num_threads : 1;
         for (int i = 0; i < n; ++i) {
             workers_.emplace_back([this] { this->worker(); });
@@ -60,24 +100,47 @@ class AioHandle {
             int64_t len = std::min(block_size_, n - off);
             char* p = buf + off;
             int64_t foff = file_offset + off;
-            enqueue([this, path, p, len, foff, flags, is_read] {
-                int fd = ::open(path.c_str(), flags, 0644);
+            // O_DIRECT needs file-offset and length alignment; the
+            // bounce buffer supplies the memory alignment. The tail (or
+            // an unaligned file offset) takes the buffered path.
+            bool direct = use_direct_ && (foff % kDirectAlign) == 0 &&
+                          (len % kDirectAlign) == 0;
+            enqueue([this, path, p, len, foff, flags, is_read, direct] {
+                int fd = ::open(path.c_str(),
+                                direct ? (flags | O_DIRECT) : flags, 0644);
+                if (fd < 0 && direct) {
+                    // filesystem without O_DIRECT (tmpfs): buffered
+                    fd = ::open(path.c_str(), flags, 0644);
+                }
                 if (fd < 0) {
                     errors_.fetch_add(1);
                     return;
                 }
+                char* io_buf = p;
+                if (direct) {
+                    io_buf = bounce_buffer(len);
+                    if (io_buf == nullptr) {
+                        errors_.fetch_add(1);
+                        ::close(fd);
+                        return;
+                    }
+                    if (!is_read) std::memcpy(io_buf, p, (size_t)len);
+                }
                 int64_t done = 0;
                 while (done < len) {
                     ssize_t r = is_read
-                                    ? ::pread(fd, p + done, len - done,
+                                    ? ::pread(fd, io_buf + done, len - done,
                                               foff + done)
-                                    : ::pwrite(fd, p + done, len - done,
-                                               foff + done);
+                                    : ::pwrite(fd, io_buf + done,
+                                               len - done, foff + done);
                     if (r <= 0) {
                         errors_.fetch_add(1);
                         break;
                     }
                     done += r;
+                }
+                if (direct && is_read && done == len) {
+                    std::memcpy(p, io_buf, (size_t)len);
                 }
                 ::close(fd);
             });
@@ -123,6 +186,7 @@ class AioHandle {
     }
 
     int64_t block_size_;
+    bool use_direct_;
     bool stop_;
     int64_t pending_;
     std::atomic<int64_t> errors_;
@@ -137,7 +201,14 @@ class AioHandle {
 extern "C" {
 
 void* ds_aio_handle_new(int64_t block_size, int num_threads) {
-    return new AioHandle(block_size, num_threads);
+    return new AioHandle(block_size, num_threads, /*use_direct=*/false);
+}
+
+// O_DIRECT-capable constructor (reference: aio config block's
+// use_direct / the sweep's page-cache-off mode).
+void* ds_aio_handle_new_direct(int64_t block_size, int num_threads,
+                               int use_direct) {
+    return new AioHandle(block_size, num_threads, use_direct != 0);
 }
 
 void ds_aio_handle_free(void* h) { delete static_cast<AioHandle*>(h); }

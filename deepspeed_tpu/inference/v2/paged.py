@@ -181,11 +181,13 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
 
 def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     pos0: jax.Array,
-                    window: int | None = None):
+                    window: int | None = None,
+                    alibi_slopes: jax.Array | None = None):
     """q: [B, S_new, H, D]; k/v: gathered pages [B, smax, H_kv, D]
     (already containing this chunk's fresh k/v); pos0 [B] tokens cached
     before this chunk. Causal over absolute positions; ``window``
-    restricts lookback (Mistral SWA). (reference: blocked_flash)"""
+    restricts lookback (Mistral SWA); ``alibi_slopes`` [H] adds Bloom's
+    per-head linear position bias. (reference: blocked_flash)"""
     b, sq, hq, d = q.shape
     smax = k.shape[1]
     hkv = k.shape[2]
@@ -200,6 +202,10 @@ def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     mask = kpos[:, None, :] <= qpos[:, :, None]               # [B, S, smax]
     if window is not None:
         mask &= kpos[:, None, :] > qpos[:, :, None] - window
+    if alibi_slopes is not None:
+        rel = (kpos[:, None, :] - qpos[:, :, None]).astype(jnp.float32)
+        logits = logits + (alibi_slopes[None, :, None, None]
+                           * rel[:, None])
     logits = jnp.where(mask[:, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
@@ -228,14 +234,18 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     # [B, S, H, D] chunk as a scan output; one bulk scatter after the
     # scan writes all layers. Routing the slabs through the ys stream
     # would copy the whole pool through HBM every step.
+    alibi = getattr(model, "_alibi_slopes", None)
+
     def body(x, xs):
         p, k_pool, v_pool = xs
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
         bs_ = k_pool.shape[1]
-        if use_kernel and q.shape[-1] % 8 == 0 and bs_ % 8 == 0:
+        if (use_kernel and q.shape[-1] % 8 == 0 and bs_ % 8 == 0
+                and alibi is None):
             # blocked-flash kernel: reads pages via the block table, no
-            # gathered [B, smax, H, D] materialization
+            # gathered [B, smax, H, D] materialization (no ALiBi path
+            # in-kernel yet — Bloom takes the exact gathered form below)
             a = paged_attention_kernel(
                 q, k, v, k_pool, v_pool, block_tables, pos0, true_len,
                 window=model.config.sliding_window)
@@ -245,9 +255,13 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
             v_pages = place_in_pages(gather_pages(v_pool, block_tables),
                                      v, pos0, true_len)
             a = paged_attention(q, k_pages, v_pages, pos0,
-                                window=model.config.sliding_window)
+                                window=model.config.sliding_window,
+                                alibi_slopes=alibi)
         if model.config.parallel_residual:
-            m, _ = model._mlp(p, h)
+            # GPT-NeoX (parallel_dual_norm): MLP reads its own LayerNorm
+            h_mlp = (model._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+                     if model.config.parallel_dual_norm else h)
+            m, _ = model._mlp(p, h_mlp)
             return x + model._attn_out(p, a) + m, (k, v)
         x = x + model._attn_out(p, a)
         x, _ = model._mlp_residual(p, x)

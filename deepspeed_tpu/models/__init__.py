@@ -1,4 +1,5 @@
 from .base import Model, ModelConfig, get_model_class, register_model  # noqa: F401
+from .bert import Bert, bert_config  # noqa: F401
 from .bloom import Bloom, bloom_config  # noqa: F401
 from .falcon import Falcon, falcon_config  # noqa: F401
 from .gpt2 import GPT2, gpt2_config  # noqa: F401

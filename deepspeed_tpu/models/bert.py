@@ -1,0 +1,174 @@
+"""BERT-style encoder, end-to-end trainable (reference:
+module_inject/containers/bert.py + the training transformer kernel
+ops/transformer/transformer.py it was built for — DeepSpeed's original
+headline workload was BERT pre-training).
+
+Wraps ops/transformer.py's DeepSpeedTransformerLayer (the encoder-layer
+API mirroring the reference kernel config) into a Model-protocol MLM:
+embeddings (token + learned position, LayerNorm), stacked layers via
+lax.scan, and the standard BERT MLM head (transform + tied decoder).
+Trainable through ds.initialize like any decoder family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import layers as L
+from ..ops.transformer import (DeepSpeedTransformerConfig,
+                               DeepSpeedTransformerLayer)
+from .base import register_model
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    norm_eps: float = 1e-12
+    pre_layer_norm: bool = False     # post-LN = original BERT
+    param_dtype: Any = None
+
+    def __post_init__(self):
+        if self.param_dtype is None:
+            self.param_dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        d, f, v, Lr = (self.hidden_size, self.intermediate_size,
+                       self.vocab_size, self.num_layers)
+        per_layer = (d * 3 * d + 3 * d) + (d * d + d) + 2 * d \
+            + (d * f + f) + (f * d + d) + 2 * d
+        embed = v * d + self.max_seq_len * d + 2 * d
+        head = d * d + d + 2 * d + v   # transform + LN + decoder bias
+        return embed + Lr * per_layer + head
+
+    def flops_per_token(self, seq_len: int, causal: bool = False) -> float:
+        # encoders attend bidirectionally; `causal` kept for API parity
+        n = self.num_params()
+        return 6 * n + 12 * self.num_layers * self.hidden_size * seq_len
+
+
+def bert_config(size: str = "base", **overrides) -> BertConfig:
+    presets = {
+        "tiny": dict(hidden_size=64, num_layers=2, num_heads=4,
+                     intermediate_size=256, vocab_size=512,
+                     max_seq_len=128),
+        "base": dict(hidden_size=768, num_layers=12, num_heads=12,
+                     intermediate_size=3072),
+        "large": dict(hidden_size=1024, num_layers=24, num_heads=16,
+                      intermediate_size=4096),
+    }
+    base = dict(presets[size])
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+@register_model("bert")
+class Bert:
+    """Model-protocol encoder: init / apply (MLM logits) / loss."""
+
+    def __init__(self, config: BertConfig | None = None,
+                 size: str | None = None, **overrides):
+        if config is not None and (size is not None or overrides):
+            raise ValueError(
+                "pass either an explicit config or size/overrides, not both")
+        self.config = config or bert_config(size or "base", **overrides)
+        c = self.config
+        self._layer = DeepSpeedTransformerLayer(DeepSpeedTransformerConfig(
+            hidden_size=c.hidden_size, intermediate_size=c.intermediate_size,
+            heads=c.num_heads, num_hidden_layers=c.num_layers,
+            attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+            pre_layer_norm=c.pre_layer_norm, layer_norm_eps=c.norm_eps,
+            training=True))
+
+    # ------------------------------------------------------------- init
+    def init(self, rng: jax.Array) -> PyTree:
+        c = self.config
+        dt = c.param_dtype
+        d, v = c.hidden_size, c.vocab_size
+        ks = jax.random.split(rng, c.num_layers + 3)
+        layer_trees = [self._layer.init(k) for k in ks[:c.num_layers]]
+        layers = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_trees)
+        std = 0.02
+        return {
+            "embed": {
+                "tokens": (jax.random.normal(ks[-3], (v, d)) * std
+                           ).astype(dt),
+                "positions": (jax.random.normal(ks[-2], (c.max_seq_len, d))
+                              * std).astype(dt),
+                "ln_scale": jnp.ones((d,), dt),
+                "ln_bias": jnp.zeros((d,), dt),
+            },
+            "layers": layers,
+            "mlm_head": {
+                "transform_w": (jax.random.normal(ks[-1], (d, d)) * std
+                                ).astype(dt),
+                "transform_b": jnp.zeros((d,), dt),
+                "ln_scale": jnp.ones((d,), dt),
+                "ln_bias": jnp.zeros((d,), dt),
+                "decoder_b": jnp.zeros((v,), dt),
+            },
+        }
+
+    # ------------------------------------------------------------ apply
+    def apply(self, params: PyTree, tokens: jax.Array,
+              attention_mask: jax.Array | None = None) -> jax.Array:
+        """MLM logits [B, S, V]. ``attention_mask``: [B, S] 1=real
+        0=padding (HF convention) -> additive bias."""
+        c = self.config
+        if tokens.shape[-1] > c.max_seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[-1]} exceeds max_seq_len "
+                f"{c.max_seq_len}")
+        e = params["embed"]
+        x = jnp.take(e["tokens"], tokens, axis=0)
+        x = x + e["positions"][: tokens.shape[-1]][None]
+        x = L.layer_norm(x, e["ln_scale"], e["ln_bias"], c.norm_eps)
+        bias = None
+        if attention_mask is not None:
+            bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                             0.0, -1e30).astype(jnp.float32)
+
+        def body(h, lp):
+            return self._layer.apply(lp, h, attention_mask=bias), None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        h = params["mlm_head"]
+        x = L.gelu(x @ h["transform_w"] + h["transform_b"])
+        x = L.layer_norm(x, h["ln_scale"], h["ln_bias"], c.norm_eps)
+        return x @ e["tokens"].T + h["decoder_b"]
+
+    # ------------------------------------------------------------- loss
+    def loss(self, params: PyTree, batch: Any, **_kw) -> jax.Array:
+        """Masked-LM loss: batch = (tokens, targets[, attention_mask]);
+        targets use -100 at unmasked positions (HF convention)."""
+        if isinstance(batch, dict):
+            tokens, targets = batch["input_ids"], batch["labels"]
+            mask = batch.get("attention_mask")
+        else:
+            tokens, targets = batch[0], batch[1]
+            mask = batch[2] if len(batch) > 2 else None
+        logits = self.apply(params, tokens, attention_mask=mask)
+        return L.cross_entropy_loss(logits, targets)
+
+    def partition_rules(self):
+        from jax.sharding import PartitionSpec as P
+        return [
+            (r"embed/tokens", P("tp", None)),
+            (r"layers/(qkv_w|inter_w)", P(None, None, "tp")),
+            (r"layers/(attn_ow|output_w)", P(None, "tp", None)),
+            (r"mlm_head/transform_w", P()),
+        ]

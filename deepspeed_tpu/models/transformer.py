@@ -349,7 +349,10 @@ class DecoderLM:
                                window=self.config.sliding_window,
                                alibi_slopes=self._alibi_slopes)
         if self.config.parallel_residual:
-            m, _ = self._mlp(p, h)
+            # GPT-NeoX (parallel_dual_norm): MLP reads its own LayerNorm
+            h_mlp = (self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+                     if self.config.parallel_dual_norm else h)
+            m, _ = self._mlp(p, h_mlp)
             return x + self._attn_out(p, a) + m, k_cache, v_cache
         x = x + self._attn_out(p, a)
         x, _ = self._mlp_residual(p, x)

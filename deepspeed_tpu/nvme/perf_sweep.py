@@ -22,6 +22,10 @@ DEFAULT_SWEEP = {
     "block_size": [1 << 17, 1 << 20],   # 128K, 1M
     "queue_depth": [4, 32],
     "io_parallel": [1, 2],
+    # O_DIRECT bypasses the page cache so the sweep measures the DEVICE
+    # (reference: the aio op always runs O_DIRECT; buffered rows are
+    # kept for comparison / filesystems without O_DIRECT support)
+    "use_direct": [False, True],
 }
 
 
@@ -47,7 +51,8 @@ def _run_one(cfg: dict, folder: str, io_size: int) -> dict:
     from ..ops.aio import AsyncIOHandle
     h = AsyncIOHandle(block_size=cfg["block_size"],
                       queue_depth=cfg["queue_depth"],
-                      num_threads=cfg.get("io_parallel", 1))
+                      num_threads=cfg.get("io_parallel", 1),
+                      use_direct=cfg.get("use_direct", False))
     buf = np.random.default_rng(0).integers(
         0, 255, size=io_size, dtype=np.uint8)
     out = np.zeros_like(buf)

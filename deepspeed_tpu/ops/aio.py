@@ -23,14 +23,18 @@ class AsyncIOHandle:
 
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
                  single_submit: bool = False, overlap_events: bool = True,
-                 num_threads: int = 4):
+                 num_threads: int = 4, use_direct: bool = False):
         self._lib = AsyncIOBuilder().load()
         # queue_depth maps to thread-pool width here: the pool already
-        # provides the request parallelism io_submit's ring gives libaio
-        self._h = self._lib.ds_aio_handle_new(
-            block_size, max(num_threads, queue_depth if single_submit else 1))
+        # provides the request parallelism io_submit's ring gives libaio.
+        # use_direct opens data files O_DIRECT (page-cache bypass) with
+        # per-worker aligned bounce buffers (csrc/aio.cpp).
+        self._h = self._lib.ds_aio_handle_new_direct(
+            block_size, max(num_threads, queue_depth if single_submit else 1),
+            1 if use_direct else 0)
         self.block_size = block_size
         self.num_threads = num_threads
+        self.use_direct = use_direct
 
     def __del__(self):
         h = getattr(self, "_h", None)

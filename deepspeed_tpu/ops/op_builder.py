@@ -138,6 +138,8 @@ class AsyncIOBuilder(OpBuilder):
         cp = ctypes.c_char_p
         lib.ds_aio_handle_new.argtypes = [_i64, _i32]
         lib.ds_aio_handle_new.restype = vp
+        lib.ds_aio_handle_new_direct.argtypes = [_i64, _i32, _i32]
+        lib.ds_aio_handle_new_direct.restype = vp
         lib.ds_aio_handle_free.argtypes = [vp]
         lib.ds_aio_pread.argtypes = [vp, cp, ctypes.c_void_p, _i64, _i64]
         lib.ds_aio_pwrite.argtypes = [vp, cp, ctypes.c_void_p, _i64, _i64]
