@@ -109,7 +109,10 @@ class AioHandle {
                 int fd = ::open(path.c_str(),
                                 direct ? (flags | O_DIRECT) : flags, 0644);
                 if (fd < 0 && direct) {
-                    // filesystem without O_DIRECT (tmpfs): buffered
+                    // filesystem without O_DIRECT (tmpfs): buffered —
+                    // COUNTED so callers can tell a sweep row measured
+                    // the page cache after all
+                    direct_fallbacks_.fetch_add(1);
                     fd = ::open(path.c_str(), flags, 0644);
                 }
                 if (fd < 0) {
@@ -156,6 +159,7 @@ class AioHandle {
 
     int64_t block_size() const { return block_size_; }
     int num_threads() const { return (int)workers_.size(); }
+    int64_t direct_fallbacks() const { return direct_fallbacks_.load(); }
 
    private:
     void enqueue(std::function<void()> fn) {
@@ -190,6 +194,7 @@ class AioHandle {
     bool stop_;
     int64_t pending_;
     std::atomic<int64_t> errors_;
+    std::atomic<int64_t> direct_fallbacks_{0};
     std::deque<Task> tasks_;
     std::vector<std::thread> workers_;
     std::mutex mu_;
@@ -256,6 +261,11 @@ int64_t ds_aio_block_size(void* h) {
 
 int ds_aio_num_threads(void* h) {
     return static_cast<AioHandle*>(h)->num_threads();
+}
+
+// chunks that requested O_DIRECT but fell back to buffered I/O
+int64_t ds_aio_direct_fallbacks(void* h) {
+    return static_cast<AioHandle*>(h)->direct_fallbacks();
 }
 
 }  // extern "C"

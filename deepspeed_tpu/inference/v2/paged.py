@@ -258,10 +258,7 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
                                 window=model.config.sliding_window,
                                 alibi_slopes=alibi)
         if model.config.parallel_residual:
-            # GPT-NeoX (parallel_dual_norm): MLP reads its own LayerNorm
-            h_mlp = (model._norm(x, p["ln2_scale"], p.get("ln2_bias"))
-                     if model.config.parallel_dual_norm else h)
-            m, _ = model._mlp(p, h_mlp)
+            m, _ = model._mlp(p, model._parallel_mlp_input(p, x, h))
             return x + model._attn_out(p, a) + m, (k, v)
         x = x + model._attn_out(p, a)
         x, _ = model._mlp_residual(p, x)

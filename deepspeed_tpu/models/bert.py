@@ -101,7 +101,9 @@ class Bert:
         d, v = c.hidden_size, c.vocab_size
         ks = jax.random.split(rng, c.num_layers + 3)
         layer_trees = [self._layer.init(k) for k in ks[:c.num_layers]]
-        layers = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_trees)
+        # the kernel-layer init only knows fp16/fp32; honor param_dtype
+        layers = jax.tree.map(lambda *xs: jnp.stack(xs).astype(dt),
+                              *layer_trees)
         std = 0.02
         return {
             "embed": {

@@ -194,6 +194,16 @@ class DecoderLM:
         m, aux = self._mlp(p, h)
         return x + m, aux
 
+    def _parallel_mlp_input(self, p: PyTree, x: jax.Array, h: jax.Array):
+        """MLP input for parallel-residual blocks — THE single place for
+        the dual-norm switch (GPT-NeoX norms the raw residual with ln2;
+        Falcon/GPT-J share ln1's output). apply/flash/decode/paged all
+        route through here so the paths can't drift (a past bug: decode
+        and v2 serving fed ln1's output to a dual-norm MLP)."""
+        if self.config.parallel_dual_norm:
+            return self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
+        return h
+
     def block(self, layer_params: PyTree, x: jax.Array, *,
               attn_fn: AttnFn | None = None,
               positions: jax.Array | None = None) -> jax.Array:
@@ -238,11 +248,7 @@ class DecoderLM:
         q, k, v = self._qkv(p, h, positions)
         a = attn_fn(q, k, v, causal=True)
         if c.parallel_residual:
-            # Falcon/Phi-2: attention and MLP read the same normed input;
-            # GPT-NeoX (parallel_dual_norm): MLP gets its own LayerNorm
-            h_mlp = (self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
-                     if c.parallel_dual_norm else h)
-            m, aux = self._mlp(p, h_mlp)
+            m, aux = self._mlp(p, self._parallel_mlp_input(p, x, h))
             return x + self._attn_out(p, a) + m, aux
         x = x + self._attn_out(p, a)
         return self._mlp_residual(p, x)
@@ -278,9 +284,7 @@ class DecoderLM:
 
         def seg_out(p, x, a, h):
             if c.parallel_residual:
-                h_mlp = (self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
-                         if c.parallel_dual_norm else h)
-                m, aux = self._mlp(p, h_mlp)
+                m, aux = self._mlp(p, self._parallel_mlp_input(p, x, h))
                 return x + self._attn_out(p, a) + m, aux
             x2 = x + self._attn_out(p, a)
             x2 = checkpoint_name(x2, "resid_mid")
@@ -349,10 +353,7 @@ class DecoderLM:
                                window=self.config.sliding_window,
                                alibi_slopes=self._alibi_slopes)
         if self.config.parallel_residual:
-            # GPT-NeoX (parallel_dual_norm): MLP reads its own LayerNorm
-            h_mlp = (self._norm(x, p["ln2_scale"], p.get("ln2_bias"))
-                     if self.config.parallel_dual_norm else h)
-            m, _ = self._mlp(p, h_mlp)
+            m, _ = self._mlp(p, self._parallel_mlp_input(p, x, h))
             return x + self._attn_out(p, a) + m, k_cache, v_cache
         x = x + self._attn_out(p, a)
         x, _ = self._mlp_residual(p, x)

@@ -65,8 +65,13 @@ def _run_one(cfg: dict, folder: str, io_size: int) -> dict:
     t_read = time.time() - t0
     os.unlink(path)
     gb = io_size / 2 ** 30
-    return {**cfg, "write_gbs": gb / max(t_write, 1e-9),
-            "read_gbs": gb / max(t_read, 1e-9)}
+    out = {**cfg, "write_gbs": gb / max(t_write, 1e-9),
+           "read_gbs": gb / max(t_read, 1e-9)}
+    if cfg.get("use_direct"):
+        # honest rows: non-zero fallbacks mean the filesystem rejected
+        # O_DIRECT and (part of) this row measured the page cache
+        out["direct_effective"] = h.direct_fallbacks == 0
+    return out
 
 
 def perf_run_sweep(folder: Optional[str] = None,

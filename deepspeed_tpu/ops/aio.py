@@ -61,6 +61,13 @@ class AsyncIOHandle:
 
     wait = synchronize  # reference spells it `wait`
 
+    @property
+    def direct_fallbacks(self) -> int:
+        """Chunks that requested O_DIRECT but fell back to buffered I/O
+        (filesystem without O_DIRECT, e.g. tmpfs). Non-zero means a
+        use_direct measurement partially rode the page cache."""
+        return int(self._lib.ds_aio_direct_fallbacks(self._h))
+
     # --- sync ops ----------------------------------------------------
     def sync_pread(self, buffer: np.ndarray, path: str,
                    file_offset: int = 0) -> int:

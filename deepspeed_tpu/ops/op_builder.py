@@ -153,3 +153,5 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_block_size.restype = _i64
         lib.ds_aio_num_threads.argtypes = [vp]
         lib.ds_aio_num_threads.restype = _i32
+        lib.ds_aio_direct_fallbacks.argtypes = [vp]
+        lib.ds_aio_direct_fallbacks.restype = _i64

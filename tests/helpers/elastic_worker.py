@@ -1,0 +1,62 @@
+"""Worker for test_elastic_agent_restart_loop: runs the elastic restart
+agent end-to-end. Epoch 0 (restart_count 0) simulates a membership
+change -> the agent re-execs this process; epoch 1 trains 2 real ZeRO-2
+steps and writes {restarts, world, losses} to argv[1]."""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from deepspeed_tpu.elasticity.elastic_agent import (  # noqa: E402
+    ElasticTrainingAgent, WorldSizeChanged)
+
+OUT = sys.argv[1]
+CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 64,
+        "micro_batch_sizes": [2, 4, 8],
+        "min_gpus": 1,
+        "max_gpus": 8,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+agent = ElasticTrainingAgent(CONFIG, restart_backoff_s=0.0)
+
+
+def build_fn(world, micro, gas):
+    if agent.restart_count == 0:
+        # first epoch: a membership change is "detected"
+        raise WorldSizeChanged()
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config={
+        "train_batch_size": micro * gas * jax.device_count(),
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"fsdp": -1},
+        "steps_per_print": 10 ** 9,
+    })
+    tb = engine.train_batch_size_
+    tokens = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(0), (tb, 17), 0, 512))
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    losses = [float(engine.train_batch(batch)) for _ in range(2)]
+    with open(OUT, "w") as f:
+        json.dump({"restarts": agent.restart_count, "world": world,
+                   "micro": micro, "gas": gas, "losses": losses}, f)
+
+
+agent.run(build_fn)
