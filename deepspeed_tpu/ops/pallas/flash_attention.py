@@ -300,10 +300,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
         raise ValueError(f"q heads {hq} must be a multiple of kv heads "
                          f"{hkv}")
     rep = hq // hkv
-    if s > 128 and s % 128 != 0:
-        # the blocked kernels require 128-aligned sequence lengths; an
+    if (s > 128 and s % 128 != 0) or (
+            s < 128 and jax.default_backend() == "tpu"):
+        # the blocked kernels require 128-aligned sequence lengths: an
         # unaligned tail would be silently dropped by the grid floor
-        # division — use the exact (unfused) path instead
+        # division, and sub-128 blocks fail Mosaic's lane-width lowering
+        # on real hardware (interpret mode accepts them, so CPU tests
+        # still exercise the kernel at tiny shapes) — use the exact
+        # (unfused) path instead
         from ..layers import dot_product_attention, window_bias
         bias = window_bias(s, window) if window is not None else None
         return dot_product_attention(q, k, v, causal=causal, bias=bias)

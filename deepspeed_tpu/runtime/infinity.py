@@ -542,6 +542,18 @@ class StreamedZeroEngine:
         m = self._last_metrics
         return float(m["grad_norm"]) if m is not None else None
 
+    def save_16bit_model(self, save_dir, checkpoint_name="model_weights.npz"):
+        """Consolidated weights export — the bridge OFF the streamed
+        tier: the npz loads into init_inference(checkpoint=...) or back
+        into the sharded engine via model_parameters, so a model trained
+        7B-style on one chip can be served or resumed sharded on a pod
+        (reference: engine.save_16bit_model:3638)."""
+        from types import SimpleNamespace
+
+        from .checkpointing import save_16bit_model as _save
+        return _save(SimpleNamespace(state={"params": self.params}),
+                     save_dir, checkpoint_name)
+
     # ------------------------------------------------------------------
     # checkpointing: host state pulls through the client process — fine
     # on a real pod host, slow through a remote tunnel (documented)

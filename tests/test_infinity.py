@@ -7,6 +7,7 @@ runs on TPU is exercised and must track the sharded engine's
 trajectory."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -97,6 +98,39 @@ def test_streamed_bf16_moments(devices8):
     assert eng.m_layers[eng._stream_names[0]].dtype == jnp.bfloat16
     l_s = [float(eng.train_batch(batch)) for _ in range(4)]
     np.testing.assert_allclose(l_s, l_ref, rtol=5e-3, atol=5e-3)
+
+
+def test_streamed_to_sharded_bridge(tmp_path, devices8):
+    """Train on the streamed tier, export 16-bit weights, continue on
+    the SHARDED engine (and serve via init_inference) — the one-chip ->
+    pod hand-off ZeRO-Infinity exists to enable."""
+    from deepspeed_tpu.checkpoint.universal import flatten_with_names
+    batch = _batch(5)
+    eng, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                 config=_stream_cfg())
+    for _ in range(2):
+        eng.train_batch(batch)
+    eng.save_16bit_model(str(tmp_path))
+    data = np.load(tmp_path / "model_weights.npz")
+    # rebuild the tree and resume sharded via model_parameters
+    model = Llama(size="tiny")
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    names = [n for n, _ in flatten_with_names(abstract)]
+    flat = [jnp.asarray(data[n]) for n in names]
+    tree = jax.tree.unflatten(jax.tree.structure(abstract), flat)
+    sharded, _, _, _ = ds.initialize(
+        model=model, model_parameters=tree,
+        config=_cfg(mesh={"fsdp": -1}, zero_optimization={"stage": 2}))
+    np.testing.assert_allclose(float(sharded.eval_batch(batch)),
+                               float(eng.eval_batch(batch)),
+                               rtol=1e-4, atol=1e-4)
+    losses = [float(sharded.train_batch(batch)) for _ in range(2)]
+    assert losses[-1] < losses[0]
+    # and straight into serving
+    inf = ds.init_inference(Llama(size="tiny"), dtype="float32",
+                            checkpoint=str(tmp_path / "model_weights.npz"))
+    out = inf.generate(jnp.asarray([[1, 2, 3]]), max_new_tokens=2)
+    assert np.asarray(out).shape == (1, 5)
 
 
 def test_streamed_rejects_unsupported(devices8):

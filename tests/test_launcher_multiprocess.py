@@ -92,7 +92,11 @@ def test_elastic_agent_restart_loop(tmp_path):
     p = subprocess.Popen([sys.executable, worker, out], env=_env(4),
                          stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT)
-    stdout, _ = p.communicate(timeout=480)
+    try:
+        stdout, _ = p.communicate(timeout=480)
+    finally:
+        if p.poll() is None:
+            p.kill()   # don't leak a self-re-exec'ing worker
     assert p.returncode == 0, stdout.decode(errors="replace")[-3000:]
     res = json.load(open(out))
     assert res["restarts"] == 1           # exactly one re-exec happened
