@@ -185,16 +185,25 @@ def kernel_smoke() -> dict:
                              [:B * 8].reshape(B, 8))
         pos0 = jnp.asarray([21, 0])
         true_len = jnp.asarray([SQ, 7])
-        out_k = paged_attention_kernel(q, k_new, v_new, k_pool, v_pool,
-                                       tables, pos0, true_len)
-        ref = paged_attention(
-            q, place_in_pages(gather_pages(k_pool, tables), k_new, pos0,
-                              true_len),
-            place_in_pages(gather_pages(v_pool, tables), v_new, pos0,
-                           true_len), pos0)
+        from deepspeed_tpu.ops.layers import alibi_slopes
+        k_pages = place_in_pages(gather_pages(k_pool, tables), k_new,
+                                 pos0, true_len)
+        v_pages = place_in_pages(gather_pages(v_pool, tables), v_new,
+                                 pos0, true_len)
         live = jnp.arange(SQ)[None, :, None, None] < true_len[:, None,
                                                              None, None]
-        return jnp.max(jnp.abs(jnp.where(live, out_k - ref, 0.0)))
+        # BOTH kernel specializations get hardware parity: the default
+        # path and the ALiBi (Bloom) path — worst error is reported
+        err = 0.0
+        for slopes in (None, alibi_slopes(H)):
+            out_k = paged_attention_kernel(
+                q, k_new, v_new, k_pool, v_pool, tables, pos0, true_len,
+                alibi_slopes=slopes)
+            ref = paged_attention(q, k_pages, v_pages, pos0,
+                                  alibi_slopes=slopes)
+            err = jnp.maximum(err, jnp.max(jnp.abs(
+                jnp.where(live, out_k - ref, 0.0))))
+        return err
 
     for name, fn in [("int8_roundtrip", int8_roundtrip),
                      ("fp8_roundtrip", fp8_roundtrip),

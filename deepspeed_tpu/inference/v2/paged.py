@@ -49,7 +49,8 @@ def place_in_pages(pages: jax.Array, kv: jax.Array, pos0: jax.Array,
 
 
 def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
-                           pos0, true_len, *, window: int | None = None):
+                           pos0, true_len, *, window: int | None = None,
+                           alibi_slopes=None):
     """Blocked-flash Pallas kernel (reference:
     inference/v2/kernels/ragged_ops/blocked_flash): attention reads KV
     pages straight from the pool through scalar-prefetched block tables —
@@ -77,6 +78,10 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
     counts = (-(-jnp.asarray(pos0, jnp.int32) // bs)).astype(jnp.int32)
     tables = jnp.minimum(block_tables, nb - 1).astype(jnp.int32)
     sc = 1.0 / np.sqrt(d)
+    # per-head ALiBi slopes become compile-time constants of the static
+    # head loop (Bloom; reference blocked_flash takes an alibi operand)
+    slopes = (np.asarray(alibi_slopes, np.float32)
+              if alibi_slopes is not None else None)
 
     def kernel(counts_ref, tables_ref, pos0_ref, tlen_ref, q_ref, kn_ref,
                vn_ref, kp_ref, vp_ref, o_ref, m_s, l_s):
@@ -110,6 +115,9 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                        < tl)
                 if window is not None:
                     live &= qpos - kpos < window
+                if slopes is not None:
+                    s = s + float(slopes[h]) * (
+                        kpos - qpos).astype(jnp.float32)
                 s = jnp.where(live, s, -1e30)
                 rows = pl.ds(h * sq, sq)
                 m_prev = m_s[rows, :1]
@@ -241,14 +249,13 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
         bs_ = k_pool.shape[1]
-        if (use_kernel and q.shape[-1] % 8 == 0 and bs_ % 8 == 0
-                and alibi is None):
+        if use_kernel and q.shape[-1] % 8 == 0 and bs_ % 8 == 0:
             # blocked-flash kernel: reads pages via the block table, no
-            # gathered [B, smax, H, D] materialization (no ALiBi path
-            # in-kernel yet — Bloom takes the exact gathered form below)
+            # gathered [B, smax, H, D] materialization; ALiBi rides as
+            # static per-head slopes
             a = paged_attention_kernel(
                 q, k, v, k_pool, v_pool, block_tables, pos0, true_len,
-                window=model.config.sliding_window)
+                window=model.config.sliding_window, alibi_slopes=alibi)
         else:
             k_pages = place_in_pages(gather_pages(k_pool, block_tables),
                                      k, pos0, true_len)
