@@ -18,11 +18,11 @@ from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
 
 __all__ = ["build_engine", "SUPPORTED_MODEL_TYPES"]
 
-# reference engine_factory.py name table (+ bloom/gptj/gptneox, which
-# the reference serves through module_inject containers)
+# reference engine_factory.py name table (+ bloom/gptj/gptneox/internlm,
+# which the reference serves through module_inject containers)
 SUPPORTED_MODEL_TYPES = ("gpt2", "llama", "mistral", "mixtral", "falcon",
                          "opt", "phi", "phi3", "qwen", "qwen2", "qwen2_moe",
-                         "bloom", "gptj", "gptneox")
+                         "bloom", "gptj", "gptneox", "internlm")
 
 
 def build_engine(model_type: str, size: str = "tiny",

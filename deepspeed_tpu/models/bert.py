@@ -70,6 +70,9 @@ def bert_config(size: str = "base", **overrides) -> BertConfig:
                      intermediate_size=3072),
         "large": dict(hidden_size=1024, num_layers=24, num_heads=16,
                       intermediate_size=4096),
+        # reference containers/distil_bert.py: 6-layer distilled BERT
+        "distil": dict(hidden_size=768, num_layers=6, num_heads=12,
+                       intermediate_size=3072),
     }
     base = dict(presets[size])
     base.update(overrides)

@@ -9,11 +9,12 @@ import pytest
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import (GPT2, OPT, Bloom, Falcon, GPTJ, GPTNeoX,
-                                  Llama, Mistral, Mixtral, Phi, Phi3, Qwen,
-                                  Qwen2, Qwen2MoE, get_model_class)
+                                  InternLM, Llama, Mistral, Mixtral, Phi,
+                                  Phi3, Qwen, Qwen2, Qwen2MoE,
+                                  get_model_class)
 
 FAMILIES = [GPT2, Llama, Mistral, Mixtral, Falcon, OPT, Phi, Phi3, Qwen,
-            Qwen2, Qwen2MoE, Bloom, GPTJ, GPTNeoX]
+            Qwen2, Qwen2MoE, Bloom, GPTJ, GPTNeoX, InternLM]
 
 
 def tiny(cls):
@@ -45,7 +46,7 @@ def test_family_init_loss_decode(cls):
 def test_registry_covers_reference_families():
     for name in ("gpt2", "llama", "mistral", "mixtral", "falcon", "opt",
                  "phi", "phi3", "qwen", "qwen2", "qwen2_moe", "bloom",
-                 "gptj", "gptneox"):
+                 "gptj", "gptneox", "internlm", "bert"):
         assert get_model_class(name) is not None
 
 
