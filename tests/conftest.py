@@ -44,6 +44,7 @@ _FAST = {
     ("test_inference_v2.py", "test_blocked_allocator"),
     ("test_inference_v2.py", "test_state_manager_admission"),
     ("test_linear.py", "test_fp_quantize_validates_group_size_alignment"),
+    ("test_infinity.py", "test_streamed_matches_sharded_fp32"),
 }
 
 
