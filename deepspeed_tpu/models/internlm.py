@@ -1,4 +1,5 @@
-"""InternLM family (reference: module_inject/containers/internlm.py —
+"""InternLM family (reference: module_inject/containers/internlm.py).
+
 Llama architecture; the 7B generation carries biases on ALL attention
 projections (q/k/v AND o_proj, which the reference container loads as
 self_attn.o_proj.bias) while the MLP stays bias-free; InternLM-20B
