@@ -139,6 +139,16 @@ class InferenceEngineV2:
             tokens[i, :n] = seq.tokens[seq.seen:seq.seen + n]
             pos0[i] = seq.seen
             true_len[i] = n
+        # context bucketing (the reference buckets KV lengths the same
+        # way): narrow the block table to the LIVE context's power-of-two
+        # block count, so attention cost scales with actual sequence
+        # lengths instead of max_blocks_per_seq — the paged kernel's
+        # grid and the gather path's page reads both shrink with it.
+        # Bounded recompiles: one executable per (batch, chunk, context)
+        # bucket triple, each dimension log2-many.
+        live_blocks = -(-int((pos0 + true_len).max()) // mgr.block_size)
+        k_blocks = min(_bucket(max(live_blocks, 1)), tables.shape[1])
+        tables = tables[:, :k_blocks]
         # padded rows must not write: true_len 0 drops their scatters.
         # logits come back already gathered at each row's last valid
         # token (logits_gather fused into the compiled step)
