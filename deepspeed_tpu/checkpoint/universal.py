@@ -90,6 +90,12 @@ def ds_to_universal(checkpoint_dir: str, output_dir: str,
     tag = _find_tag(checkpoint_dir, tag)
     state_path = os.path.join(checkpoint_dir, tag, "state")
 
+    streamed_file = os.path.join(checkpoint_dir, tag, "streamed_state.npz")
+    if os.path.exists(streamed_file):
+        # StreamedZeroEngine layout (runtime/infinity.py)
+        return _streamed_engine_to_universal(checkpoint_dir, output_dir,
+                                             tag, streamed_file)
+
     host_file = os.path.join(checkpoint_dir, tag, "host_opt_rank0.npz")
     if not os.path.exists(host_file):
         try:
@@ -223,6 +229,49 @@ def _ds_to_universal_streamed(checkpoint_dir: str, output_dir: str,
     return output_dir
 
 
+def _streamed_engine_to_universal(checkpoint_dir: str, output_dir: str,
+                                  tag: str, npz_path: str) -> str:
+    """Convert a StreamedZeroEngine checkpoint (runtime/infinity.py
+    save_checkpoint — ``master::``/``m::``/``v::`` flat entries for the
+    host-streamed layer matrices plus ``dev_*::`` entries for the
+    device-resident leaves) into the standard per-param fragments, so a
+    model trained 7B-style on ONE chip resumes with full optimizer state
+    on ANY sharded topology (the reference's ds_to_universal promise)."""
+    data = np.load(npz_path)
+    zdir = os.path.join(os.path.abspath(output_dir), ZERO_DIR)
+    names: list[str] = []
+    n_moments: dict[str, int] = {}
+
+    def emit(pname, mst, m, v):
+        pdir = os.path.join(zdir, pname)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(mst, dtype=np.float32))
+        np.save(os.path.join(pdir, "exp_avg.npy"),
+                np.asarray(m, dtype=np.float32))
+        np.save(os.path.join(pdir, "exp_avg_sq.npy"),
+                np.asarray(v, dtype=np.float32))
+        names.append(pname)
+        n_moments[pname] = 2
+
+    for key in data.files:
+        if key.startswith("master::"):
+            name = key[len("master::"):]
+            emit("layers/" + name, data[key], data["m::" + name],
+                 data["v::" + name])
+        elif key.startswith("dev_master::"):
+            name = key[len("dev_master::"):]
+            uname = ("layers/" + name[len("layers_small/"):]
+                     if name.startswith("layers_small/") else name)
+            emit(uname, data[key], data["dev_m::" + name],
+                 data["dev_v::" + name])
+
+    step = int(data["__step__"]) if "__step__" in data.files else 0
+    _write_universal_meta(checkpoint_dir, output_dir, tag, step, names,
+                          n_moments)
+    return output_dir
+
+
 def _iter_param_files(universal_dir: str) -> Iterator[tuple[str, str]]:
     zdir = os.path.join(universal_dir, ZERO_DIR)
     for root, _dirs, files in os.walk(zdir):
@@ -309,6 +358,25 @@ def load_universal_checkpoint(engine, universal_dir: str) -> dict:
             treedef, new_leaves)
 
     step = int(meta.get("step", 0))
+    # optax step counters (ScaleByAdamState.count etc.) are scalar int
+    # leaves the per-param fragments don't carry; resume them at the
+    # checkpoint's step or Adam's bias correction restarts at t=1 and
+    # the first resumed updates diverge from the uninterrupted run
+    def bump_counts(opt):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt)
+        out = []
+        for path, leaf in flat:
+            if (hasattr(leaf, "shape") and leaf.shape == ()
+                    and np.issubdtype(np.asarray(leaf).dtype, np.integer)
+                    and _path_name(path).rsplit("/", 1)[-1] == "count"):
+                leaf = jax.device_put(
+                    np.asarray(step, np.asarray(leaf).dtype),
+                    getattr(leaf, "sharding", None))
+            out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    if engine.state.get("opt_state") not in ((), {}, None):
+        engine.state["opt_state"] = bump_counts(engine.state["opt_state"])
     engine.state["step"] = jax.device_put(
         np.asarray(step, dtype=np.int32),
         engine.state_shardings["step"])
