@@ -60,25 +60,39 @@ def initialize(args=None,
         if auto:
             import jax as _jax
             # auto only when the caller didn't hand us objects the
-            # streamed engine can't take over
+            # streamed engine can't take over (model_parameters ARE
+            # consumable — the streamed engine loads them as the fp32
+            # master instead of re-initializing from config.seed)
             stream = (zc.stage == 3 and zc.offload_param.device == "cpu"
                       and len(_jax.devices()) == 1
-                      and optimizer is None and training_data is None)
+                      and optimizer is None and training_data is None
+                      and mpu is None and mesh_param is None)
         if stream:
             # models larger than HBM on one chip: layer-streamed params
             # + optimizer through pinned_host (ZeRO-Infinity capability;
             # reference stage3.py:1926 + swap_tensor/)
             from .runtime.infinity import StreamedZeroEngine
             try:
-                engine = StreamedZeroEngine(model, config,
-                                            lr_scheduler=lr_scheduler)
+                if mpu is not None or mesh_param is not None:
+                    raise NotImplementedError(
+                        "param streaming is single-chip; mpu/mesh_param "
+                        "need the sharded engine")
+                if optimizer is not None or training_data is not None:
+                    raise NotImplementedError(
+                        "param streaming owns its optimizer/data loop; "
+                        "pass optimizer via config and feed batches to "
+                        "train_batch directly")
+                engine = StreamedZeroEngine(
+                    model, config, lr_scheduler=lr_scheduler,
+                    model_parameters=model_parameters)
                 return engine, None, None, engine.lr_schedule
             except (NotImplementedError, ValueError):
                 if not auto:
                     raise
                 # auto mode: configs the streamed engine doesn't cover
-                # (ga>1, fp16, non-Adam, non-DecoderLM) keep the sharded
-                # whole-tree-fetch path that served them before
+                # (ga>1, fp16, non-Adam, non-DecoderLM, unconsumable
+                # model_parameters) keep the sharded whole-tree-fetch
+                # path that served them before
         engine_cls = DeepSpeedEngine
         if config.hybrid_engine.enabled:
             from .runtime.hybrid_engine import DeepSpeedHybridEngine

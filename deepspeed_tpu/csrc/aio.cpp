@@ -130,6 +130,7 @@ class AioHandle {
                     if (!is_read) std::memcpy(io_buf, p, (size_t)len);
                 }
                 int64_t done = 0;
+                bool cur_direct = direct;
                 while (done < len) {
                     ssize_t r = is_read
                                     ? ::pread(fd, io_buf + done, len - done,
@@ -141,6 +142,21 @@ class AioHandle {
                         break;
                     }
                     done += r;
+                    // a short direct transfer can leave a remainder that
+                    // violates O_DIRECT's offset/length alignment (EOF,
+                    // some filesystems); finish via a buffered fd instead
+                    // of failing the misaligned direct retry with EINVAL
+                    if (cur_direct && done < len &&
+                        (done % kDirectAlign) != 0) {
+                        direct_fallbacks_.fetch_add(1);
+                        ::close(fd);
+                        fd = ::open(path.c_str(), flags, 0644);
+                        if (fd < 0) {
+                            errors_.fetch_add(1);
+                            return;
+                        }
+                        cur_direct = false;
+                    }
                 }
                 if (direct && is_read && done == len) {
                     std::memcpy(p, io_buf, (size_t)len);

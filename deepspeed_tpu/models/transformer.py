@@ -217,6 +217,13 @@ class DecoderLM:
                 "sliding_window is set but a custom attn_fn (e.g. the "
                 "sequence-parallel wrapper) is in use; the window mask is "
                 "NOT applied by the wrapper — attention is full-causal")
+        if attn_fn is not None and c.position_embedding == "alibi":
+            from ..utils.logging import warning_once
+            warning_once(
+                "position_embedding='alibi' but a custom attn_fn (e.g. the "
+                "sequence-parallel wrapper) is in use; the ALiBi bias is "
+                "NOT applied by the wrapper — the model runs with no "
+                "positional encoding")
         if attn_fn is None:
             if c.position_embedding == "alibi":
                 # ALiBi rides the exact path as a per-head additive bias

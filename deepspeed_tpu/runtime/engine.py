@@ -346,11 +346,20 @@ class DeepSpeedEngine:
                      for i, l in enumerate(leaves)]
             budget = ratio * sum(sz for sz, _ in sizes)
             chosen, acc = set(), 0
+            # largest-first, skipping any leaf that would overshoot: the
+            # configured ratio is an upper BOUND on host-resident bytes
+            # (a dominant leaf no longer drags everything to host)
             for sz, i in sorted(sizes, key=lambda t: (-t[0], t[1])):
-                if acc >= budget:
-                    break
+                if acc + sz > budget:
+                    continue
                 chosen.add(i)
                 acc += sz
+            if not chosen:
+                from ..utils.logging import logger
+                logger.warning(
+                    f"offload ratio={ratio} selected no leaves (every "
+                    "leaf exceeds the byte budget); optimizer state "
+                    "stays in device memory")
             flat, treedef = jax.tree.flatten(shardings, is_leaf=is_sh)
             assert len(flat) == len(leaves), "sharding/abstract mismatch"
             return jax.tree.unflatten(

@@ -73,7 +73,7 @@ class StreamedZeroEngine:
     """
 
     def __init__(self, module, config: DeepSpeedConfig,
-                 lr_scheduler=None):
+                 lr_scheduler=None, model_parameters=None):
         if not _is_streamable_module(module):
             raise ValueError(
                 "param streaming needs a DecoderLM-style module "
@@ -81,6 +81,7 @@ class StreamedZeroEngine:
         self.module = module
         self.config = config
         self.model_config = module.config
+        self._init_params = model_parameters
 
         tb, mb, ga = config.resolve_batch_sizes(1)
         if ga > 1:
@@ -192,7 +193,42 @@ class StreamedZeroEngine:
 
         fp32_bytes = sum(int(np.prod(l.shape)) * 4
                          for _, l in flatten_with_names(abstract))
-        if fp32_bytes < 6 * 2 ** 30:
+        if self._init_params is not None:
+            # pretrained / resume weights become the fp32 master directly
+            # instead of re-initializing from config.seed (reference
+            # semantics: deepspeed.initialize(model_parameters=...) trains
+            # the GIVEN weights; ADVICE r3 high finding)
+            given = self._init_params
+            try:
+                g_abs = jax.eval_shape(lambda t: t, given)
+                ok = (jax.tree.structure(g_abs)
+                      == jax.tree.structure(abstract)
+                      and all(a.shape == b.shape for a, b in zip(
+                          jax.tree.leaves(g_abs),
+                          jax.tree.leaves(abstract))))
+            except (TypeError, ValueError):
+                ok = False  # not an abstractifiable pytree of arrays
+            if not ok:
+                raise ValueError(
+                    "model_parameters does not match module.init's tree "
+                    "structure/shapes; param streaming cannot consume it")
+
+            def put32(x, sh):
+                if isinstance(x, jax.Array):
+                    return jax.device_put(x.astype(jnp.float32), sh)
+                return jax.device_put(np.asarray(x, np.float32), sh)
+
+            big_in, small_in = split_flat(given["layers"])
+            big = {n: put32(l, self._host_sh) for n, l in big_in.items()}
+            small = {n: put32(l, self._dev_sh)
+                     for n, l in small_in.items()}
+            dev_rest = {k: jax.tree.map(lambda x: put32(x, self._dev_sh), v)
+                        for k, v in given.items() if k != "layers"}
+            # release the engine's references to the input tree (the
+            # caller should del theirs too — at Infinity scale two
+            # resident copies of the weights exhaust host RAM)
+            self._init_params = given = big_in = small_in = None
+        elif fp32_bytes < 6 * 2 ** 30:
             # small model: one init jit, big leaves straight to host
             out_sh = jax.tree.map(lambda _: self._dev_sh, abstract)
             sh_flat = dict(flatten_with_names(out_sh["layers"]))
@@ -557,8 +593,9 @@ class StreamedZeroEngine:
     # ------------------------------------------------------------------
     # checkpointing: host state pulls through the client process — fine
     # on a real pod host, slow through a remote tunnel (documented)
-    def save_checkpoint(self, save_dir, tag=None, **_kw):
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, **_kw):
         import os
+        import pickle
         from ..checkpoint.universal import flatten_with_names
         tag = tag or f"global_step{self.step_count}"
         path = os.path.join(save_dir, tag)
@@ -572,13 +609,28 @@ class StreamedZeroEngine:
             for name, leaf in flatten_with_names(tree):
                 arrays[f"{prefix}::{name}"] = np.asarray(leaf)
         arrays["__step__"] = np.asarray(self.step_count)
+        # full progress counters, not just the optimizer step — a resumed
+        # run reports the same global_steps/samples it left off with
+        # (reference engine.save_checkpoint state dict parity)
+        arrays["__progress__"] = np.asarray(
+            [self.global_steps, self.global_samples, self.skipped_steps])
+        arrays["__client_state__"] = np.frombuffer(
+            pickle.dumps(client_state or {}), dtype=np.uint8)
         np.savez(os.path.join(path, "streamed_state.npz"), **arrays)
         with open(os.path.join(save_dir, "latest"), "w") as f:
             f.write(tag)
         return True
 
-    def load_checkpoint(self, load_dir, tag=None, **_kw):
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True,
+                        load_module_only=False, **_kw):
+        """Restore streamed state. ``load_optimizer_states=False`` (or
+        ``load_module_only=True``) restores weights but keeps zero
+        moments / step 0 — the reference's weights-only reload. Other
+        reference kwargs (load_lr_scheduler_states, custom loaders) have
+        no referent here: the schedule is a pure function of step_count."""
         import os
+        import pickle
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()
@@ -596,13 +648,34 @@ class StreamedZeroEngine:
 
         self.master_layers = restore("master", self.master_layers,
                                      self._host_sh)
-        self.m_layers = restore("m", self.m_layers, self._host_sh)
-        self.v_layers = restore("v", self.v_layers, self._host_sh)
         self.dev_master = restore("dev_master", self.dev_master,
                                   self._dev_sh)
-        self.dev_m = restore("dev_m", self.dev_m, self._dev_sh)
-        self.dev_v = restore("dev_v", self.dev_v, self._dev_sh)
+        opt = load_optimizer_states and not load_module_only
+        if opt:
+            self.m_layers = restore("m", self.m_layers, self._host_sh)
+            self.v_layers = restore("v", self.v_layers, self._host_sh)
+            self.dev_m = restore("dev_m", self.dev_m, self._dev_sh)
+            self.dev_v = restore("dev_v", self.dev_v, self._dev_sh)
+        else:
+            # weights-only reload must also RESET moments: step_count
+            # goes to 0, and t=1 bias correction against stale trained
+            # moments would wildly overscale the first update
+            def zeros(tree, sh):
+                return jax.tree.map(
+                    lambda x: jax.device_put(
+                        jnp.zeros(x.shape, x.dtype), sh), tree)
+            self.m_layers = zeros(self.m_layers, self._host_sh)
+            self.v_layers = zeros(self.v_layers, self._host_sh)
+            self.dev_m = zeros(self.dev_m, self._dev_sh)
+            self.dev_v = zeros(self.dev_v, self._dev_sh)
         self.dev_params = jax.tree.map(
             lambda x: x.astype(self.compute_dtype), self.dev_master)
-        self.step_count = int(data["__step__"])
-        return load_dir, {}
+        self.step_count = int(data["__step__"]) if opt else 0
+        if "__progress__" in data and opt:
+            gs, gsa, sk = (int(x) for x in data["__progress__"])
+            self.global_steps, self.global_samples = gs, gsa
+            self.skipped_steps = sk
+        client_state = {}
+        if "__client_state__" in data:
+            client_state = pickle.loads(bytes(data["__client_state__"]))
+        return load_dir, client_state

@@ -179,6 +179,67 @@ def test_stream_auto_dispatch_requires_single_chip(devices8):
     assert isinstance(eng, DeepSpeedEngine)
 
 
+def test_streamed_consumes_model_parameters(devices8):
+    """Explicit stream=True with model_parameters trains the GIVEN
+    weights, not a fresh seed init (ADVICE r3 high: auto-dispatch used
+    to silently discard them)."""
+    from deepspeed_tpu.runtime.infinity import StreamedZeroEngine
+    batch = _batch(7)
+    donor, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                   config=_stream_cfg())
+    donor.train_batch(batch)
+    weights = jax.tree.map(np.asarray, donor.params)
+    cfg = _stream_cfg()
+    cfg["seed"] = 1234  # different init seed: must NOT matter
+    eng, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                 model_parameters=weights, config=cfg)
+    assert isinstance(eng, StreamedZeroEngine)
+    np.testing.assert_allclose(float(eng.eval_batch(batch)),
+                               float(donor.eval_batch(batch)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_streamed_explicit_rejects_unconsumable_objects(devices8):
+    """Explicit stream=True must REFUSE (not silently drop) caller
+    objects the streamed engine cannot take over (ADVICE r3 high)."""
+    with pytest.raises(NotImplementedError, match="single-chip"):
+        ds.initialize(model=Llama(size="tiny"), mpu=object(),
+                      config=_stream_cfg())
+    with pytest.raises(NotImplementedError, match="optimizer"):
+        ds.initialize(model=Llama(size="tiny"), optimizer=object(),
+                      config=_stream_cfg())
+    with pytest.raises(ValueError, match="model_parameters"):
+        ds.initialize(model=Llama(size="tiny"),
+                      model_parameters={"bogus": np.zeros(3)},
+                      config=_stream_cfg())
+
+
+def test_streamed_checkpoint_progress_counters(tmp_path, devices8):
+    """global_steps/global_samples/skipped_steps and client_state survive
+    the round trip (ADVICE r3: only step_count used to)."""
+    batch = _batch(8)
+    e1, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                config=_stream_cfg())
+    for _ in range(3):
+        e1.train_batch(batch)
+    e1.save_checkpoint(str(tmp_path), client_state={"epoch": 7})
+    e2, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                config=_stream_cfg())
+    _, client = e2.load_checkpoint(str(tmp_path))
+    assert client == {"epoch": 7}
+    assert e2.global_steps == 3 and e2.global_samples == 24
+    # weights-only reload: moments zero, step 0
+    e3, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                config=_stream_cfg())
+    e3.train_batch(batch)  # dirty the moments first: reload must RESET
+    e3.load_checkpoint(str(tmp_path), load_optimizer_states=False)
+    assert e3.step_count == 0
+    assert not np.any(np.asarray(e3.m_layers[e3._stream_names[0]]))
+    np.testing.assert_allclose(
+        np.asarray(e3.master_layers[e3._stream_names[0]]),
+        np.asarray(e1.master_layers[e1._stream_names[0]]))
+
+
 def test_streamed_moe_model(devices8):
     """MoE stacks ([L, E, ...] expert leaves) stream like dense ones and
     the router aux loss flows through the manual backward."""

@@ -50,9 +50,11 @@ def test_twin_flow_partial_offload_ratio(devices8):
              for l in jax.tree.leaves(off.state["opt_state"])
              if hasattr(l, "sharding")}
     assert "pinned_host" in kinds and len(kinds) > 1, kinds
-    # requested host fraction lands in [0.5, 0.5 + largest-leaf slack];
-    # the report reads the REQUESTED shardings only before a fallback, so
-    # measure from state_shardings (CPU emulation falls back on compute)
+    # ratio is an upper BOUND on host-resident bytes (ADVICE r3: leaves
+    # that would overshoot the budget are skipped, so a dominant leaf
+    # can no longer drag everything to host); the report reads the
+    # REQUESTED shardings only before a fallback, so measure from
+    # state_shardings (CPU emulation falls back on compute)
     from jax.sharding import NamedSharding
     total = host = 0
     for sh, leaf in zip(
@@ -63,7 +65,7 @@ def test_twin_flow_partial_offload_ratio(devices8):
         total += b
         if getattr(sh, "memory_kind", None) == "pinned_host":
             host += b
-    assert 0.5 <= host / total < 0.95, host / total
+    assert 0.0 < host / total <= 0.5, host / total
     l_ref = run_steps(ref, n=3)
     l_off = run_steps(off, n=3)
     np.testing.assert_allclose(l_off, l_ref, rtol=1e-4, atol=1e-4)
