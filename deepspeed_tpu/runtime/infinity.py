@@ -32,8 +32,12 @@ through the client process (which may be far from the chip).
 Scope (documented limits, enforced at dispatch in ``initialize``):
 single-replica (one chip per model instance — the multi-chip paths use
 the sharded engine), decoder models built on models/transformer.py
-DecoderLM, gradient_accumulation_steps == 1, bf16 or fp32 compute (fp16
-loss-scaling is a sharded-engine feature), Adam/AdamW.
+DecoderLM, bf16 or fp32 compute (fp16 loss-scaling is a sharded-engine
+feature), Adam/AdamW. Gradient accumulation runs the backward scan per
+micro-batch with an in-scan add into a donated pinned_host grad stack,
+so the master+moments stream — the dominant PCIe traffic — is paid once
+per optimizer step, not once per micro-batch (grads accumulate in the
+compute dtype, mirroring the reference's fp16 grad buffers).
 
 On non-TPU backends the memory-kind annotations are skipped (single
 memory space) but the identical streaming program runs, so CPU tests
@@ -84,18 +88,18 @@ class StreamedZeroEngine:
         self._init_params = model_parameters
 
         tb, mb, ga = config.resolve_batch_sizes(1)
-        if ga > 1:
-            raise NotImplementedError(
-                "param streaming supports gradient_accumulation_steps=1 "
-                "(accumulating a host-resident grad stack would double "
-                "the PCIe traffic per micro-batch)")
         if config.fp16.enabled:
             raise NotImplementedError(
                 "param streaming supports bf16/fp32; fp16 loss scaling "
                 "is a sharded-engine feature")
         self.train_batch_size_ = tb
         self.micro_batch_size_ = mb
-        self.gradient_accumulation_steps_ = 1
+        # ga>1 accumulates per-layer grads into a donated pinned_host
+        # stack inside the backward scan (one extra H2D read of the grad
+        # stack per micro-batch) while the master+moments stream — the
+        # dominant PCIe traffic — runs ONCE per step (reference GAS
+        # semantics: runtime/engine.py:2007)
+        self.gradient_accumulation_steps_ = ga
         self.compute_dtype = (jnp.bfloat16 if config.bf16.enabled
                               else jnp.float32)
         self._mixed = config.bf16.enabled
@@ -136,6 +140,7 @@ class StreamedZeroEngine:
 
         self._init_state()
         self._phase_a = None
+        self._phase_a_acc = None
         self._phase_b = None
         self._eval_jit = None
         self.global_steps = 0
@@ -324,15 +329,26 @@ class StreamedZeroEngine:
     def _to_host(self, t):
         return jax.device_put(t, self._host_sh)
 
-    def _build_phase_a(self):
+    def _build_phase_a(self, accumulate: bool = False):
         """grads: streamed fwd scan + manual reverse vjp scan.
 
         Returns (loss, grads_layers[host, compute-dtype], dev_grads[f32],
-        grad_sq, finite).
+        grad_norm, finite). Gradients are seeded with 1/ga so the
+        accumulated stacks hold the MEAN-loss gradient after the last
+        micro-batch (reference GAS scales by 1/gas before the step).
+
+        ``accumulate=True`` builds the micro-batch 1..ga-1 variant: the
+        backward scan fetches the previous micro-batches' grad slice
+        from pinned_host, adds this micro-batch's contribution, and
+        writes the sum back — the host grad stacks are DONATED so the
+        accumulator aliases in place; grad-norm/finite are computed over
+        the accumulated values (so the last call's norm is the step's
+        true mean-grad norm, and an earlier micro's NaN propagates).
         """
         module = self.module
         cdt = self.compute_dtype
         aux_coef = module.aux_loss_coef()
+        inv_ga = 1.0 / self.gradient_accumulation_steps_
 
         def fetch(lh):
             # one layer's fp32 master slice -> HBM -> compute dtype
@@ -352,7 +368,7 @@ class StreamedZeroEngine:
         split = self._split_flat
         assemble = self._assemble_layer
 
-        def phase_a(master_layers, dev_params, batch):
+        def phase_a(master_layers, dev_params, batch, *acc_args):
             tokens, targets = _unpack_batch(batch)
             small_stack = dev_params["layers_small"]
 
@@ -375,22 +391,36 @@ class StreamedZeroEngine:
                 functools.partial(head_loss, targets=targets),
                 dev_params, xL)
             loss = ce + aux_coef * aux
-            d_head_dev, dxL = head_vjp(jnp.ones((), ce.dtype))
+            d_head_dev, dxL = head_vjp(jnp.asarray(inv_ga, ce.dtype))
+
+            if accumulate:
+                grads_acc, dev_acc = acc_args
+                bxs = (master_layers, small_stack, acts, grads_acc)
+            else:
+                bxs = (master_layers, small_stack, acts)
 
             def bbody(carry, xs):
                 g, sq, finite = carry
-                lh, small, x_in = xs
+                if accumulate:
+                    lh, small, x_in, gacc = xs
+                else:
+                    (lh, small, x_in), gacc = xs, None
 
                 def layer(lp, x):
                     return module.block(lp, x)
 
                 lp = assemble(fetch(lh), small)
                 _, vjp = jax.vjp(layer, lp, x_in)
-                dlp, dx = vjp((g, jnp.asarray(aux_coef, jnp.float32)))
-                for t in jax.tree.leaves(dlp):
+                dlp, dx = vjp((g, jnp.asarray(aux_coef * inv_ga,
+                                              jnp.float32)))
+                dbig, dsmall = split(dlp)
+                if accumulate:
+                    dbig = jax.tree.map(
+                        lambda a, b: self._to_dev(a) + b.astype(a.dtype),
+                        gacc, dbig)
+                for t in jax.tree.leaves(dbig):
                     sq += jnp.sum(jnp.square(t.astype(jnp.float32)))
                     finite &= jnp.isfinite(t).all()
-                dbig, dsmall = split(dlp)
                 dsmall = jax.tree.map(
                     lambda t: t.astype(jnp.float32), dsmall)
                 return (dx, sq, finite), (
@@ -399,22 +429,24 @@ class StreamedZeroEngine:
             (dx0, sq, finite), (dlayers, dsmall_stack) = jax.lax.scan(
                 bbody,
                 (dxL, jnp.zeros((), jnp.float32), jnp.array(True)),
-                (master_layers, small_stack, acts), reverse=True)
+                bxs, reverse=True)
 
             (d_embed_dev,) = embed_vjp(dx0)
             dev_grads = jax.tree.map(
                 lambda a, b: (a.astype(jnp.float32)
                               + b.astype(jnp.float32)),
                 d_head_dev, d_embed_dev)
-            for t in jax.tree.leaves(
-                    {k: v for k, v in dev_grads.items()
-                     if k != "layers_small"}):
-                sq += jnp.sum(jnp.square(t))
-                finite &= jnp.isfinite(t).all()
-            # per-layer small grads were already counted in the scan;
-            # embed/head contribute zeros for them
+            # embed/head contribute zeros for layers_small, so this add
+            # installs the per-layer small-grad stacks
             dev_grads["layers_small"] = jax.tree.map(
                 jnp.add, dev_grads["layers_small"], dsmall_stack)
+            if accumulate:
+                dev_grads = jax.tree.map(jnp.add, dev_grads, dev_acc)
+            # norm/finite over the (accumulated) device-resident grads,
+            # including the small per-layer stacks
+            for t in jax.tree.leaves(dev_grads):
+                sq += jnp.sum(jnp.square(t))
+                finite &= jnp.isfinite(t).all()
             return loss, dlayers, dev_grads, jnp.sqrt(sq), finite
 
         host = self._host_sh
@@ -424,7 +456,8 @@ class StreamedZeroEngine:
         grads_sh = jax.tree.map(lambda _: host, abstract)
         return jax.jit(
             phase_a,
-            out_shardings=(dev, grads_sh, None, dev, dev))
+            out_shardings=(dev, grads_sh, None, dev, dev),
+            donate_argnums=(3, 4) if accumulate else ())
 
     def _build_phase_b(self):
         """Streamed Adam: scan (g, master, m, v) per layer through HBM;
@@ -485,25 +518,60 @@ class StreamedZeroEngine:
         host, dev = self._host_sh, self._dev_sh
         habs = jax.eval_shape(lambda t: t, self.master_layers)
         hsh = jax.tree.map(lambda _: host, habs)
+        # grads_layers (arg 3) is deliberately NOT donated: it has no
+        # same-shaped output to alias with (the r3 bench's "donated
+        # buffers were not usable" warning was exactly these stacks);
+        # train_batch deletes it right after the call instead
         return jax.jit(
             phase_b,
             out_shardings=(hsh, hsh, hsh, None, None, None, None),
-            donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+            donate_argnums=(0, 1, 2, 4, 5, 6))
 
     # ------------------------------------------------------------------
     def train_batch(self, batch=None, data_iter=None):
-        if batch is None:
-            if data_iter is None:
-                raise ValueError("train_batch needs a batch or data_iter")
-            batch = next(data_iter)
+        ga = self.gradient_accumulation_steps_
         if self._phase_a is None:
             self._phase_a = self._build_phase_a()
             self._phase_b = self._build_phase_b()
-        batch = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._dev_sh), batch)
+            self._phase_a_acc = (self._build_phase_a(accumulate=True)
+                                 if ga > 1 else None)
+        # assemble the step's micro-batches: a full train batch splits
+        # along the leading axis; a data_iter yields one micro-batch per
+        # draw (reference train_batch pulls gas micro-batches)
+        if batch is None:
+            if data_iter is None:
+                raise ValueError("train_batch needs a batch or data_iter")
+            micros = [next(data_iter) for _ in range(ga)]
+        elif ga == 1:
+            micros = [batch]
+        else:
+            mb = self.micro_batch_size_
+            n = np.shape(jax.tree.leaves(batch)[0])[0]
+            if n != self.train_batch_size_:
+                raise ValueError(
+                    f"train_batch got {n} samples; expected "
+                    f"train_batch_size={self.train_batch_size_} "
+                    f"(= {mb} micro x {ga} accumulation)")
+            micros = [jax.tree.map(lambda x: x[i * mb:(i + 1) * mb],
+                                   batch) for i in range(ga)]
         t0 = time.perf_counter()
-        loss, grads_layers, dev_grads, norm, finite = self._phase_a(
-            self.master_layers, self.dev_params, batch)
+        losses = []
+        grads_layers = dev_grads = None
+        for i, micro in enumerate(micros):
+            micro = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x), self._dev_sh),
+                micro)
+            if i == 0:
+                loss, grads_layers, dev_grads, norm, finite = \
+                    self._phase_a(self.master_layers, self.dev_params,
+                                  micro)
+            else:
+                loss, grads_layers, dev_grads, norm, finite = \
+                    self._phase_a_acc(self.master_layers,
+                                      self.dev_params, micro,
+                                      grads_layers, dev_grads)
+            losses.append(loss)
+        loss = losses[0] if ga == 1 else jnp.mean(jnp.stack(losses))
         metrics = {"loss": loss, "grad_norm": norm,
                    "loss_scale": jnp.ones(()), "overflow": ~finite}
         if bool(finite):

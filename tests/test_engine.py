@@ -106,6 +106,26 @@ def test_forward_backward_step_compat(devices8):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_no_sync_triple_matches_train_batch(devices8):
+    """no_sync() is an API-parity no-op (engine.no_sync docstring): the
+    eager triple under it must still reproduce train_batch numerics —
+    the reference's comm deferral changes scheduling, never results."""
+    cfg = base_config(zero_optimization={"stage": 1})
+    e1, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+    e2, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+    batch = make_batch(jax.random.PRNGKey(0))
+    e1.train_batch(batch)
+    with e2.no_sync():
+        for i in range(2):
+            micro = jax.tree.map(lambda x: x[i * 8:(i + 1) * 8], batch)
+            e2.backward(e2.forward(micro))
+    e2.step()
+    np.testing.assert_allclose(
+        np.asarray(e1.state["params"]["embed"]["tokens"]),
+        np.asarray(e2.state["params"]["embed"]["tokens"]),
+        rtol=2e-5, atol=5e-5)
+
+
 def test_scheduler_and_clipping(devices8):
     engine, _, _, sched = ds.initialize(
         model=GPT2(size="tiny"),
