@@ -542,6 +542,14 @@ class StreamedZeroEngine:
             if data_iter is None:
                 raise ValueError("train_batch needs a batch or data_iter")
             micros = [next(data_iter) for _ in range(ga)]
+            for m in micros:
+                n = np.shape(jax.tree.leaves(m)[0])[0]
+                if n != self.micro_batch_size_:
+                    raise ValueError(
+                        f"data_iter yielded a {n}-row batch; the streamed "
+                        f"engine draws {ga} MICRO-batches of "
+                        f"{self.micro_batch_size_} rows per step "
+                        "(pass batch= for a full train batch instead)")
         elif ga == 1:
             micros = [batch]
         else:

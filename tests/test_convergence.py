@@ -1,0 +1,106 @@
+"""Convergence-grade training tests (VERDICT r3 missing #5; reference:
+tests/unit/modeling.py vendored-BERT convergence suites, tests/model/).
+
+Step-agreement tests catch step-level math errors but not slow
+corruption (drifting optimizer state, loss-scale decay, master/compute
+divergence) that only shows up over hundreds of steps. Here a tiny
+2-layer GPT-2 trains ~300 steps on a DETERMINISTIC induction-head corpus
+— each sequence's second half repeats its first half, so the only way
+below the random-half entropy floor is a working induction circuit
+(attention + optimizer + precision machinery all healthy end-to-end) —
+and the final loss must fall below a fixed threshold for every precision
+/ sharding / streaming configuration.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2
+
+VOCAB = 64
+SEQ = 32          # 16 random tokens + 16-token copy
+HALF = SEQ // 2
+N_SEQS = 256
+BATCH = 16
+STEPS = 300
+
+# targets 1..HALF-1 are random (irreducible ~log V each); targets
+# HALF-1.. are copies of positions 0.. (predictable once the induction
+# circuit forms). Floor = (HALF-1)/(SEQ-1) * log V ~= 2.01; an untrained
+# model sits at log V ~= 4.16. 2.55 demands most of the learnable margin.
+LOSS_TARGET = 2.55
+
+
+def _corpus():
+    rng = np.random.default_rng(1234)            # deterministic corpus
+    first = rng.integers(0, VOCAB, size=(N_SEQS, HALF))
+    toks = np.concatenate([first, first], axis=1).astype(np.int32)
+    return toks
+
+
+def _run(config, steps=STEPS, model=None):
+    toks = _corpus()
+    engine, _, _, _ = ds.initialize(
+        model=model or GPT2(size="tiny", vocab_size=VOCAB,
+                            max_seq_len=SEQ),
+        config=config)
+    losses = []
+    for i in range(steps):
+        rows = np.arange(i * BATCH, (i + 1) * BATCH) % N_SEQS
+        batch = toks[rows]
+        losses.append(float(engine.train_batch(
+            (batch[:, :-1], batch[:, 1:]))))
+    return losses
+
+
+def _base(**over):
+    cfg = {
+        "train_batch_size": BATCH,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 3e-3, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,
+        **over,
+    }
+    return cfg
+
+
+def _assert_converged(losses):
+    assert np.isfinite(losses).all(), losses[-5:]
+    assert losses[-1] < LOSS_TARGET, (losses[0], losses[-1])
+    # and it must have actually learned, not started low
+    assert losses[0] > 3.5, losses[0]
+
+
+def test_convergence_fp32_zero2(devices8):
+    _assert_converged(_run(_base(zero_optimization={"stage": 2})))
+
+
+def test_convergence_bf16(devices8):
+    _assert_converged(_run(_base(bf16={"enabled": True},
+                                 zero_optimization={"stage": 2})))
+
+
+def test_convergence_fp16_dynamic_scale(devices8):
+    """Dynamic loss scaling over hundreds of steps: the scale must grow
+    and never corrupt the trajectory (reference fp16/loss_scaler.py)."""
+    losses = _run(_base(fp16={"enabled": True, "initial_scale_power": 12,
+                              "loss_scale_window": 50}))
+    _assert_converged(losses)
+
+
+def test_convergence_streamed(devices8):
+    """The streamed ZeRO-Infinity engine's hand-rolled reverse-scan
+    backward + host-resident Adam must hold a full trajectory, with
+    gradient accumulation in the loop (runtime/infinity.py)."""
+    losses = _run(_base(
+        train_micro_batch_size_per_gpu=BATCH // 2,
+        bf16={"enabled": True},
+        zero_optimization={
+            "stage": 3,
+            "offload_param": {"device": "cpu", "stream": True},
+            "offload_optimizer": {"device": "cpu"}},
+    ), model=GPT2(size="tiny", vocab_size=VOCAB, max_seq_len=SEQ,
+                  tie_embeddings=False))
+    _assert_converged(losses)
