@@ -47,6 +47,12 @@ def initialize(args=None,
     config = config if config is not None else config_params
     from .runtime.config import DeepSpeedConfig as _Cfg
     config = _Cfg.from_any(config)  # parsed once; constructors accept it
+    if hasattr(model, "moe_serving_dispatch"):
+        # a model previously passed through init_inference(
+        # moe_grouped_dispatch=True) carries the serving dispatch flag;
+        # training must use the capacity einsum (drops are a training
+        # regularizer, and ep sharding needs the all-to-all form)
+        model.moe_serving_dispatch = False
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine
         engine = PipelineEngine(

@@ -35,6 +35,17 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     triangular_masking: bool = True
     return_tuple: bool = True
     seed: int = 0
+    # weight-only int8 for routed MoE expert weights (reference:
+    # inference/v2 cutlass mixed_gemm + ZeRO-Inference weight quant).
+    # Decode MoE is expert-weight-READ bound; int8 halves those bytes
+    # and XLA fuses the dequant into the expert GEMM (moe/sharded_moe.py
+    # quantize_experts). Single-replica serving only (tp=1).
+    quantize_moe_experts: bool = False
+    # opt-in sort-by-expert grouped-GEMM decode dispatch
+    # (moe_ffn_grouped). Measured SLOWER than the einsum dispatch on
+    # v5e decode shapes (ragged_dot lowering); kept for parity with the
+    # reference's moe_gemm path and for future lowering improvements.
+    moe_grouped_dispatch: bool = False
 
     @classmethod
     def from_any(cls, config=None, **kwargs) -> "DeepSpeedInferenceConfig":

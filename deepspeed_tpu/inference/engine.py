@@ -67,6 +67,20 @@ class InferenceEngine:
 
         params = jax.tree.map(cast, params)
 
+        if config.quantize_moe_experts:
+            if tp > 1:
+                raise NotImplementedError(
+                    "quantize_moe_experts is a single-replica serving "
+                    "path (quantized expert leaves bypass the tp rule "
+                    "tables); shard OR quantize, not both")
+            lay = params.get("layers") if isinstance(params, dict) else None
+            if isinstance(lay, dict) and isinstance(lay.get("experts"),
+                                                    dict) \
+                    and "w_up" in lay["experts"]:
+                from ..moe.sharded_moe import quantize_experts
+                lay["experts"] = quantize_experts(lay["experts"],
+                                                  self.dtype)
+
         # shard with model rules / AutoTP inference
         rules = get_tp_rules(model, params)
         specs = filter_spec_for_mesh(match_rules(rules, params), self.mesh,
@@ -75,6 +89,25 @@ class InferenceEngine:
         self.params = jax.device_put(params, self.param_shardings)
 
         self.model_config: ModelConfig | None = getattr(model, "config", None)
+        # MoE grouped serving dispatch (sort-by-expert + ragged_dot,
+        # moe/sharded_moe.py moe_ffn_grouped; reference: inference/v2
+        # moe_gemm + moe_gather/moe_scatter) is OPT-IN: measured on v5e
+        # decode (340M-class, batch 16/64) ragged_dot's TPU lowering is
+        # SLOWER than the capacity-einsum dispatch (2558 vs 3736 tok/s),
+        # because decode MoE is expert-weight-read bound and the einsum
+        # already sits at that floor — use quantize_moe_experts to cut
+        # the floor itself. Opting in MUTATES the model instance
+        # (Mixtral.moe_serving_dispatch); training engines reset it.
+        if hasattr(model, "moe_serving_dispatch"):
+            if config.moe_grouped_dispatch and tp > 1:
+                raise NotImplementedError(
+                    "moe_grouped_dispatch is a single-replica serving "
+                    "path (ragged_dot bypasses the ep/tp all-to-all "
+                    "dispatch); shard OR group, not both")
+            # assigned unconditionally from config so engines never
+            # inherit another engine's dispatch mode through the shared
+            # model instance
+            model.moe_serving_dispatch = bool(config.moe_grouped_dispatch)
         self._forward = jax.jit(
             lambda p, tokens: self.module.apply(p, tokens))
         self._generate_fns: dict[tuple, Any] = {}

@@ -37,6 +37,18 @@ def _bucket(n: int, lo: int = 1) -> int:
     return b
 
 
+def _batch_bucket(n: int) -> int:
+    """Decode-batch bucket: powers of two up to 8, then multiples of 8.
+
+    Power-of-two-only batch buckets waste up to ~2x on everything
+    (weights reads excepted) — e.g. 24 live sequences padded to 32 rows
+    cost +33% per tick. Sublane granularity on TPU is 8, so multiples
+    of 8 bucket tightly with a bounded executable count (r4 serving
+    profiling: this alone closed most of the v2-vs-v1 decode gap at
+    moderate batch)."""
+    return _bucket(n) if n <= 8 else -(-n // 8) * 8
+
+
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
     (state_manager block/pool sizing knobs)."""
@@ -126,7 +138,7 @@ class InferenceEngineV2:
         seqs = [mgr.seqs[u] for u in uids]
         max_pending = max(s.pending for s in seqs)
         s_bucket = _bucket(min(max_pending, self._chunk))
-        b_bucket = _bucket(len(seqs))
+        b_bucket = _batch_bucket(len(seqs))
 
         tokens = np.zeros((b_bucket, s_bucket), np.int32)
         pos0 = np.zeros((b_bucket,), np.int32)

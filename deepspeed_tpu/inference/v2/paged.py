@@ -99,41 +99,51 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
 
         def fold(k_ref_, v_ref_, base, limit):
             """Accumulate one kv block whose rows sit at absolute
-            positions base+[0, blk); positions >= limit are dead."""
+            positions base+[0, blk); positions >= limit are dead.
+
+            The position mask is head-independent and computed ONCE;
+            the running-softmax bookkeeping (max/exp/corr/l) operates
+            on the head-stacked [hq*sq, blk] score matrix in one pass —
+            only the two MXU contractions stay per-head (their operands
+            genuinely differ per head). This cut the per-grid-step VPU
+            op count ~6x vs a fully per-head loop (r4 decode-tick
+            profiling)."""
+            shape2 = (sq, k_ref_.shape[1])
+            qpos = p0 + jax.lax.broadcasted_iota(jnp.int32, shape2, 0)
+            kpos = base + jax.lax.broadcasted_iota(jnp.int32, shape2, 1)
+            live = (kpos <= qpos) & (kpos < limit) \
+                & (jax.lax.broadcasted_iota(jnp.int32, shape2, 0) < tl)
+            if window is not None:
+                live &= qpos - kpos < window
+            neg = jnp.where(live, 0.0, -1e30)            # [sq, blk]
+            rel = ((kpos - qpos).astype(jnp.float32)
+                   if slopes is not None else None)
+            parts = []
             for h in range(hq):
                 qv = q_ref[0, :, h, :]                      # [sq, d]
                 kblk = k_ref_[0, :, h // rep, :]            # [blk, d]
-                vblk = v_ref_[0, :, h // rep, :]
                 s = jnp.dot(qv, kblk.T,
                             preferred_element_type=jnp.float32) * sc
-                qpos = p0 + jax.lax.broadcasted_iota(jnp.int32, s.shape,
-                                                     0)
-                kpos = base + jax.lax.broadcasted_iota(jnp.int32,
-                                                       s.shape, 1)
-                live = (kpos <= qpos) & (kpos < limit) \
-                    & (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                       < tl)
-                if window is not None:
-                    live &= qpos - kpos < window
                 if slopes is not None:
-                    s = s + float(slopes[h]) * (
-                        kpos - qpos).astype(jnp.float32)
-                s = jnp.where(live, s, -1e30)
-                rows = pl.ds(h * sq, sq)
-                m_prev = m_s[rows, :1]
-                l_prev = l_s[rows, :1]
-                m_new = jnp.maximum(
-                    m_prev, jnp.max(s, axis=-1, keepdims=True))
-                p = jnp.exp(s - m_new)
-                corr = jnp.exp(m_prev - m_new)
-                l_s[rows, :1] = l_prev * corr + jnp.sum(
-                    p, axis=-1, keepdims=True)
-                o_ref[0, :, h, :] = (o_ref[0, :, h, :] * corr
-                                     + jnp.dot(
-                                         p.astype(kblk.dtype), vblk,
-                                         preferred_element_type=jnp
-                                         .float32))
-                m_s[rows, :1] = m_new
+                    s = s + float(slopes[h]) * rel
+                parts.append(s + neg)
+            S = jnp.concatenate(parts, axis=0)           # [hq*sq, blk]
+            m_prev = m_s[:, :1]
+            l_prev = l_s[:, :1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(S, axis=-1, keepdims=True))
+            p = jnp.exp(S - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_s[:, :1] = l_prev * corr + jnp.sum(
+                p, axis=-1, keepdims=True)
+            m_s[:, :1] = m_new
+            for h in range(hq):
+                vblk = v_ref_[0, :, h // rep, :]
+                rows = slice(h * sq, (h + 1) * sq)
+                o_ref[0, :, h, :] = (
+                    o_ref[0, :, h, :] * corr[rows]
+                    + jnp.dot(p[rows].astype(vblk.dtype), vblk,
+                              preferred_element_type=jnp.float32))
 
         page_live = t < count
         if window is not None:

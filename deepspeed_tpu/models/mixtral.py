@@ -70,10 +70,24 @@ class Mixtral(DecoderLM):
         }
         return params
 
+    # set True by init_inference: decode batches route through the
+    # sort-by-expert grouped GEMM (exact top-k, no capacity padding or
+    # drops) instead of the training path's [N, E, C] capacity einsum
+    # (reference: inference v2 moe_gemm/moe_gather/moe_scatter vs
+    # training sharded_moe dispatch)
+    moe_serving_dispatch = False
+
     def _mlp(self, p, h):
         c = self.config
+        from ..moe.sharded_moe import dequantize_experts
+        experts = dequantize_experts(p["experts"], h.dtype)
+        if self.moe_serving_dispatch:
+            from ..moe.sharded_moe import moe_ffn_grouped
+            return moe_ffn_grouped(h, p["router"], experts,
+                                   k=c.moe_top_k,
+                                   activation=c.activation)
         return moe_ffn(
-            h, p["router"], p["experts"], k=c.moe_top_k,
+            h, p["router"], experts, k=c.moe_top_k,
             capacity_factor=c.capacity_factor, min_capacity=c.min_capacity,
             activation=c.activation)
 

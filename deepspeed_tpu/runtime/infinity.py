@@ -10,7 +10,14 @@ XLA's memory-space support do the swapping:
 
 - the fp32 master copy of every transformer layer (plus Adam moments)
   lives in ``pinned_host`` memory on the TPU host — model size is bounded
-  by host RAM, not HBM;
+  by host RAM, not HBM; under the **nvme tier**
+  (``offload_optimizer.device="nvme"``) master+moments live on DISK
+  instead and page per layer through the native AIO op into the C++ CPU
+  Adam (one-layer read-ahead, the PipelinedOptimizerSwapper pattern), so
+  model size is bounded by NVMe capacity;
+- phase A reads a COMPUTE-DTYPE (bf16) stream copy of the layer stacks,
+  not the fp32 master — half the per-micro-batch H2D bytes; the
+  optimizer phase refreshes the stream stack from the updated master;
 - the forward pass is a ``lax.scan`` over the stacked ``[L, ...]`` layer
   leaves whose body explicitly ``device_put``s one layer's slice into
   HBM — XLA turns that into a per-layer H2D DMA pipelined against
@@ -130,8 +137,29 @@ class StreamedZeroEngine:
                                 sched_cfg.params if sched_cfg else {},
                                 p.get("lr", 1e-3)))
 
-        self._moment_dtype = jnp.dtype(
-            config.zero_optimization.offload_optimizer.moment_dtype)
+        off = config.zero_optimization.offload_optimizer
+        self._moment_dtype = jnp.dtype(off.moment_dtype)
+        # nvme tier: master + moments page through NVMe per layer during
+        # the optimizer phase; only the compute-dtype stream stack (+
+        # transient grad stacks) occupy host RAM, so model size is
+        # bounded by DISK, not host RAM (reference:
+        # swap_tensor/partitioned_param_swapper.py,
+        # stage3.py:1926 optimizer-state swap)
+        self._nvme = off.device == "nvme"
+        if self._nvme:
+            import os
+            self._nvme_dir = off.nvme_path or os.path.join(
+                os.getcwd(), "ds_nvme_swap")
+            os.makedirs(self._nvme_dir, exist_ok=True)
+            from ..ops.aio import get_aio_handle
+            self._aio = get_aio_handle(config.aio)
+            from ..ops.cpu_optimizers import DeepSpeedCPUAdam
+            self._cpu_opt = DeepSpeedCPUAdam(
+                lr=p.get("lr", 1e-3), betas=(self._b1, self._b2),
+                eps=self._eps, weight_decay=self._wd,
+                adamw_mode=self._adamw_mode)
+            self._have_moments = False
+            self._last_nvme_io = {"read": 0, "written": 0}
         dev = jax.devices()[0]
         on_tpu = jax.default_backend() == "tpu"
         self._dev_sh = SingleDeviceSharding(dev)
@@ -142,20 +170,32 @@ class StreamedZeroEngine:
         self._phase_a = None
         self._phase_a_acc = None
         self._phase_b = None
+        self._phase_b_dev = None
         self._eval_jit = None
         self.global_steps = 0
         self.global_samples = 0
         self.skipped_steps = 0
         self._last_metrics = None
         n = self.model_config.num_params()
-        state_gib = (4 + 2 * self._moment_dtype.itemsize) \
-            * self._n_layer_params / 2 ** 30
-        log_dist(f"StreamedZeroEngine: {n/1e9:.2f}B params, "
-                 f"layers master+moments in "
-                 f"{'pinned_host' if on_tpu else 'device (cpu test rig)'} "
-                 f"({state_gib:.1f} GiB host state, moments "
-                 f"{self._moment_dtype.name}), "
-                 f"dtype={jnp.dtype(self.compute_dtype).name}")
+        cdt_size = jnp.dtype(self.compute_dtype).itemsize
+        if self._nvme:
+            state_gib = (4 + 2 * self._moment_dtype.itemsize) \
+                * self._n_layer_params / 2 ** 30
+            log_dist(f"StreamedZeroEngine: {n/1e9:.2f}B params, "
+                     f"master+moments on NVMe ({state_gib:.1f} GiB at "
+                     f"{self._nvme_dir}), {jnp.dtype(self.compute_dtype).name} "
+                     f"stream stack in pinned_host "
+                     f"({cdt_size * self._n_layer_params / 2**30:.1f} GiB)")
+        else:
+            state_gib = (4 + (cdt_size if self._mixed else 0)
+                         + 2 * self._moment_dtype.itemsize) \
+                * self._n_layer_params / 2 ** 30
+            log_dist(f"StreamedZeroEngine: {n/1e9:.2f}B params, "
+                     f"layers master+stream+moments in "
+                     f"{'pinned_host' if on_tpu else 'device (cpu test rig)'} "
+                     f"({state_gib:.1f} GiB host state, moments "
+                     f"{self._moment_dtype.name}), "
+                     f"dtype={jnp.dtype(self.compute_dtype).name}")
 
     # ------------------------------------------------------------------
     def _init_state(self):
@@ -224,7 +264,20 @@ class StreamedZeroEngine:
                 return jax.device_put(np.asarray(x, np.float32), sh)
 
             big_in, small_in = split_flat(given["layers"])
-            big = {n: put32(l, self._host_sh) for n, l in big_in.items()}
+            if self._nvme:
+                # given weights go straight to disk as the fp32 master;
+                # only the compute-dtype stream copy lands in pinned_host
+                big = {}
+                for n_, l in big_in.items():
+                    arr = np.asarray(l, np.float32)
+                    arr.tofile(self._nvme_file(n_, "master"))
+                    big[n_] = jax.device_put(
+                        arr.astype(np.dtype(self.compute_dtype)),
+                        self._host_sh)
+                    del arr
+            else:
+                big = {n: put32(l, self._host_sh)
+                       for n, l in big_in.items()}
             small = {n: put32(l, self._dev_sh)
                      for n, l in small_in.items()}
             dev_rest = {k: jax.tree.map(lambda x: put32(x, self._dev_sh), v)
@@ -233,7 +286,7 @@ class StreamedZeroEngine:
             # caller should del theirs too — at Infinity scale two
             # resident copies of the weights exhaust host RAM)
             self._init_params = given = big_in = small_in = None
-        elif fp32_bytes < 6 * 2 ** 30:
+        elif fp32_bytes < 6 * 2 ** 30 and not self._nvme:
             # small model: one init jit, big leaves straight to host
             out_sh = jax.tree.map(lambda _: self._dev_sh, abstract)
             sh_flat = dict(flatten_with_names(out_sh["layers"]))
@@ -255,9 +308,20 @@ class StreamedZeroEngine:
                 def pick(rng, _n=name):
                     flat = dict(flatten_with_names(init32(rng)["layers"]))
                     return flat[_n]
-                big[name] = jax.jit(
+                leaf = jax.jit(
                     pick, out_shardings=self._host_sh)(rng)
-                big[name].block_until_ready()
+                leaf.block_until_ready()
+                if self._nvme:
+                    # one leaf at a time: fp32 never accumulates in RAM
+                    arr = np.asarray(leaf)
+                    arr.tofile(self._nvme_file(name, "master"))
+                    del leaf
+                    big[name] = jax.device_put(
+                        arr.astype(np.dtype(self.compute_dtype)),
+                        self._host_sh)
+                    del arr
+                else:
+                    big[name] = leaf
 
             def rest(rng):
                 p = init32(rng)
@@ -269,25 +333,52 @@ class StreamedZeroEngine:
             small = dev_all.pop("layers_small")
             dev_rest = dev_all
 
-        self.master_layers = big                            # fp32, host
         self.dev_master = dev_rest                          # fp32, device
         self.dev_master["layers_small"] = small
         self.dev_params = jax.tree.map(
             lambda x: x.astype(self.compute_dtype), self.dev_master)
 
-        mdt = self._moment_dtype
-        zeros_like_host = jax.jit(
-            lambda t: jax.tree.map(
-                lambda x: jnp.zeros(x.shape, mdt), t),
-            out_shardings=jax.tree.map(lambda _: self._host_sh,
-                                       jax.eval_shape(lambda t: t, big)))
-        self.m_layers = zeros_like_host(self.master_layers)
-        self.v_layers = zeros_like_host(self.master_layers)
+        if self._nvme:
+            # `big` already holds the compute-dtype stream stack; master
+            # is on disk, moments are created lazily at the first step
+            self.master_layers = None
+            self.stream_layers = big
+            self.m_layers = self.v_layers = None
+        else:
+            self.master_layers = big
+            if self._mixed:
+                # phase A reads a compute-dtype copy of the layer stacks
+                # — HALF the per-micro-batch H2D bytes of streaming the
+                # fp32 master (the dominant PCIe traffic at ga>1);
+                # phase B refreshes it from the updated master in-scan
+                cast_host = jax.jit(
+                    lambda t: jax.tree.map(
+                        lambda x: x.astype(self.compute_dtype), t),
+                    out_shardings=jax.tree.map(
+                        lambda _: self._host_sh,
+                        jax.eval_shape(lambda t: t, big)))
+                self.stream_layers = cast_host(big)
+            else:
+                self.stream_layers = big    # fp32 compute: same arrays
+            mdt = self._moment_dtype
+            zeros_like_host = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, mdt), t),
+                out_shardings=jax.tree.map(lambda _: self._host_sh,
+                                           jax.eval_shape(lambda t: t,
+                                                          big)))
+            self.m_layers = zeros_like_host(self.master_layers)
+            self.v_layers = zeros_like_host(self.master_layers)
         self.dev_m = jax.tree.map(jnp.zeros_like, self.dev_master)
         self.dev_v = jax.tree.map(jnp.zeros_like, self.dev_master)
         self.step_count = 0
         self._n_layer_params = sum(
             int(np.prod(l.shape)) for n, l in named if n in stream)
+
+    def _nvme_file(self, name: str, field: str) -> str:
+        import os
+        return os.path.join(self._nvme_dir,
+                            f"streamed_{field}_{name.replace('/', '_')}.bin")
 
     # ------------------------------------------------------------------
     def _assemble_layer(self, big_flat: dict, small_flat: dict) -> PyTree:
@@ -301,25 +392,41 @@ class StreamedZeroEngine:
     @property
     def params(self) -> PyTree:
         """Full parameter tree view; the streamed layer matrices are the
-        HOST-RESIDENT fp32 master (reads are fine, they stream)."""
+        HOST-RESIDENT fp32 master (reads are fine, they stream). Under
+        the nvme tier the compute-dtype stream stack stands in — the
+        fp32 master lives on disk (use save_checkpoint for exact
+        state)."""
+        big = self.stream_layers if self._nvme else self.master_layers
         out = {k: v for k, v in self.dev_params.items()
                if k != "layers_small"}
         out["layers"] = self._assemble_layer(
-            self.master_layers, self.dev_params["layers_small"])
+            big, self.dev_params["layers_small"])
         return out
 
     def host_memory_report(self) -> dict:
-        out = {"pinned_host": 0, "device": 0}
-        for leaf in jax.tree.leaves([self.master_layers, self.m_layers,
-                                     self.v_layers]):
+        import os
+        out = {"pinned_host": 0, "device": 0, "nvme": 0}
+        host_trees = [self.master_layers, self.m_layers, self.v_layers]
+        if self._mixed or self._nvme:
+            host_trees.append(self.stream_layers)
+        for leaf in jax.tree.leaves([t for t in host_trees
+                                     if t is not None]):
             kind = getattr(leaf.sharding, "memory_kind", None)
             out["pinned_host" if kind == "pinned_host" else "device"] += \
                 int(leaf.size) * leaf.dtype.itemsize
         for leaf in jax.tree.leaves([self.dev_master, self.dev_m,
                                      self.dev_v]):
             out["device"] += int(leaf.size) * leaf.dtype.itemsize
-        total = out["pinned_host"] + out["device"]
+        if self._nvme:
+            for name in self._stream_names:
+                for f in ("master", "exp_avg", "exp_avg_sq"):
+                    path = self._nvme_file(name, f)
+                    if os.path.exists(path):
+                        out["nvme"] += os.path.getsize(path)
+        total = out["pinned_host"] + out["device"] + out["nvme"]
         out["host_fraction"] = out["pinned_host"] / total if total else 0.0
+        out["offloaded_fraction"] = ((out["pinned_host"] + out["nvme"])
+                                     / total if total else 0.0)
         return out
 
     # ------------------------------------------------------------------
@@ -351,7 +458,9 @@ class StreamedZeroEngine:
         inv_ga = 1.0 / self.gradient_accumulation_steps_
 
         def fetch(lh):
-            # one layer's fp32 master slice -> HBM -> compute dtype
+            # one layer's compute-dtype stream slice -> HBM (the cast is
+            # a no-op in bf16 mode: phase B already wrote the stack in
+            # compute dtype, halving this H2D stream vs fp32 master)
             return jax.tree.map(
                 lambda t: self._to_dev(t).astype(cdt), lh)
 
@@ -368,7 +477,7 @@ class StreamedZeroEngine:
         split = self._split_flat
         assemble = self._assemble_layer
 
-        def phase_a(master_layers, dev_params, batch, *acc_args):
+        def phase_a(stream_layers, dev_params, batch, *acc_args):
             tokens, targets = _unpack_batch(batch)
             small_stack = dev_params["layers_small"]
 
@@ -385,7 +494,7 @@ class StreamedZeroEngine:
 
             (xL, aux), acts = jax.lax.scan(
                 fbody, (x0, jnp.zeros((), jnp.float32)),
-                (master_layers, small_stack))
+                (stream_layers, small_stack))
 
             ce, head_vjp = jax.vjp(
                 functools.partial(head_loss, targets=targets),
@@ -395,9 +504,9 @@ class StreamedZeroEngine:
 
             if accumulate:
                 grads_acc, dev_acc = acc_args
-                bxs = (master_layers, small_stack, acts, grads_acc)
+                bxs = (stream_layers, small_stack, acts, grads_acc)
             else:
-                bxs = (master_layers, small_stack, acts)
+                bxs = (stream_layers, small_stack, acts)
 
             def bbody(carry, xs):
                 g, sq, finite = carry
@@ -452,32 +561,51 @@ class StreamedZeroEngine:
         host = self._host_sh
         dev = self._dev_sh
         abstract = jax.eval_shape(
-            lambda t: jax.tree.map(lambda x: x, t), self.master_layers)
+            lambda t: jax.tree.map(lambda x: x, t), self.stream_layers)
         grads_sh = jax.tree.map(lambda _: host, abstract)
         return jax.jit(
             phase_a,
             out_shardings=(dev, grads_sh, None, dev, dev),
             donate_argnums=(3, 4) if accumulate else ())
 
+    def _adam_leaf(self, mst, m, v, g, t, lr, coef):
+        b1, b2, eps, wd = self._b1, self._b2, self._eps, self._wd
+        mdt, vdt = m.dtype, v.dtype   # storage dtype (moment_dtype)
+        g = g.astype(jnp.float32) * coef
+        m = b1 * m.astype(jnp.float32) + (1 - b1) * g
+        v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        u = mhat / (jnp.sqrt(vhat) + eps)
+        if self._adamw_mode and wd:
+            # decoupled decay only; __init__ rejects L2-mode decay
+            u = u + wd * mst
+        return mst - lr * u, m.astype(mdt), v.astype(vdt)
+
+    @staticmethod
+    def _untriple(out):
+        is_t = lambda x: isinstance(x, tuple)   # noqa: E731
+        return tuple(jax.tree.map(lambda o, _i=i: o[_i], out, is_leaf=is_t)
+                     for i in range(3))
+
+    def _dev_adam(self, dev_master, dev_m, dev_v, dev_grads, t, lr, coef):
+        """Adam over the device-resident leaves (embed/head/norm/small
+        per-layer stacks); returns (master', m', v', params')."""
+        out = jax.tree.map(
+            lambda a, b_, c, d: self._adam_leaf(a, b_, c, d, t, lr, coef),
+            dev_master, dev_m, dev_v, dev_grads,
+            is_leaf=lambda x: isinstance(x, jax.Array))
+        dmst2, dm2, dv2 = self._untriple(out)
+        dev_params2 = jax.tree.map(
+            lambda x: x.astype(self.compute_dtype), dmst2)
+        return dmst2, dm2, dv2, dev_params2
+
     def _build_phase_b(self):
         """Streamed Adam: scan (g, master, m, v) per layer through HBM;
-        device-resident leaves update in the same program."""
-        b1, b2, eps, wd = self._b1, self._b2, self._eps, self._wd
-        adamw = self._adamw_mode
+        device-resident leaves update in the same program. Also emits
+        the refreshed compute-dtype stream stack phase A reads."""
         cdt = self.compute_dtype
-
-        def adam_leaf(mst, m, v, g, t, lr, coef):
-            mdt, vdt = m.dtype, v.dtype   # storage dtype (moment_dtype)
-            g = g.astype(jnp.float32) * coef
-            m = b1 * m.astype(jnp.float32) + (1 - b1) * g
-            v = b2 * v.astype(jnp.float32) + (1 - b2) * jnp.square(g)
-            mhat = m / (1 - b1 ** t)
-            vhat = v / (1 - b2 ** t)
-            u = mhat / (jnp.sqrt(vhat) + eps)
-            if adamw and wd:
-                # decoupled decay only; __init__ rejects L2-mode decay
-                u = u + wd * mst
-            return mst - lr * u, m.astype(mdt), v.astype(vdt)
+        mixed = self._mixed
 
         def phase_b(master_layers, m_layers, v_layers, grads_layers,
                     dev_master, dev_m, dev_v, dev_grads, t, lr, coef):
@@ -485,54 +613,183 @@ class StreamedZeroEngine:
                 mst, m, v, g = xs
                 mst, m, v, g = jax.tree.map(self._to_dev, (mst, m, v, g))
                 out = jax.tree.map(
-                    lambda a, b_, c, d: adam_leaf(a, b_, c, d, t, lr,
-                                                  coef),
+                    lambda a, b_, c, d: self._adam_leaf(a, b_, c, d, t,
+                                                        lr, coef),
                     mst, m, v, g,
                     is_leaf=lambda x: isinstance(x, jax.Array))
-                mst2 = jax.tree.map(lambda o: o[0], out,
-                                    is_leaf=lambda x: isinstance(x, tuple))
-                m2 = jax.tree.map(lambda o: o[1], out,
-                                  is_leaf=lambda x: isinstance(x, tuple))
-                v2 = jax.tree.map(lambda o: o[2], out,
-                                  is_leaf=lambda x: isinstance(x, tuple))
+                mst2, m2, v2 = self._untriple(out)
+                ys = [mst2, m2, v2]
+                if mixed:
+                    ys.append(jax.tree.map(lambda x: x.astype(cdt), mst2))
                 return (), tuple(jax.tree.map(self._to_host, x)
-                                 for x in (mst2, m2, v2))
+                                 for x in ys)
 
-            _, (mst2, m2, v2) = jax.lax.scan(
+            _, host_out = jax.lax.scan(
                 body, (), (master_layers, m_layers, v_layers,
                            grads_layers))
+            dev_out = self._dev_adam(dev_master, dev_m, dev_v, dev_grads,
+                                     t, lr, coef)
+            return (*host_out, *dev_out)
 
-            out = jax.tree.map(
-                lambda a, b_, c, d: adam_leaf(a, b_, c, d, t, lr, coef),
-                dev_master, dev_m, dev_v, dev_grads,
-                is_leaf=lambda x: isinstance(x, jax.Array))
-            dmst2 = jax.tree.map(lambda o: o[0], out,
-                                 is_leaf=lambda x: isinstance(x, tuple))
-            dm2 = jax.tree.map(lambda o: o[1], out,
-                               is_leaf=lambda x: isinstance(x, tuple))
-            dv2 = jax.tree.map(lambda o: o[2], out,
-                               is_leaf=lambda x: isinstance(x, tuple))
-            dev_params2 = jax.tree.map(lambda x: x.astype(cdt), dmst2)
-            return mst2, m2, v2, dmst2, dm2, dv2, dev_params2
-
-        host, dev = self._host_sh, self._dev_sh
+        host = self._host_sh
         habs = jax.eval_shape(lambda t: t, self.master_layers)
         hsh = jax.tree.map(lambda _: host, habs)
+        n_host = 4 if self._mixed else 3
         # grads_layers (arg 3) is deliberately NOT donated: it has no
         # same-shaped output to alias with (the r3 bench's "donated
         # buffers were not usable" warning was exactly these stacks);
-        # train_batch deletes it right after the call instead
+        # train_batch deletes it right after the call instead. The old
+        # stream stack is not an input at all — train_batch drops its
+        # reference before the call so old/new never coexist in RAM.
         return jax.jit(
             phase_b,
-            out_shardings=(hsh, hsh, hsh, None, None, None, None),
+            out_shardings=(*([hsh] * n_host), None, None, None, None),
             donate_argnums=(0, 1, 2, 4, 5, 6))
 
     # ------------------------------------------------------------------
+    def _nvme_stream_step(self, grads_layers, lr: float, coef: float,
+                          t: int) -> None:
+        """Optimizer phase of the nvme tier: master + Adam moments page
+        from NVMe one LAYER at a time with one-layer read-ahead (the
+        PipelinedOptimizerSwapper pattern, reference:
+        runtime/swap_tensor/pipelined_optimizer_swapper.py +
+        stage3.py:1926), the native CPU Adam (csrc/cpu_optimizers.cpp)
+        updates the fp32 shard in a bounce buffer, and the updated
+        compute-dtype weights refresh the pinned_host stream stack that
+        phase A reads. RAM high-water per leaf: two layers of fp32
+        state + one compute-dtype stack.
+
+        Runs in the client process — on a production pod the client IS
+        the TPU host, so reads/writes hit local NVMe; through a dev
+        tunnel the grad pull/stream push dominate (documented in
+        README)."""
+        if getattr(self, "_nvme_failed", None):
+            raise RuntimeError(
+                f"nvme swap state is corrupt ({self._nvme_failed}); "
+                "reload from a checkpoint before training further")
+        cdt_np = np.dtype(self.compute_dtype)
+        mdt_np = np.dtype(self._moment_dtype)   # on-disk moment dtype
+        m32 = mdt_np == np.float32
+        io_stats = {"read": 0, "written": 0}
+        new_stream = {}
+        old_stream = self.stream_layers
+        self.stream_layers = None
+        try:
+            self._nvme_sweep(grads_layers, lr, coef, t, cdt_np, mdt_np,
+                             m32, io_stats, new_stream, old_stream)
+        except Exception as e:
+            # the sweep mutates disk state leaf-by-leaf and consumes the
+            # grad stacks as it goes; a mid-sweep failure leaves master/
+            # moments part step-t, part step-t-1 — poison the engine so
+            # every later call says so instead of silently training on
+            # (or checkpointing) corrupt state
+            self._nvme_failed = f"{type(e).__name__}: {e}"
+            raise
+        self.stream_layers = new_stream
+        self._have_moments = True
+        self._last_nvme_io = io_stats
+
+    def _nvme_sweep(self, grads_layers, lr, coef, t, cdt_np, mdt_np,
+                    m32, io_stats, new_stream, old_stream):
+        for name in self._stream_names:
+            g_all = np.asarray(grads_layers[name])        # [L, ...] cdt
+            del grads_layers[name]
+            # the old stream leaf dies BEFORE the new one allocates —
+            # the stacks never coexist, so host high-water stays one
+            # stream stack + two layers of fp32 state
+            old_stream.pop(name, None)
+            L = g_all.shape[0]
+            lshape = g_all.shape[1:]
+            n_el = int(np.prod(lshape))
+            nbytes = n_el * 4                   # master is fp32 on disk
+            m_nbytes = n_el * mdt_np.itemsize
+            stream_np = np.empty(g_all.shape, cdt_np)
+            paths = {f: self._nvme_file(name, f)
+                     for f in ("master", "exp_avg", "exp_avg_sq")}
+            # double buffers: read layer l+1 while layer l computes,
+            # write layer l-1 behind both (synchronize() at each
+            # iteration also completes the slot's previous write before
+            # its buffer is reused)
+            bufs = [{"master": np.empty(lshape, np.float32),
+                     "exp_avg": np.empty(lshape, mdt_np),
+                     "exp_avg_sq": np.empty(lshape, mdt_np)}
+                    for _ in range(2)]
+            # fp32 compute view of the moments when disk dtype differs
+            # (the C++ optimizer updates fp32; moment_dtype only sets
+            # STORAGE, matching the cpu tier's semantics)
+            scratch32 = (None if m32 else
+                         {f: np.empty(lshape, np.float32)
+                          for f in ("exp_avg", "exp_avg_sq")})
+
+            def start_read(l, slot):
+                self._aio.async_pread(bufs[slot]["master"],
+                                      paths["master"], l * nbytes)
+                if self._have_moments:
+                    for f in ("exp_avg", "exp_avg_sq"):
+                        self._aio.async_pread(bufs[slot][f], paths[f],
+                                              l * m_nbytes)
+
+            start_read(0, 0)
+            for l in range(L):
+                slot = l % 2
+                rc = self._aio.synchronize()   # read(l) + write(l-1)
+                if rc:
+                    raise IOError(f"nvme swap I/O failed (rc={rc}) on "
+                                  f"{paths['master']}")
+                if l + 1 < L:
+                    start_read(l + 1, 1 - slot)
+                b = bufs[slot]
+                if m32:
+                    moments = {"exp_avg": b["exp_avg"],
+                               "exp_avg_sq": b["exp_avg_sq"]}
+                    if not self._have_moments:
+                        for buf in moments.values():
+                            buf.fill(0.0)
+                else:
+                    moments = scratch32
+                    for f, buf in moments.items():
+                        if self._have_moments:
+                            buf[:] = b[f]      # mdt -> fp32 cast
+                        else:
+                            buf.fill(0.0)
+                g = g_all[l].astype(np.float32, copy=True)
+                if coef != 1.0:
+                    g *= np.float32(coef)
+                self._cpu_opt.step_raw(b["master"], g, moments, lr, t)
+                stream_np[l] = b["master"].astype(cdt_np)
+                if not m32:
+                    for f, buf in moments.items():
+                        b[f][:] = buf          # fp32 -> mdt for disk
+                self._aio.async_pwrite(b["master"], paths["master"],
+                                       l * nbytes)
+                for f in ("exp_avg", "exp_avg_sq"):
+                    self._aio.async_pwrite(b[f], paths[f], l * m_nbytes)
+                io_stats["read"] += (nbytes + 2 * m_nbytes
+                                     if self._have_moments else nbytes)
+                io_stats["written"] += nbytes + 2 * m_nbytes
+            rc = self._aio.synchronize()
+            if rc:
+                raise IOError(f"nvme swap write failed (rc={rc})")
+            new_stream[name] = jax.device_put(stream_np, self._host_sh)
+            del stream_np, g_all, bufs
+
+    # ------------------------------------------------------------------
+    def _check_usable(self):
+        if self._nvme and getattr(self, "_nvme_failed", None):
+            raise RuntimeError(
+                f"nvme swap state is corrupt ({self._nvme_failed}); "
+                "reload from a checkpoint before using this engine")
+
     def train_batch(self, batch=None, data_iter=None):
+        self._check_usable()
         ga = self.gradient_accumulation_steps_
         if self._phase_a is None:
             self._phase_a = self._build_phase_a()
-            self._phase_b = self._build_phase_b()
+            if self._nvme:
+                self._phase_b_dev = jax.jit(self._dev_adam,
+                                            donate_argnums=(0, 1, 2))
+            else:
+                self._phase_b = self._build_phase_b()
             self._phase_a_acc = (self._build_phase_a(accumulate=True)
                                  if ga > 1 else None)
         # assemble the step's micro-batches: a full train batch splits
@@ -571,11 +828,11 @@ class StreamedZeroEngine:
                 micro)
             if i == 0:
                 loss, grads_layers, dev_grads, norm, finite = \
-                    self._phase_a(self.master_layers, self.dev_params,
+                    self._phase_a(self.stream_layers, self.dev_params,
                                   micro)
             else:
                 loss, grads_layers, dev_grads, norm, finite = \
-                    self._phase_a_acc(self.master_layers,
+                    self._phase_a_acc(self.stream_layers,
                                       self.dev_params, micro,
                                       grads_layers, dev_grads)
             losses.append(loss)
@@ -589,14 +846,36 @@ class StreamedZeroEngine:
             if clip and clip > 0:
                 coef = min(1.0, clip / (float(norm) + 1e-6))
             t = self.step_count + 1
-            (self.master_layers, self.m_layers, self.v_layers,
-             self.dev_master, self.dev_m, self.dev_v,
-             self.dev_params) = self._phase_b(
-                self.master_layers, self.m_layers, self.v_layers,
-                grads_layers, self.dev_master, self.dev_m, self.dev_v,
-                dev_grads, jnp.asarray(t, jnp.float32),
-                jnp.asarray(lr, jnp.float32),
-                jnp.asarray(coef, jnp.float32))
+            if self._nvme:
+                (self.dev_master, self.dev_m, self.dev_v,
+                 self.dev_params) = self._phase_b_dev(
+                    self.dev_master, self.dev_m, self.dev_v, dev_grads,
+                    jnp.asarray(t, jnp.float32),
+                    jnp.asarray(lr, jnp.float32),
+                    jnp.asarray(coef, jnp.float32))
+                self._nvme_stream_step(grads_layers, lr, coef, t)
+            else:
+                # drop the old stream stack BEFORE phase_b allocates the
+                # refreshed one, so two compute-dtype copies never
+                # coexist in host RAM (for fp32 compute the stream IS
+                # the master — phase_b emits no separate stream output
+                # and the alias renews below)
+                self.stream_layers = None
+                out = self._phase_b(
+                    self.master_layers, self.m_layers, self.v_layers,
+                    grads_layers, self.dev_master, self.dev_m,
+                    self.dev_v, dev_grads, jnp.asarray(t, jnp.float32),
+                    jnp.asarray(lr, jnp.float32),
+                    jnp.asarray(coef, jnp.float32))
+                if self._mixed:
+                    (self.master_layers, self.m_layers, self.v_layers,
+                     self.stream_layers, self.dev_master, self.dev_m,
+                     self.dev_v, self.dev_params) = out
+                else:
+                    (self.master_layers, self.m_layers, self.v_layers,
+                     self.dev_master, self.dev_m, self.dev_v,
+                     self.dev_params) = out
+                    self.stream_layers = self.master_layers
             self.step_count = t
         else:
             self.skipped_steps += 1
@@ -621,7 +900,7 @@ class StreamedZeroEngine:
         from ..models.transformer import _unpack_batch
         from ..ops.layers import cross_entropy_loss
 
-        def fwd(master_layers, dev_params, batch):
+        def fwd(stream_layers, dev_params, batch):
             tokens, targets = _unpack_batch(batch)
             x = module.embed(dev_params, tokens)
 
@@ -635,7 +914,7 @@ class StreamedZeroEngine:
 
             (xL, aux), _ = jax.lax.scan(
                 body, (x, jnp.zeros((), jnp.float32)),
-                (master_layers, dev_params["layers_small"]))
+                (stream_layers, dev_params["layers_small"]))
             xn = module._norm(xL, dev_params["final_norm"]["scale"],
                               dev_params["final_norm"].get("bias"))
             logits = module._project_vocab(dev_params, xn)
@@ -644,11 +923,12 @@ class StreamedZeroEngine:
         return jax.jit(fwd, out_shardings=self._dev_sh)
 
     def eval_batch(self, batch):
+        self._check_usable()
         if getattr(self, "_eval_jit", None) is None:
             self._eval_jit = self._build_eval()
         batch = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._dev_sh), batch)
-        return self._eval_jit(self.master_layers, self.dev_params, batch)
+        return self._eval_jit(self.stream_layers, self.dev_params, batch)
 
     def get_global_grad_norm(self):
         m = self._last_metrics
@@ -670,6 +950,7 @@ class StreamedZeroEngine:
     # checkpointing: host state pulls through the client process — fine
     # on a real pod host, slow through a remote tunnel (documented)
     def save_checkpoint(self, save_dir, tag=None, client_state=None, **_kw):
+        self._check_usable()
         import os
         import pickle
         from ..checkpoint.universal import flatten_with_names
@@ -677,8 +958,26 @@ class StreamedZeroEngine:
         path = os.path.join(save_dir, tag)
         os.makedirs(path, exist_ok=True)
         arrays = {}
-        for prefix, tree in (("master", self.master_layers),
-                             ("m", self.m_layers), ("v", self.v_layers),
+        if self._nvme:
+            # stream the fp32 master/moments out of the swap files one
+            # leaf at a time (never materializing the full fp32 tree)
+            for name in self._stream_names:
+                shape = self.stream_layers[name].shape
+                mdt = np.dtype(self._moment_dtype)
+                for prefix, f in (("master", "master"), ("m", "exp_avg"),
+                                  ("v", "exp_avg_sq")):
+                    swap_path = self._nvme_file(name, f)
+                    dt = np.float32 if prefix == "master" else mdt
+                    if prefix == "master" or self._have_moments:
+                        arrays[f"{prefix}::{name}"] = np.fromfile(
+                            swap_path, dt).reshape(shape)
+                    else:
+                        arrays[f"{prefix}::{name}"] = np.zeros(shape, dt)
+            host_trees = ()
+        else:
+            host_trees = (("master", self.master_layers),
+                          ("m", self.m_layers), ("v", self.v_layers))
+        for prefix, tree in (*host_trees,
                              ("dev_master", self.dev_master),
                              ("dev_m", self.dev_m),
                              ("dev_v", self.dev_v)):
@@ -722,14 +1021,50 @@ class StreamedZeroEngine:
             flat, treedef = jax.tree.flatten(tree)
             return jax.tree.unflatten(treedef, leaves)
 
-        self.master_layers = restore("master", self.master_layers,
-                                     self._host_sh)
+        opt = load_optimizer_states and not load_module_only
+        if self._nvme:
+            import os
+            cdt_np = np.dtype(self.compute_dtype)
+            stream = {}
+            for name in self._stream_names:
+                master = np.ascontiguousarray(data[f"master::{name}"],
+                                              dtype=np.float32)
+                master.tofile(self._nvme_file(name, "master"))
+                stream[name] = jax.device_put(
+                    master.astype(cdt_np), self._host_sh)
+                for prefix, f in (("m", "exp_avg"), ("v", "exp_avg_sq")):
+                    path = self._nvme_file(name, f)
+                    if opt:
+                        np.ascontiguousarray(
+                            data[f"{prefix}::{name}"],
+                            dtype=np.dtype(self._moment_dtype)) \
+                            .tofile(path)
+                    elif os.path.exists(path):
+                        os.unlink(path)
+            self.stream_layers = stream
+            self._have_moments = opt
+        else:
+            self.master_layers = restore("master", self.master_layers,
+                                         self._host_sh)
+            if self._mixed:
+                self.stream_layers = jax.jit(
+                    lambda t: jax.tree.map(
+                        lambda x: x.astype(self.compute_dtype), t),
+                    out_shardings=jax.tree.map(
+                        lambda _: self._host_sh,
+                        jax.eval_shape(lambda t: t,
+                                       self.master_layers)))(
+                    self.master_layers)
+            else:
+                self.stream_layers = self.master_layers
         self.dev_master = restore("dev_master", self.dev_master,
                                   self._dev_sh)
-        opt = load_optimizer_states and not load_module_only
         if opt:
-            self.m_layers = restore("m", self.m_layers, self._host_sh)
-            self.v_layers = restore("v", self.v_layers, self._host_sh)
+            if not self._nvme:
+                self.m_layers = restore("m", self.m_layers,
+                                        self._host_sh)
+                self.v_layers = restore("v", self.v_layers,
+                                        self._host_sh)
             self.dev_m = restore("dev_m", self.dev_m, self._dev_sh)
             self.dev_v = restore("dev_v", self.dev_v, self._dev_sh)
         else:
@@ -740,8 +1075,9 @@ class StreamedZeroEngine:
                 return jax.tree.map(
                     lambda x: jax.device_put(
                         jnp.zeros(x.shape, x.dtype), sh), tree)
-            self.m_layers = zeros(self.m_layers, self._host_sh)
-            self.v_layers = zeros(self.v_layers, self._host_sh)
+            if not self._nvme:
+                self.m_layers = zeros(self.m_layers, self._host_sh)
+                self.v_layers = zeros(self.v_layers, self._host_sh)
             self.dev_m = zeros(self.dev_m, self._dev_sh)
             self.dev_v = zeros(self.dev_v, self._dev_sh)
         self.dev_params = jax.tree.map(
