@@ -60,6 +60,16 @@ class OffloadParamConfig(DeepSpeedConfigModel):
     # streamed engine (CPU tests), False forces the whole-tree-fetch
     # sharded path.
     stream: Optional[bool] = None
+    # TPU extension (streamed cpu tier): what phase A streams per layer.
+    # "master" (default) streams the fp32 master directly — minimum
+    # host RAM. "compute" keeps a bf16 copy of the layer stacks in
+    # pinned_host, halving fwd/bwd H2D bytes at +2 bytes/param of host
+    # RAM — measured on a v5e host at 7B scale the extra pinned
+    # footprint (~81 GiB total) cost MORE in host-memory pressure than
+    # the halved bytes saved (98s/step master vs 107.5s compute), so
+    # opt in only with RAM headroom. The nvme tier always keeps the
+    # compute-dtype stack (master is on disk).
+    stream_dtype: Literal["compute", "master"] = "master"
 
 
 class ZeroConfig(DeepSpeedConfigModel):

@@ -15,9 +15,12 @@ XLA's memory-space support do the swapping:
   instead and page per layer through the native AIO op into the C++ CPU
   Adam (one-layer read-ahead, the PipelinedOptimizerSwapper pattern), so
   model size is bounded by NVMe capacity;
-- phase A reads a COMPUTE-DTYPE (bf16) stream copy of the layer stacks,
-  not the fp32 master — half the per-micro-batch H2D bytes; the
-  optimizer phase refreshes the stream stack from the updated master;
+- phase A streams the fp32 master per layer and casts on device
+  (default), or — with ``offload_param.stream_dtype="compute"`` — reads
+  a bf16 copy of the layer stacks that the optimizer phase refreshes,
+  halving fwd/bwd H2D bytes at +2 bytes/param of pinned host RAM
+  (measured net NEGATIVE at 7B on a v5e host near its pinned limit:
+  the pressure cost exceeded the byte saving; see config.py);
 - the forward pass is a ``lax.scan`` over the stacked ``[L, ...]`` layer
   leaves whose body explicitly ``device_put``s one layer's slice into
   HBM — XLA turns that into a per-layer H2D DMA pipelined against
@@ -146,6 +149,12 @@ class StreamedZeroEngine:
         # swap_tensor/partitioned_param_swapper.py,
         # stage3.py:1926 optimizer-state swap)
         self._nvme = off.device == "nvme"
+        # separate compute-dtype stream stack? (nvme: always — master is
+        # on disk; cpu tier: only when mixed AND configured "compute")
+        self._stream_separate = self._nvme or (
+            self._mixed and
+            config.zero_optimization.offload_param.stream_dtype
+            == "compute")
         if self._nvme:
             import os
             self._nvme_dir = off.nvme_path or os.path.join(
@@ -187,7 +196,7 @@ class StreamedZeroEngine:
                      f"stream stack in pinned_host "
                      f"({cdt_size * self._n_layer_params / 2**30:.1f} GiB)")
         else:
-            state_gib = (4 + (cdt_size if self._mixed else 0)
+            state_gib = (4 + (cdt_size if self._stream_separate else 0)
                          + 2 * self._moment_dtype.itemsize) \
                 * self._n_layer_params / 2 ** 30
             log_dist(f"StreamedZeroEngine: {n/1e9:.2f}B params, "
@@ -346,7 +355,7 @@ class StreamedZeroEngine:
             self.m_layers = self.v_layers = None
         else:
             self.master_layers = big
-            if self._mixed:
+            if self._stream_separate:
                 # phase A reads a compute-dtype copy of the layer stacks
                 # — HALF the per-micro-batch H2D bytes of streaming the
                 # fp32 master (the dominant PCIe traffic at ga>1);
@@ -359,7 +368,9 @@ class StreamedZeroEngine:
                         jax.eval_shape(lambda t: t, big)))
                 self.stream_layers = cast_host(big)
             else:
-                self.stream_layers = big    # fp32 compute: same arrays
+                # stream IS the master (fp32 compute, or
+                # stream_dtype="master"): phase A casts per layer
+                self.stream_layers = big
             mdt = self._moment_dtype
             zeros_like_host = jax.jit(
                 lambda t: jax.tree.map(
@@ -407,7 +418,7 @@ class StreamedZeroEngine:
         import os
         out = {"pinned_host": 0, "device": 0, "nvme": 0}
         host_trees = [self.master_layers, self.m_layers, self.v_layers]
-        if self._mixed or self._nvme:
+        if self._stream_separate:
             host_trees.append(self.stream_layers)
         for leaf in jax.tree.leaves([t for t in host_trees
                                      if t is not None]):
@@ -605,10 +616,16 @@ class StreamedZeroEngine:
         device-resident leaves update in the same program. Also emits
         the refreshed compute-dtype stream stack phase A reads."""
         cdt = self.compute_dtype
-        mixed = self._mixed
+        sep = self._stream_separate
 
         def phase_b(master_layers, m_layers, v_layers, grads_layers,
-                    dev_master, dev_m, dev_v, dev_grads, t, lr, coef):
+                    stream_old, dev_master, dev_m, dev_v, dev_grads,
+                    t, lr, coef):
+            # stream_old is never read — it is DONATED so the refreshed
+            # stream output aliases its pinned buffer instead of paying
+            # a multi-GiB pinned-host allocation every step (measured:
+            # fresh pinning cost ~8% of the 7B step)
+            del stream_old
             def body(_, xs):
                 mst, m, v, g = xs
                 mst, m, v, g = jax.tree.map(self._to_dev, (mst, m, v, g))
@@ -619,7 +636,7 @@ class StreamedZeroEngine:
                     is_leaf=lambda x: isinstance(x, jax.Array))
                 mst2, m2, v2 = self._untriple(out)
                 ys = [mst2, m2, v2]
-                if mixed:
+                if sep:
                     ys.append(jax.tree.map(lambda x: x.astype(cdt), mst2))
                 return (), tuple(jax.tree.map(self._to_host, x)
                                  for x in ys)
@@ -634,17 +651,18 @@ class StreamedZeroEngine:
         host = self._host_sh
         habs = jax.eval_shape(lambda t: t, self.master_layers)
         hsh = jax.tree.map(lambda _: host, habs)
-        n_host = 4 if self._mixed else 3
+        n_host = 4 if self._stream_separate else 3
         # grads_layers (arg 3) is deliberately NOT donated: it has no
         # same-shaped output to alias with (the r3 bench's "donated
         # buffers were not usable" warning was exactly these stacks);
-        # train_batch deletes it right after the call instead. The old
-        # stream stack is not an input at all — train_batch drops its
-        # reference before the call so old/new never coexist in RAM.
+        # train_batch deletes it right after the call instead.
+        # stream_old (arg 4) IS donated even though unread: its pinned
+        # buffer aliases the refreshed stream output (fp32 mode passes
+        # an empty dict — stream aliases master there).
         return jax.jit(
             phase_b,
             out_shardings=(*([hsh] * n_host), None, None, None, None),
-            donate_argnums=(0, 1, 2, 4, 5, 6))
+            donate_argnums=(0, 1, 2, 4, 5, 6, 7))
 
     # ------------------------------------------------------------------
     def _nvme_stream_step(self, grads_layers, lr: float, coef: float,
@@ -703,23 +721,34 @@ class StreamedZeroEngine:
             n_el = int(np.prod(lshape))
             nbytes = n_el * 4                   # master is fp32 on disk
             m_nbytes = n_el * mdt_np.itemsize
-            stream_np = np.empty(g_all.shape, cdt_np)
             paths = {f: self._nvme_file(name, f)
                      for f in ("master", "exp_avg", "exp_avg_sq")}
-            # double buffers: read layer l+1 while layer l computes,
-            # write layer l-1 behind both (synchronize() at each
-            # iteration also completes the slot's previous write before
-            # its buffer is reused)
-            bufs = [{"master": np.empty(lshape, np.float32),
-                     "exp_avg": np.empty(lshape, mdt_np),
-                     "exp_avg_sq": np.empty(lshape, mdt_np)}
-                    for _ in range(2)]
-            # fp32 compute view of the moments when disk dtype differs
-            # (the C++ optimizer updates fp32; moment_dtype only sets
-            # STORAGE, matching the cpu tier's semantics)
-            scratch32 = (None if m32 else
-                         {f: np.empty(lshape, np.float32)
-                          for f in ("exp_avg", "exp_avg_sq")})
+            # per-leaf scratch is allocated ONCE and reused across steps
+            # (multi-GiB allocations per step otherwise): the stream
+            # staging array, double buffers — read layer l+1 while layer
+            # l computes, write layer l-1 behind both (synchronize() at
+            # each iteration also completes the slot's previous write
+            # before its buffer is reused) — and, when the disk moment
+            # dtype differs, an fp32 compute view (the C++ optimizer
+            # updates fp32; moment_dtype only sets STORAGE, matching
+            # the cpu tier's semantics)
+            cache = getattr(self, "_nvme_scratch", None) or {}
+            self._nvme_scratch = cache
+            if name not in cache:
+                cache[name] = {
+                    "stream": np.empty(g_all.shape, cdt_np),
+                    "bufs": [
+                        {"master": np.empty(lshape, np.float32),
+                         "exp_avg": np.empty(lshape, mdt_np),
+                         "exp_avg_sq": np.empty(lshape, mdt_np)}
+                        for _ in range(2)],
+                    "scratch32": (None if m32 else
+                                  {f: np.empty(lshape, np.float32)
+                                   for f in ("exp_avg", "exp_avg_sq")}),
+                }
+            stream_np = cache[name]["stream"]
+            bufs = cache[name]["bufs"]
+            scratch32 = cache[name]["scratch32"]
 
             def start_read(l, slot):
                 self._aio.async_pread(bufs[slot]["master"],
@@ -752,7 +781,10 @@ class StreamedZeroEngine:
                             buf[:] = b[f]      # mdt -> fp32 cast
                         else:
                             buf.fill(0.0)
-                g = g_all[l].astype(np.float32, copy=True)
+                # always a fresh C-order fp32 buffer: the pinned-host
+                # stack can come back F-contiguous on TPU backends, and
+                # the C++ optimizer requires C-contiguous input
+                g = np.array(g_all[l], dtype=np.float32, order="C")
                 if coef != 1.0:
                     g *= np.float32(coef)
                 self._cpu_opt.step_raw(b["master"], g, moments, lr, t)
@@ -771,7 +803,7 @@ class StreamedZeroEngine:
             if rc:
                 raise IOError(f"nvme swap write failed (rc={rc})")
             new_stream[name] = jax.device_put(stream_np, self._host_sh)
-            del stream_np, g_all, bufs
+            del g_all
 
     # ------------------------------------------------------------------
     def _check_usable(self):
@@ -855,19 +887,23 @@ class StreamedZeroEngine:
                     jnp.asarray(coef, jnp.float32))
                 self._nvme_stream_step(grads_layers, lr, coef, t)
             else:
-                # drop the old stream stack BEFORE phase_b allocates the
-                # refreshed one, so two compute-dtype copies never
-                # coexist in host RAM (for fp32 compute the stream IS
-                # the master — phase_b emits no separate stream output
-                # and the alias renews below)
+                # the old stream stack is DONATED into phase_b so the
+                # refreshed one aliases its pinned buffer (when the
+                # stream IS the master — fp32 compute or
+                # stream_dtype="master" — donate nothing extra, the
+                # alias renews below)
+                old_stream = (self.stream_layers
+                              if self._stream_separate else {})
                 self.stream_layers = None
                 out = self._phase_b(
                     self.master_layers, self.m_layers, self.v_layers,
-                    grads_layers, self.dev_master, self.dev_m,
-                    self.dev_v, dev_grads, jnp.asarray(t, jnp.float32),
+                    grads_layers, old_stream, self.dev_master,
+                    self.dev_m, self.dev_v, dev_grads,
+                    jnp.asarray(t, jnp.float32),
                     jnp.asarray(lr, jnp.float32),
                     jnp.asarray(coef, jnp.float32))
-                if self._mixed:
+                del old_stream
+                if self._stream_separate:
                     (self.master_layers, self.m_layers, self.v_layers,
                      self.stream_layers, self.dev_master, self.dev_m,
                      self.dev_v, self.dev_params) = out
@@ -1043,10 +1079,11 @@ class StreamedZeroEngine:
                         os.unlink(path)
             self.stream_layers = stream
             self._have_moments = opt
+            self._nvme_failed = None   # disk state is clean again
         else:
             self.master_layers = restore("master", self.master_layers,
                                          self._host_sh)
-            if self._mixed:
+            if self._stream_separate:
                 self.stream_layers = jax.jit(
                     lambda t: jax.tree.map(
                         lambda x: x.astype(self.compute_dtype), t),

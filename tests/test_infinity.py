@@ -362,3 +362,47 @@ def test_streamed_nvme_checkpoint_roundtrip(tmp_path, devices8):
     l1 = [float(eng.train_batch(batch)) for _ in range(2)]
     l2 = [float(eng2.train_batch(batch)) for _ in range(2)]
     np.testing.assert_allclose(l2, l1, rtol=1e-5, atol=1e-5)
+
+
+def test_stream_stack_tracks_master(devices8):
+    """With stream_dtype="compute", the compute-dtype stream stack
+    phase A reads must equal the cast of the fp32 master after every
+    optimizer step (phase B refreshes it in-scan); divergence would
+    silently train on stale weights."""
+    eng, _, _, _ = ds.initialize(model=Llama(size="tiny"), config=_cfg(
+        bf16={"enabled": True},
+        zero_optimization={
+            "stage": 3,
+            "offload_param": {"device": "cpu", "stream": True,
+                              "stream_dtype": "compute"}}))
+    assert eng._stream_separate
+    batch = _batch(5)
+    for _ in range(2):
+        eng.train_batch(batch)
+    for name, mst in eng.master_layers.items():
+        np.testing.assert_array_equal(
+            np.asarray(eng.stream_layers[name]),
+            np.asarray(mst.astype(jnp.bfloat16)))
+    # fp32 compute: the stream IS the master (no second copy)
+    eng32, _, _, _ = ds.initialize(model=Llama(size="tiny"),
+                                   config=_stream_cfg())
+    eng32.train_batch(batch)
+    assert all(eng32.stream_layers[n] is eng32.master_layers[n]
+               for n in eng32.master_layers)
+    # default ("master"): bf16 compute without the extra stack —
+    # phase A casts the fp32 master per layer (min host RAM mode)
+    engm, _, _, _ = ds.initialize(
+        model=Llama(size="tiny"),
+        config=_stream_cfg(bf16={"enabled": True}))
+    assert not engm._stream_separate
+    l_m = [float(engm.train_batch(batch)) for _ in range(3)]
+    engc, _, _, _ = ds.initialize(model=Llama(size="tiny"), config=_cfg(
+        bf16={"enabled": True},
+        zero_optimization={
+            "stage": 3,
+            "offload_param": {"device": "cpu", "stream": True,
+                              "stream_dtype": "compute"}}))
+    assert engc._stream_separate
+    l_c = [float(engc.train_batch(batch)) for _ in range(3)]
+    # both modes stream bf16(master) weights into compute -> same math
+    np.testing.assert_allclose(l_m, l_c, rtol=1e-5, atol=1e-5)

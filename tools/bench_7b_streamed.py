@@ -1,10 +1,14 @@
+import sys; sys.path.insert(0, "/root/repo")
 import time, sys
 import numpy as np
 import jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import Llama
 
 ga = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+stream_dtype = sys.argv[2] if len(sys.argv) > 2 else "compute"
 micro, seq = 8, 2048
 batch = micro * ga
 model = Llama(hidden_size=4096, num_layers=32, num_heads=32,
@@ -21,7 +25,8 @@ engine, _, _, _ = ds.initialize(model=model, config={
     "gradient_clipping": 1.0,
     "zero_optimization": {
         "stage": 3,
-        "offload_param": {"device": "cpu"},
+        "offload_param": {"device": "cpu",
+                          "stream_dtype": stream_dtype},
         "offload_optimizer": {"device": "cpu",
                               "moment_dtype": "bfloat16"}},
     "steps_per_print": 10 ** 9})
@@ -35,4 +40,4 @@ loss = float(engine.train_batch(data))
 dt = time.perf_counter() - t0
 tps = batch * seq / dt
 mfu = tps * model.config.flops_per_token(seq) / 197e12
-print("ga", ga, "step_s", round(dt,2), "tps", round(tps,1), "mfu", round(mfu,4), "loss", round(loss,4))
+print("ga", ga, "stream", stream_dtype, "step_s", round(dt,2), "tps", round(tps,1), "mfu", round(mfu,4), "loss", round(loss,4))

@@ -1,3 +1,4 @@
+import sys; sys.path.insert(0, "/root/repo")
 import time
 import numpy as np
 import jax, jax.numpy as jnp
@@ -25,8 +26,8 @@ def decode_tps(model, grouped=None):
     np.asarray(out)
     return B * N / ((time.perf_counter() - t0) / reps)
 
-m_grp = decode_tps(moe)                      # grouped (default now)
-m_ein = decode_tps(moe, grouped=False)       # old einsum path
+m_grp = decode_tps(moe, grouped=True)        # opt-in grouped dispatch
+m_ein = decode_tps(moe)                      # einsum path (default)
 d = decode_tps(dense)
 print("moe grouped tps", round(m_grp,1), "moe einsum tps", round(m_ein,1),
       "dense tps", round(d,1))

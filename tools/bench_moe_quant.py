@@ -1,3 +1,4 @@
+import sys; sys.path.insert(0, "/root/repo")
 """int8-expert MoE decode vs dense at batch 16/64 (routing-overhead
 floor sweep) on the real chip. Run from the repo root."""
 import time
