@@ -199,8 +199,10 @@ class StreamedZeroEngine:
             state_gib = (4 + (cdt_size if self._stream_separate else 0)
                          + 2 * self._moment_dtype.itemsize) \
                 * self._n_layer_params / 2 ** 30
+            tiers = ("master+stream+moments" if self._stream_separate
+                     else "master+moments")
             log_dist(f"StreamedZeroEngine: {n/1e9:.2f}B params, "
-                     f"layers master+stream+moments in "
+                     f"layers {tiers} in "
                      f"{'pinned_host' if on_tpu else 'device (cpu test rig)'} "
                      f"({state_gib:.1f} GiB host state, moments "
                      f"{self._moment_dtype.name}), "
@@ -802,7 +804,14 @@ class StreamedZeroEngine:
             rc = self._aio.synchronize()
             if rc:
                 raise IOError(f"nvme swap write failed (rc={rc})")
-            new_stream[name] = jax.device_put(stream_np, self._host_sh)
+            # TPU: device_put into pinned_host COPIES (registration
+            # boundary), so the cached staging buffer is safe to reuse
+            # next step. CPU rig: device_put may alias the numpy buffer
+            # zero-copy — hand it a private copy so a caller holding
+            # engine.params across steps never sees mutation.
+            src = (stream_np if jax.default_backend() == "tpu"
+                   else stream_np.copy())
+            new_stream[name] = jax.device_put(src, self._host_sh)
             del g_all
 
     # ------------------------------------------------------------------

@@ -1,4 +1,6 @@
-import sys; sys.path.insert(0, "/root/repo")
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import time, sys
 import numpy as np
 import jax, jax.numpy as jnp
@@ -8,7 +10,7 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models import Llama
 
 ga = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-stream_dtype = sys.argv[2] if len(sys.argv) > 2 else "compute"
+stream_dtype = sys.argv[2] if len(sys.argv) > 2 else "master"
 micro, seq = 8, 2048
 batch = micro * ga
 model = Llama(hidden_size=4096, num_layers=32, num_heads=32,

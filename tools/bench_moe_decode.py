@@ -1,4 +1,6 @@
-import sys; sys.path.insert(0, "/root/repo")
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import time
 import numpy as np
 import jax, jax.numpy as jnp

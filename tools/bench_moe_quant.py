@@ -1,6 +1,8 @@
-import sys; sys.path.insert(0, "/root/repo")
 """int8-expert MoE decode vs dense at batch 16/64 (routing-overhead
 floor sweep) on the real chip. Run from the repo root."""
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import time
 import numpy as np
 import jax, jax.numpy as jnp
