@@ -543,6 +543,11 @@ def llama7b_streamed(ds, on_tpu: bool):
         # "master" (default): the bf16 stream stack measured NET
         # NEGATIVE on this host (+13.5 GiB pinned pushed it into
         # host-memory pressure: 107.5 vs 98.0 s/step at ga=8).
+        # micro=8 is the HBM sweet spot: at ga-saturation the per-TOKEN
+        # cost is the per-micro weight stream (81 GiB / 16k tokens), so
+        # a bigger micro would halve it — but micro=16 OOMs and
+        # micro=12 spills activations (measured 0.042 MFU); the ~0.31
+        # ceiling on 16 GiB HBM is set by that floor.
         # Measured r4: ga=8 0.285 MFU, ga=16 0.308 MFU (from r3's
         # 0.121 at ga=1).
         micro, ga, seq, steps = 8, 16, 2048, 1

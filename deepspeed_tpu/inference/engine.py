@@ -37,6 +37,15 @@ class InferenceEngine:
         self._config = config
         self.module = model
         self.dtype = config.jax_dtype
+        if self.dtype == jnp.int8:
+            # blanket-casting float weights to int8 would silently
+            # truncate them to garbage; int8 serving is WEIGHT-ONLY
+            # quantization with scales (reference ZeRO-Inference)
+            raise NotImplementedError(
+                "dtype='int8' is not a blanket cast; use "
+                "quantize_moe_experts=True (routed experts) or "
+                "linear.QuantizedParameter (dense weights) for "
+                "weight-only int8 with scales")
         tp = max(1, config.tensor_parallel.tp_size)
         n_dev = len(jax.devices())
         if tp > n_dev:

@@ -14,7 +14,7 @@ bridge onto a sharded multi-chip run once a pod is available.
 Two knobs worth knowing:
 - ``--ga N`` gradient accumulation: the master+moments stream is paid
   once per optimizer step, so MFU climbs with ga (measured on v5e:
-  0.127 at ga=1 -> 0.308 at ga=16).
+  0.121 at ga=1 -> 0.308 at ga=16).
 - ``--nvme DIR`` moves the fp32 master + Adam moments to DISK, paged
   per layer through the native AIO op into the C++ CPU Adam — model
   size becomes bounded by NVMe capacity instead of host RAM (run this

@@ -1,7 +1,7 @@
 import sys
 from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
-import time, sys
+import time
 import numpy as np
 import jax, jax.numpy as jnp
 jax.config.update("jax_compilation_cache_dir", "/tmp/jaxcache")
@@ -11,13 +11,15 @@ from deepspeed_tpu.models import Llama
 
 ga = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 stream_dtype = sys.argv[2] if len(sys.argv) > 2 else "master"
-micro, seq = 8, 2048
+micro = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+loss_chunk = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+seq = 2048
 batch = micro * ga
 model = Llama(hidden_size=4096, num_layers=32, num_heads=32,
               num_kv_heads=32, intermediate_size=11008,
               vocab_size=32000, max_seq_len=2048,
               remat_policy="segments", attn_impl="flash",
-              tie_embeddings=False)
+              loss_chunk=loss_chunk, tie_embeddings=False)
 engine, _, _, _ = ds.initialize(model=model, config={
     "train_batch_size": batch,
     "train_micro_batch_size_per_gpu": micro,
@@ -42,4 +44,4 @@ loss = float(engine.train_batch(data))
 dt = time.perf_counter() - t0
 tps = batch * seq / dt
 mfu = tps * model.config.flops_per_token(seq) / 197e12
-print("ga", ga, "stream", stream_dtype, "step_s", round(dt,2), "tps", round(tps,1), "mfu", round(mfu,4), "loss", round(loss,4))
+print("ga", ga, "stream", stream_dtype, "micro", micro, "step_s", round(dt,2), "tps", round(tps,1), "mfu", round(mfu,4), "loss", round(loss,4))
