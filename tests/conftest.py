@@ -24,7 +24,7 @@ jax.config.update("jax_threefry_partitionable", True)
 
 
 # ---------------------------------------------------------------------
-# fast tier: `pytest -m fast` runs a <2-minute smoke covering the core
+# fast tier: `pytest -m fast` runs a ~2-minute smoke covering the core
 # subsystems (engine/ZeRO, pipeline, sequence-parallel, MoE, inference
 # v2 bookkeeping, mesh/comm) so CI and reviewers get a quick signal; the
 # full suite exceeds 10 minutes of XLA compiles on the 8-device CPU mesh
@@ -45,12 +45,13 @@ _FAST = {
     ("test_inference_v2.py", "test_state_manager_admission"),
     ("test_linear.py", "test_fp_quantize_validates_group_size_alignment"),
     ("test_infinity.py", "test_streamed_matches_sharded_fp32"),
+    ("test_infinity.py", "test_streamed_nvme_matches_cpu_tier"),
 }
 
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "fast: <2-minute smoke tier (see README Development)")
+        "markers", "fast: ~2-minute smoke tier (see README Development)")
 
 
 def pytest_collection_modifyitems(config, items):
