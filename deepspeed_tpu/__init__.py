@@ -48,10 +48,11 @@ def initialize(args=None,
     from .runtime.config import DeepSpeedConfig as _Cfg
     config = _Cfg.from_any(config)  # parsed once; constructors accept it
     if hasattr(model, "moe_serving_dispatch"):
-        # a model previously passed through init_inference(
-        # moe_grouped_dispatch=True) carries the serving dispatch flag;
-        # training must use the capacity einsum (drops are a training
-        # regularizer, and ep sharding needs the all-to-all form)
+        # belt-and-braces: init_inference binds the serving dispatch
+        # flag to its own shallow copy and never mutates the shared
+        # instance, but a user may have set the class/instance attr by
+        # hand; training must use the capacity einsum (drops are a
+        # training regularizer, and ep sharding needs the all-to-all)
         model.moe_serving_dispatch = False
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine

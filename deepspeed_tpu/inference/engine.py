@@ -105,18 +105,22 @@ class InferenceEngine:
         # SLOWER than the capacity-einsum dispatch (2558 vs 3736 tok/s),
         # because decode MoE is expert-weight-read bound and the einsum
         # already sits at that floor — use quantize_moe_experts to cut
-        # the floor itself. Opting in MUTATES the model instance
-        # (Mixtral.moe_serving_dispatch); training engines reset it.
+        # the floor itself. The flag lives on a per-engine shallow copy
+        # of the model (never the shared instance).
         if hasattr(model, "moe_serving_dispatch"):
             if config.moe_grouped_dispatch and tp > 1:
                 raise NotImplementedError(
                     "moe_grouped_dispatch is a single-replica serving "
                     "path (ragged_dot bypasses the ep/tp all-to-all "
                     "dispatch); shard OR group, not both")
-            # assigned unconditionally from config so engines never
-            # inherit another engine's dispatch mode through the shared
-            # model instance
-            model.moe_serving_dispatch = bool(config.moe_grouped_dispatch)
+            # the flag is read at TRACE time, so bind it to a per-engine
+            # shallow copy of the model — never to the (possibly shared)
+            # instance, where a later engine's mode would leak into an
+            # earlier engine's first trace (ADVICE r4)
+            import copy
+            self.module = copy.copy(model)
+            self.module.moe_serving_dispatch = bool(
+                config.moe_grouped_dispatch)
         self._forward = jax.jit(
             lambda p, tokens: self.module.apply(p, tokens))
         self._generate_fns: dict[tuple, Any] = {}

@@ -115,9 +115,14 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                 & (jax.lax.broadcasted_iota(jnp.int32, shape2, 0) < tl)
             if window is not None:
                 live &= qpos - kpos < window
-            neg = jnp.where(live, 0.0, -1e30)            # [sq, blk]
             rel = ((kpos - qpos).astype(jnp.float32)
                    if slopes is not None else None)
+            # rows dead for EVERY q position hold pool garbage; zero
+            # them on the v side too — p==0 alone doesn't protect the
+            # contraction (0 * NaN = NaN)
+            vmask = jnp.any(live, axis=0)                # [blk]
+            vclean = [jnp.where(vmask[:, None], v_ref_[0, :, g, :], 0)
+                      for g in range(hq // rep)]         # per kv head
             parts = []
             for h in range(hq):
                 qv = q_ref[0, :, h, :]                      # [sq, d]
@@ -126,7 +131,9 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                             preferred_element_type=jnp.float32) * sc
                 if slopes is not None:
                     s = s + float(slopes[h]) * rel
-                parts.append(s + neg)
+                # where() (not an additive -1e30) so NaN/Inf in dead
+                # KV-pool slots cannot poison the row softmax.
+                parts.append(jnp.where(live, s, -1e30))
             S = jnp.concatenate(parts, axis=0)           # [hq*sq, blk]
             m_prev = m_s[:, :1]
             l_prev = l_s[:, :1]
@@ -138,7 +145,7 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                 p, axis=-1, keepdims=True)
             m_s[:, :1] = m_new
             for h in range(hq):
-                vblk = v_ref_[0, :, h // rep, :]
+                vblk = vclean[h // rep]
                 rows = slice(h * sq, (h + 1) * sq)
                 o_ref[0, :, h, :] = (
                     o_ref[0, :, h, :] * corr[rows]

@@ -82,19 +82,24 @@ class AsyncIOHandle:
                                             buffer.nbytes, file_offset)
 
 
-_default_handle: Optional[AsyncIOHandle] = None
+_handles: "dict[tuple, AsyncIOHandle]" = {}
 
 
 def get_aio_handle(config=None) -> AsyncIOHandle:
-    """Process-wide handle built from the `aio` config block."""
-    global _default_handle
-    if _default_handle is None:
-        kw = {}
-        if config is not None:
-            kw = dict(block_size=config.block_size,
-                      queue_depth=config.queue_depth,
-                      single_submit=config.single_submit,
-                      overlap_events=config.overlap_events,
-                      num_threads=max(config.thread_count, 4))
-        _default_handle = AsyncIOHandle(**kw)
-    return _default_handle
+    """Process-wide handle cache, keyed by the `aio` config values —
+    two engines with different aio blocks get different handles
+    instead of silently sharing the first caller's settings. Handles
+    live for the life of the process (engines hold references anyway,
+    so eviction could not actually retire a pool; the distinct-config
+    count in one process is small)."""
+    kw = {}
+    if config is not None:
+        kw = dict(block_size=config.block_size,
+                  queue_depth=config.queue_depth,
+                  single_submit=config.single_submit,
+                  overlap_events=config.overlap_events,
+                  num_threads=max(config.thread_count, 4))
+    key = tuple(sorted(kw.items()))
+    if key not in _handles:
+        _handles[key] = AsyncIOHandle(**kw)
+    return _handles[key]

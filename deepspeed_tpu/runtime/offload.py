@@ -111,9 +111,16 @@ class NVMeOffloadOptimizer:
             opt_cfg.type if opt_cfg else "adamw",
             opt_cfg.params if opt_cfg else {})
         off = engine.config.zero_optimization.offload_optimizer
-        self.nvme_dir = off.nvme_path or os.path.join(
-            os.getcwd(), "ds_nvme_swap")
+        # per-engine scratch subdir + atexit cleanup: same collision /
+        # leak contract as StreamedZeroEngine._nvme_dir (ADVICE r4)
+        from .infinity import _NVME_ENGINE_SEQ
+        base = off.nvme_path or os.path.join(os.getcwd(), "ds_nvme_swap")
+        self.nvme_dir = os.path.join(
+            base, f"engine_pid{os.getpid()}_e{next(_NVME_ENGINE_SEQ)}")
         os.makedirs(self.nvme_dir, exist_ok=True)
+        import atexit
+        import shutil
+        atexit.register(shutil.rmtree, self.nvme_dir, ignore_errors=True)
         self._aio = get_aio_handle(engine.config.aio)
         self._engine = engine
         self._shards: list[_ShardRec] = []
@@ -156,7 +163,9 @@ class NVMeOffloadOptimizer:
                 ordinal += 1
 
     def _moment_path(self, key: str, moment: str) -> str:
-        safe = key.replace("/", "_")
+        # injective ('_'→'__' before '/'→'_s'): 'a/b' and 'a_b' must not
+        # share a moment file
+        safe = key.replace("_", "__").replace("/", "_s")
         return os.path.join(self.nvme_dir,
                             f"rank{jax.process_index()}_{safe}_{moment}.bin")
 
