@@ -10,12 +10,40 @@ for GPU DMA; TPU offload moves through host RAM anyway).
 
 from __future__ import annotations
 
+import atexit
+import itertools
 import os
+import shutil
 from typing import Optional
 
 import numpy as np
 
 from .op_builder import AsyncIOBuilder
+
+_SCRATCH_SEQ = itertools.count()
+
+
+def engine_scratch_dir(base: str) -> tuple[str, "callable"]:
+    """Per-engine NVMe scratch subdir under ``base`` (ADVICE r4): two
+    engines — same or different process — can never share swap files.
+    Registered for best-effort removal at interpreter exit; callers
+    should also invoke the returned ``cleanup`` when discarding the
+    engine mid-process so sweeps don't strand fp32-state-sized dirs."""
+    path = os.path.join(
+        base, f"engine_pid{os.getpid()}_e{next(_SCRATCH_SEQ)}")
+    os.makedirs(path, exist_ok=True)
+    atexit.register(shutil.rmtree, path, ignore_errors=True)
+
+    def cleanup():
+        shutil.rmtree(path, ignore_errors=True)
+
+    return path, cleanup
+
+
+def safe_leaf_name(name: str) -> str:
+    """Injective filename encoding ('_'→'__' before '/'→'_s'): leaves
+    like 'a/b' and 'a_b' must never collide on one swap file."""
+    return name.replace("_", "__").replace("/", "_s")
 
 
 class AsyncIOHandle:

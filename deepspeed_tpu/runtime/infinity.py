@@ -57,7 +57,6 @@ exercise the exact scan/vjp structure that runs on hardware.
 from __future__ import annotations
 
 import functools
-import itertools
 import time
 from typing import Any
 
@@ -71,10 +70,6 @@ from .config import DeepSpeedConfig
 from .lr_schedules import build_schedule
 
 PyTree = Any
-
-# Default-nvme-dir disambiguator: two engines in one process must not
-# share swap files (ADVICE r4).
-_NVME_ENGINE_SEQ = itertools.count()
 
 
 def _is_streamable_module(module) -> bool:
@@ -162,19 +157,11 @@ class StreamedZeroEngine:
             == "compute")
         if self._nvme:
             import os
-            # Swap files are scratch (checkpoints are self-contained), so
-            # every engine gets its OWN subdir — under nvme_path if given,
-            # else under cwd — and two engines can never corrupt each
-            # other's master/moment files (ADVICE r4). Best-effort atexit
-            # cleanup stops repeated runs stranding fp32-state-sized dirs.
+            # Swap files are scratch (checkpoints are self-contained):
+            # per-engine subdir + cleanup via ops.aio.engine_scratch_dir
+            from ..ops.aio import engine_scratch_dir
             base = off.nvme_path or os.path.join(os.getcwd(), "ds_nvme_swap")
-            self._nvme_dir = os.path.join(
-                base, f"engine_pid{os.getpid()}_e{next(_NVME_ENGINE_SEQ)}")
-            os.makedirs(self._nvme_dir, exist_ok=True)
-            import atexit
-            import shutil
-            atexit.register(shutil.rmtree, self._nvme_dir,
-                            ignore_errors=True)
+            self._nvme_dir, self._nvme_cleanup = engine_scratch_dir(base)
             from ..ops.aio import get_aio_handle
             self._aio = get_aio_handle(config.aio)
             from ..ops.cpu_optimizers import DeepSpeedCPUAdam
@@ -405,10 +392,24 @@ class StreamedZeroEngine:
 
     def _nvme_file(self, name: str, field: str) -> str:
         import os
-        # Injective encoding ('_'→'__' before '/'→'_s') so leaves like
-        # 'a/b' and 'a_b' cannot collide on one swap file.
-        safe = name.replace("_", "__").replace("/", "_s")
-        return os.path.join(self._nvme_dir, f"streamed_{field}_{safe}.bin")
+        from ..ops.aio import safe_leaf_name
+        return os.path.join(
+            self._nvme_dir, f"streamed_{field}_{safe_leaf_name(name)}.bin")
+
+    def close(self) -> None:
+        """Release the NVMe scratch dir now (it is also removed at
+        interpreter exit, but sweeps building several engines in one
+        process should not strand fp32-state-sized dirs)."""
+        cleanup = getattr(self, "_nvme_cleanup", None)
+        if cleanup is not None:
+            cleanup()
+            self._nvme_cleanup = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown
 
     # ------------------------------------------------------------------
     def _assemble_layer(self, big_flat: dict, small_flat: dict) -> PyTree:

@@ -111,16 +111,9 @@ class NVMeOffloadOptimizer:
             opt_cfg.type if opt_cfg else "adamw",
             opt_cfg.params if opt_cfg else {})
         off = engine.config.zero_optimization.offload_optimizer
-        # per-engine scratch subdir + atexit cleanup: same collision /
-        # leak contract as StreamedZeroEngine._nvme_dir (ADVICE r4)
-        from .infinity import _NVME_ENGINE_SEQ
+        from ..ops.aio import engine_scratch_dir
         base = off.nvme_path or os.path.join(os.getcwd(), "ds_nvme_swap")
-        self.nvme_dir = os.path.join(
-            base, f"engine_pid{os.getpid()}_e{next(_NVME_ENGINE_SEQ)}")
-        os.makedirs(self.nvme_dir, exist_ok=True)
-        import atexit
-        import shutil
-        atexit.register(shutil.rmtree, self.nvme_dir, ignore_errors=True)
+        self.nvme_dir, self._nvme_cleanup = engine_scratch_dir(base)
         self._aio = get_aio_handle(engine.config.aio)
         self._engine = engine
         self._shards: list[_ShardRec] = []
@@ -163,11 +156,23 @@ class NVMeOffloadOptimizer:
                 ordinal += 1
 
     def _moment_path(self, key: str, moment: str) -> str:
-        # injective ('_'→'__' before '/'→'_s'): 'a/b' and 'a_b' must not
-        # share a moment file
-        safe = key.replace("_", "__").replace("/", "_s")
-        return os.path.join(self.nvme_dir,
-                            f"rank{jax.process_index()}_{safe}_{moment}.bin")
+        from ..ops.aio import safe_leaf_name
+        return os.path.join(
+            self.nvme_dir,
+            f"rank{jax.process_index()}_{safe_leaf_name(key)}_{moment}.bin")
+
+    def close(self) -> None:
+        """Release the NVMe scratch dir (also removed at exit)."""
+        cleanup = getattr(self, "_nvme_cleanup", None)
+        if cleanup is not None:
+            cleanup()
+            self._nvme_cleanup = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown
 
     # ---------------------------------------------------------------
     def step(self, grads: PyTree, lr: float, grad_scale: float = 1.0) -> int:

@@ -198,6 +198,58 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
     return fn
 
 
+def local_value_and_grad(micro_loss: Callable, mesh: Mesh,
+                         param_specs: PyTree,
+                         batch_axes: tuple[str, ...]) -> Callable | None:
+    """Per-device UNREDUCED gradients for the eager triple's deferred
+    dp-reduction (reference: engine.no_sync, engine.py:1987 — reduction
+    is suppressed during accumulation micro-steps and paid once at the
+    boundary).
+
+    Returns ``fn(params, batch, scale, step) -> (loss, stacked_grads)``
+    where ``stacked_grads`` leaves have a leading batch-shard axis of
+    size n_batch, sharded over ``batch_axes`` — i.e. each device keeps
+    exactly its own partial gradient and NO cross-device collective
+    runs. The engine sums/means over that leading axis at the GAS
+    boundary, which is where XLA emits the single all-reduce.
+
+    Same explicit-SPMD regime as the quantized collectives: pure
+    sharded-DP meshes (no tp/sp/pp/ep — those axes' collectives live
+    inside the model forward and cannot be deferred, exactly as in the
+    reference where TP comm is never part of no_sync). Returns None
+    when the mesh has no >1 batch axis (nothing to defer).
+    """
+    batch_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    if not batch_axes:
+        return None
+
+    def fn(params, batch, scale, step):
+        def body(params_local, batch_local, scale, step):
+            full = jax.tree.map(
+                lambda x, s: _gather_param(x, s, False),
+                params_local, _as_tree(param_specs, params_local))
+            (sl, l), g_full = jax.value_and_grad(
+                micro_loss, has_aux=True)(full, batch_local, scale, step)
+            del sl
+            g_stacked = jax.tree.map(
+                lambda g: g.astype(jnp.float32)[None], g_full)
+            # local losses stay stacked too: the deferred-backward
+            # program must contain NO collective at all (even a scalar
+            # pmean would be one)
+            return l[None], g_stacked
+
+        sm = shard_map(
+            body, mesh=mesh,
+            in_specs=(param_specs, PartitionSpec(batch_axes),
+                      PartitionSpec(), PartitionSpec()),
+            out_specs=(PartitionSpec(batch_axes),
+                       PartitionSpec(batch_axes)),
+            check_vma=False)
+        return sm(params, batch, scale, step)
+
+    return fn
+
+
 def _as_tree(spec_tree, like):
     """Align a PartitionSpec tree with `like` (they share structure)."""
     return jax.tree.unflatten(

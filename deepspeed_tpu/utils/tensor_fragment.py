@@ -97,9 +97,16 @@ def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
     forward()/backward() API to observe them)."""
     grads = getattr(engine, "_accum_grads", None)
     leaf = _lookup(grads, name)
+    if leaf is not None:
+        return np.asarray(jax.device_get(leaf), dtype=np.float32)
+    # deferred eager path: per-device partials stacked on a leading
+    # batch-shard axis (engine.backward); reduce here for inspection
+    stacked = getattr(engine, "_deferred_acc", None)
+    leaf = _lookup(stacked, name)
     if leaf is None:
         return None
-    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+    return np.asarray(jax.device_get(leaf),
+                      dtype=np.float32).mean(axis=0)
 
 
 def safe_get_full_optimizer_state(engine, name: str,

@@ -107,9 +107,10 @@ def test_forward_backward_step_compat(devices8):
 
 
 def test_no_sync_triple_matches_train_batch(devices8):
-    """no_sync() is an API-parity no-op (engine.no_sync docstring): the
-    eager triple under it must still reproduce train_batch numerics —
-    the reference's comm deferral changes scheduling, never results."""
+    """The eager triple defers the dp-reduction (unreduced per-device
+    grads accumulated in backward(), one all-reduce in step() — the
+    reference's no_sync comm contract, engine.no_sync:1987) and must
+    still reproduce train_batch numerics."""
     cfg = base_config(zero_optimization={"stage": 1})
     e1, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
     e2, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
@@ -119,11 +120,66 @@ def test_no_sync_triple_matches_train_batch(devices8):
         for i in range(2):
             micro = jax.tree.map(lambda x: x[i * 8:(i + 1) * 8], batch)
             e2.backward(e2.forward(micro))
+        # grads were deferred, not reduced per-micro
+        assert e2._deferred_acc is not None and e2._accum_grads is None
     e2.step()
     np.testing.assert_allclose(
         np.asarray(e1.state["params"]["embed"]["tokens"]),
         np.asarray(e2.state["params"]["embed"]["tokens"]),
         rtol=2e-5, atol=5e-5)
+
+
+def test_no_sync_defers_reduction_to_boundary(devices8):
+    """Comm structure of the deferred eager path: the per-micro backward
+    program contains NO cross-device collective; the boundary program
+    contains the reduction; the comms logger records it (VERDICT r4 #9).
+    Also: reference guards — step() illegal inside the ctx, no reentry,
+    stage>=2 rejected."""
+    from deepspeed_tpu import comm as ds_comm
+    from deepspeed_tpu.comm import comm as ds_comm_mod
+    from deepspeed_tpu.runtime.config import CommsLoggerConfig
+    prev_logger = ds_comm.get_comms_logger()
+    ds_comm.configure_comms_logger(
+        CommsLoggerConfig(enabled=True, verbose=False))
+    try:
+        cfg = base_config(zero_optimization={"stage": 1})
+        e, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+        batch = make_batch(jax.random.PRNGKey(0))
+        for i in range(2):
+            micro = jax.tree.map(lambda x: x[i * 8:(i + 1) * 8], batch)
+            e.backward(e.forward(micro))
+        # backward program: zero collectives
+        hlo = e._local_grads_jit.lower(
+            e.state["params"], jax.tree.map(lambda x: x[:8], batch),
+            e.state["loss_scale"].scale,
+            e.state["step"]).compile().as_text()
+        for op in ("all-reduce", "reduce-scatter", "all-gather",
+                   "all-to-all", "collective-permute"):
+            assert op + "(" not in hlo and op + "-start" not in hlo, \
+                f"deferred backward contains a {op}"
+        e.step()
+        # boundary program: exactly the one reduction, logged
+        lg = ds_comm.get_comms_logger()
+        recs = {k: dict(v) for k, v in lg.comms_dict.items()
+                if "eager GAS boundary" in k}
+        assert len(recs) == 1, f"expected one boundary reduction: {recs}"
+        counts = next(iter(recs.values()))
+        assert sum(counts.values()) == 1  # traced once per GAS boundary
+        # reference guards
+        with pytest.raises(AssertionError):
+            with e.no_sync():
+                e.step()
+        with pytest.raises(AssertionError):
+            with e.no_sync():
+                with e.no_sync():
+                    pass
+        e3, _, _, _ = ds.initialize(
+            model=GPT2(size="tiny"),
+            config=base_config(zero_optimization={"stage": 2}))
+        with pytest.raises(AssertionError):
+            e3.no_sync()
+    finally:
+        ds_comm_mod._comms_logger = prev_logger
 
 
 def test_scheduler_and_clipping(devices8):

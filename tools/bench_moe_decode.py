@@ -17,9 +17,10 @@ rng = np.random.default_rng(0)
 prompts = jnp.asarray(rng.integers(0, 32000, size=(B, P)))
 
 def decode_tps(model, grouped=None):
-    e = ds.init_inference(model, dtype="bfloat16", max_out_tokens=512)
-    if grouped is not None:
-        model.moe_serving_dispatch = grouped
+    # the engine binds the dispatch mode at construction (per-engine
+    # model copy): pass it through the config, never set it post-hoc
+    e = ds.init_inference(model, dtype="bfloat16", max_out_tokens=512,
+                          moe_grouped_dispatch=bool(grouped))
     np.asarray(e.generate(prompts, max_new_tokens=N))
     reps = 3
     t0 = time.perf_counter()
