@@ -43,6 +43,72 @@ AXIS_ORDER = ("pp", "dp", "fsdp", "zps", "ep", "sp", "tp")
 BATCH_AXES = ("dp", "fsdp", "zps")
 
 
+def build_device_array(axis_order: Sequence[str], shape: Sequence[int],
+                       dcn_sizes: dict, devices: Sequence) -> np.ndarray:
+    """Physical-topology-aware device placement (reference:
+    runtime/pipe/topology.py:1 ProcessTopology — rank order encodes
+    which links each axis rides; SURVEY §7.1 "ICI vs DCN aware").
+
+    - multi-slice (``dcn_sizes`` gives per-axis DCN degrees):
+      ``mesh_utils.create_hybrid_device_mesh`` puts those axes across
+      slice boundaries (grouping devices by ``slice_index``) and every
+      other axis on intra-slice ICI;
+    - single-slice TPU: ``mesh_utils.create_device_mesh`` maps the
+      logical axes onto the physical torus coordinates (a raw
+      ``reshape`` need not — e.g. on a v5p-128 it can put ``tp`` on
+      non-adjacent chips);
+    - CPU/virtual devices (tests) and single-device: plain reshape —
+      there is no physical topology to honor.
+    """
+    unknown = set(dcn_sizes) - set(axis_order)
+    if unknown:
+        raise ValueError(f"dcn axes {sorted(unknown)} are not mesh axes")
+    if dcn_sizes:
+        dcn_shape, ici_shape = [], []
+        for a, s in zip(axis_order, shape):
+            d = int(dcn_sizes.get(a, 1))
+            if s % d != 0:
+                raise ValueError(
+                    f"mesh axis {a}={s} not divisible by its dcn degree {d}")
+            dcn_shape.append(d)
+            ici_shape.append(s // d)
+        if hasattr(devices[0], "slice_index"):
+            from jax.experimental import mesh_utils
+            return mesh_utils.create_hybrid_device_mesh(
+                tuple(ici_shape), tuple(dcn_shape), devices=devices,
+                allow_split_physical_axes=True)
+        if getattr(devices[0], "platform", None) == "tpu":
+            import warnings
+            warnings.warn(
+                "mesh.dcn was configured but these TPU devices report no "
+                "slice_index (single-slice runtime?) — falling back to "
+                "sequential-block placement; DCN axes will NOT span "
+                "slices and torus-aware placement is skipped")
+        # CPU/virtual devices carry no slice_index: emulate the hybrid
+        # layout (each axis's dcn factor outermost over contiguous
+        # "slices" of sequential devices) so dcn configs stay testable
+        # on the virtual mesh
+        arr = np.asarray(devices).reshape(tuple(dcn_shape) + tuple(ici_shape))
+        k = len(ici_shape)
+        perm: list[int] = []
+        for i in range(k):
+            perm += [i, k + i]
+        return arr.transpose(perm).reshape(tuple(shape))
+    if getattr(devices[0], "platform", None) == "tpu" and len(devices) > 1:
+        from jax.experimental import mesh_utils
+        try:
+            return mesh_utils.create_device_mesh(
+                tuple(shape), devices=devices,
+                allow_split_physical_axes=True)
+        except Exception as e:  # odd subsets: fall back with a warning
+            import warnings
+            warnings.warn(
+                f"create_device_mesh failed ({e}); falling back to raw "
+                "device order — logical axes may not map onto the "
+                "physical torus")
+    return np.asarray(devices).reshape(shape)
+
+
 @dataclasses.dataclass(frozen=True)
 class TopologyConfig:
     """Degrees for each parallelism axis. -1 for fsdp means "absorb all
@@ -84,13 +150,17 @@ class MeshTopology:
 
     def __init__(self, config: TopologyConfig | None = None,
                  devices: Optional[Sequence[jax.Device]] = None,
-                 axis_order: Sequence[str] = AXIS_ORDER):
+                 axis_order: Sequence[str] = AXIS_ORDER,
+                 dcn: Optional[dict] = None):
         self.config = config or TopologyConfig()
         devices = list(devices if devices is not None else jax.devices())
         self.sizes = self.config.resolve(len(devices))
         self.axis_order = tuple(axis_order)
+        self.dcn_sizes = {a: int(v) for a, v in (dcn or {}).items()
+                          if int(v) > 1}
         shape = tuple(self.sizes[a] for a in self.axis_order)
-        dev_array = np.asarray(devices).reshape(shape)
+        dev_array = build_device_array(self.axis_order, shape,
+                                       self.dcn_sizes, devices)
         self.mesh = Mesh(dev_array, axis_names=self.axis_order)
 
     # -- group-style queries (reference: groups.py getters) ---------------

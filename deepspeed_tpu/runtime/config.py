@@ -14,7 +14,7 @@ of the three derivable from the other two.
 from __future__ import annotations
 
 import json
-from typing import Any, Literal, Optional
+from typing import Any, Dict, Literal, Optional
 
 from pydantic import Field
 
@@ -138,7 +138,15 @@ class HybridEngineConfig(DeepSpeedConfigModel):
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-specific: degrees for each mesh axis; fsdp=-1 absorbs the rest.
     ``zps`` (ZeRO++ hpZ / MiCS shard subgroup) is normally derived from
-    zero_hpz_partition_size / mics_shard_size, not set directly."""
+    zero_hpz_partition_size / mics_shard_size, not set directly.
+
+    ``dcn`` maps axis names to the portion of their degree that spans
+    data-center-network (multi-slice) boundaries, e.g.
+    ``{"mesh": {"pp": 4, "dcn": {"pp": 2}}}`` runs pipeline stages 2-wide
+    across slices and 2-deep within each slice; axes absent from ``dcn``
+    stay entirely on intra-slice ICI (parallel/mesh.py
+    build_device_array; reference: runtime/pipe/topology.py
+    ProcessTopology)."""
     pp: int = 1
     dp: int = 1
     fsdp: int = -1
@@ -146,6 +154,7 @@ class MeshConfig(DeepSpeedConfigModel):
     ep: int = 1
     sp: int = 1
     tp: int = 1
+    dcn: Dict[str, int] = {}
 
 
 class SequenceParallelConfig(DeepSpeedConfigModel):

@@ -93,7 +93,8 @@ class DeepSpeedEngine:
                     update={"fsdp": mesh_cfg.fsdp // sub})
         self.topology = MeshTopology(TopologyConfig(
             pp=mesh_cfg.pp, dp=mesh_cfg.dp, fsdp=mesh_cfg.fsdp, zps=zps,
-            ep=mesh_cfg.ep, sp=mesh_cfg.sp, tp=mesh_cfg.tp))
+            ep=mesh_cfg.ep, sp=mesh_cfg.sp, tp=mesh_cfg.tp),
+            dcn=mesh_cfg.dcn)
         set_topology(self.topology)
         self.mesh = self.topology.mesh
 
@@ -833,11 +834,7 @@ class DeepSpeedEngine:
                 if self._deferred_acc is None:
                     self._deferred_acc = g
                 else:
-                    if self._accum_add_jit is None:
-                        self._accum_add_jit = jax.jit(
-                            lambda a, b: jax.tree.map(jnp.add, a, b),
-                            donate_argnums=(0,))
-                    self._deferred_acc = self._accum_add_jit(
+                    self._deferred_acc = self._accum_add(
                         self._deferred_acc, g)
                 # GAS tracking stays LIVE inside no_sync — divergence
                 # from the reference, which disables it because its
@@ -866,12 +863,16 @@ class DeepSpeedEngine:
         if self._accum_grads is None:
             self._accum_grads = g
         else:
-            if self._accum_add_jit is None:
-                self._accum_add_jit = jax.jit(
-                    lambda a, b: jax.tree.map(jnp.add, a, b),
-                    donate_argnums=(0,))
-            self._accum_grads = self._accum_add_jit(self._accum_grads, g)
+            self._accum_grads = self._accum_add(self._accum_grads, g)
         self._micro_count += 1
+
+    def _accum_add(self, acc, g):
+        """Donating tree-add shared by both accumulation paths."""
+        if self._accum_add_jit is None:
+            self._accum_add_jit = jax.jit(
+                lambda a, b: jax.tree.map(jnp.add, a, b),
+                donate_argnums=(0,))
+        return self._accum_add_jit(acc, g)
 
     def _finish_deferred_grads(self):
         """Mean the stacked per-device partials over their leading

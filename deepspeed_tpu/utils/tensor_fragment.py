@@ -100,13 +100,17 @@ def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
     if leaf is not None:
         return np.asarray(jax.device_get(leaf), dtype=np.float32)
     # deferred eager path: per-device partials stacked on a leading
-    # batch-shard axis (engine.backward); reduce here for inspection
+    # batch-shard axis (engine.backward). Reduce ON DEVICE to a
+    # replicated array first — the stacked leaves are sharded over the
+    # batch axes and not fully addressable from one process on a pod.
     stacked = getattr(engine, "_deferred_acc", None)
     leaf = _lookup(stacked, name)
     if leaf is None:
         return None
-    return np.asarray(jax.device_get(leaf),
-                      dtype=np.float32).mean(axis=0)
+    import jax.numpy as jnp
+    reduced = jax.jit(lambda x: jnp.mean(x, axis=0),
+                      out_shardings=engine.topology.replicated())(leaf)
+    return np.asarray(jax.device_get(reduced), dtype=np.float32)
 
 
 def safe_get_full_optimizer_state(engine, name: str,
