@@ -312,7 +312,15 @@ class DeepSpeedEngine:
         else:
             raise ValueError(f"unknown sequence_parallel.mode {mode!r}")
         log_dist(f"sequence parallelism: {mode} over sp={sp}")
-        return functools.partial(self.module.loss, attn_fn=attn)
+        # pin the activation layout [B(batch axes), S(sp), D] through the
+        # layer scan: with a manual-sp attn_fn inside and fsdp-stacked
+        # weights, unconstrained carries let GSPMD reshard per iteration
+        # (ring config's involuntary-full-rematerialization warnings)
+        kw = {}
+        if "act_sharding" in inspect.signature(self.module.loss).parameters:
+            kw["act_sharding"] = self.topology.sharding(
+                self.topology.batch_axes(), "sp")
+        return functools.partial(self.module.loss, attn_fn=attn, **kw)
 
     def _flops_per_sample(self):
         if self.model_config is None:

@@ -38,6 +38,17 @@ def _match_paths(flat: dict[str, Any], name: str) -> list[str]:
     return [k for k in flat if k.endswith("/" + name)]
 
 
+@jax.jit
+def _mean0(x):
+    return jnp.mean(x, axis=0)
+
+
+def _mean0_jit(leaf, replicated_sharding):
+    """Cached on-device mean over the leading (batch-shard) axis,
+    replicated so the result is addressable from every process."""
+    return jax.device_put(_mean0(leaf), replicated_sharding)
+
+
 def _lookup(tree: PyTree, name: str) -> Optional[Any]:
     if tree is None:
         return None
@@ -107,9 +118,7 @@ def safe_get_full_grad(engine, name: str) -> Optional[np.ndarray]:
     leaf = _lookup(stacked, name)
     if leaf is None:
         return None
-    import jax.numpy as jnp
-    reduced = jax.jit(lambda x: jnp.mean(x, axis=0),
-                      out_shardings=engine.topology.replicated())(leaf)
+    reduced = _mean0_jit(leaf, engine.topology.replicated())
     return np.asarray(jax.device_get(reduced), dtype=np.float32)
 
 
