@@ -122,6 +122,19 @@ def init_inference(model=None, config=None, **kwargs):
             f"deepspeed_tpu.init_inference requires {e.name}, which is not "
             "built yet in this checkout") from e
     params = kwargs.pop("params", None)
+    if isinstance(model, str):
+        # HF checkpoint directory: load real pretrained weights
+        # (reference: init_inference's checkpoint loading path,
+        # inference/engine.py:326 + module_inject/load_checkpoint.py:21).
+        # Caller-supplied params skip the weight read — only the
+        # config.json translation is needed then.
+        from .checkpoint.huggingface import HuggingFaceCheckpointEngine
+        from .models import get_model_class
+        hf_eng = HuggingFaceCheckpointEngine(model)
+        cfg_m = hf_eng.model_config()
+        model = get_model_class(hf_eng.family)(cfg_m)
+        if params is None:
+            params = hf_eng.load_params(cfg_m)
     cfg = DeepSpeedInferenceConfig.from_any(config, **kwargs)
     return InferenceEngine(model, cfg, params=params)
 

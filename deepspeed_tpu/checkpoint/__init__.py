@@ -5,3 +5,5 @@ from .universal import (ds_to_universal, flatten_with_names,  # noqa: F401
 from .zero_to_fp32 import (  # noqa: F401
     convert_zero_checkpoint_to_fp32_state_dict,
     get_fp32_state_dict_from_zero_checkpoint)
+from .huggingface import (  # noqa: F401
+    HuggingFaceCheckpointEngine, from_pretrained)

@@ -16,7 +16,7 @@ from typing import Any, Optional
 from ...models.base import get_model_class
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
 
-__all__ = ["build_engine", "SUPPORTED_MODEL_TYPES"]
+__all__ = ["build_engine", "build_hf_engine", "SUPPORTED_MODEL_TYPES"]
 
 # reference engine_factory.py name table (+ bloom/gptj/gptneox/internlm,
 # which the reference serves through module_inject containers)
@@ -36,6 +36,24 @@ def build_engine(model_type: str, size: str = "tiny",
             f"unsupported model_type {model_type!r}; supported: "
             f"{SUPPORTED_MODEL_TYPES}")
     model = get_model_class(model_type)(size=size, **model_overrides)
+    if engine_config is None:
+        engine_config = RaggedInferenceEngineConfig()
+    elif isinstance(engine_config, dict):
+        engine_config = RaggedInferenceEngineConfig(**engine_config)
+    return InferenceEngineV2(model, engine_config, params=params)
+
+
+def build_hf_engine(path: str,
+                    engine_config: RaggedInferenceEngineConfig | dict |
+                    None = None,
+                    **model_overrides) -> InferenceEngineV2:
+    """Serve a real pretrained model from an HF checkpoint directory
+    (reference: engine_factory.py:69 build_hf_engine +
+    checkpoint/huggingface_engine.py HuggingFaceCheckpointEngine):
+    config.json picks the family, safetensors weights are mapped into
+    the stacked pytree layout, and the ragged engine serves them."""
+    from ...checkpoint.huggingface import from_pretrained
+    model, params = from_pretrained(path, **model_overrides)
     if engine_config is None:
         engine_config = RaggedInferenceEngineConfig()
     elif isinstance(engine_config, dict):

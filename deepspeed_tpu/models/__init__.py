@@ -14,3 +14,11 @@ from .phi import Phi, Phi3, phi3_config, phi_config  # noqa: F401
 from .qwen import (Qwen, Qwen2, Qwen2MoE, qwen2_config,  # noqa: F401
                    qwen2_moe_config, qwen_config)
 from .transformer import DecoderLM  # noqa: F401
+
+
+def from_pretrained(model_path: str, **config_overrides):
+    """(model, params) from a local HF checkpoint directory — see
+    checkpoint/huggingface.py (reference: inference/v2/checkpoint/
+    huggingface_engine.py)."""
+    from ..checkpoint.huggingface import from_pretrained as _fp
+    return _fp(model_path, **config_overrides)

@@ -31,9 +31,10 @@ class ModelConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = True
+    lm_head_bias: bool = False      # Phi / GPT-J biased vocab projection
     # architecture switches
     norm_type: str = "layernorm"        # layernorm | rmsnorm
-    activation: str = "gelu"            # gelu | swiglu
+    activation: str = "gelu"            # gelu | relu | swiglu
     position_embedding: str = "learned"  # learned | rope | alibi (Bloom)
     use_bias: bool = True
     attn_qkv_bias: bool = False     # qkv biases even when use_bias=False
@@ -51,6 +52,8 @@ class ModelConfig:
     num_experts: int = 0
     moe_num_shared_experts: int = 0  # Qwen2-MoE always-on experts
     moe_top_k: int = 2
+    moe_norm_topk: bool = True      # renormalize top-k probs (Mixtral
+    #                                 yes, Qwen2-MoE norm_topk_prob)
     capacity_factor: float = 1.25
     min_capacity: int = 4
     router_aux_loss_coef: float = 0.01
@@ -110,6 +113,8 @@ class ModelConfig:
         if self.norm_type == "layernorm":
             per_layer += n_norms * d        # ln biases
         embed = v * d + (0 if self.tie_embeddings else v * d)
+        if not self.tie_embeddings and self.lm_head_bias:
+            embed += v
         if self.embed_layernorm:
             embed += 2 * d
         pos = self.max_seq_len * d if self.position_embedding == "learned" else 0

@@ -81,15 +81,17 @@ class Mixtral(DecoderLM):
         c = self.config
         from ..moe.sharded_moe import dequantize_experts
         experts = dequantize_experts(p["experts"], h.dtype)
+        norm = c.moe_norm_topk
         if self.moe_serving_dispatch:
             from ..moe.sharded_moe import moe_ffn_grouped
             return moe_ffn_grouped(h, p["router"], experts,
                                    k=c.moe_top_k,
-                                   activation=c.activation)
+                                   activation=c.activation,
+                                   normalize_topk=norm)
         return moe_ffn(
             h, p["router"], experts, k=c.moe_top_k,
             capacity_factor=c.capacity_factor, min_capacity=c.min_capacity,
-            activation=c.activation)
+            activation=c.activation, normalize_topk=norm)
 
     def partition_rules(self):
         rules = [r for r in super().partition_rules()

@@ -25,7 +25,9 @@ def opt_config(size: str = "1.3b", **overrides) -> ModelConfig:
                     intermediate_size=36864, vocab_size=50272,
                     max_seq_len=2048),
     }
-    base = dict(norm_type="layernorm", activation="gelu",
+    # OPT's FFN activation is ReLU (HF OPTConfig activation_function
+    # default; caught by the HF logits-parity suite — gelu diverged)
+    base = dict(norm_type="layernorm", activation="relu",
                 position_embedding="learned", use_bias=True,
                 tie_embeddings=True)
     base.update(presets[size])

@@ -67,7 +67,10 @@ def qwen2_moe_config(size: str = "a2.7b", **overrides) -> ModelConfig:
     }
     base = dict(norm_type="rmsnorm", activation="swiglu",
                 position_embedding="rope", use_bias=False,
-                attn_qkv_bias=True, tie_embeddings=False)
+                attn_qkv_bias=True, tie_embeddings=False,
+                # HF Qwen2-MoE norm_topk_prob defaults False: raw
+                # softmax probs combine the top-k experts
+                moe_norm_topk=False)
     base.update(presets[size])
     base.update(overrides)
     return ModelConfig(**base)

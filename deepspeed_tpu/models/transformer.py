@@ -125,6 +125,8 @@ class DecoderLM:
             params["final_norm"]["bias"] = jnp.zeros((d,), dt)
         if not c.tie_embeddings:
             params["lm_head"] = _dense_init(keys[3], (d, v), std, dt)
+            if c.lm_head_bias:  # Phi / GPT-J biased vocab projection
+                params["lm_head_b"] = jnp.zeros((v,), dt)
         return params
 
     # ---------------- pieces (reused by pipeline/inference) --------------
@@ -322,7 +324,7 @@ class DecoderLM:
             up = checkpoint_name(h @ p["w_up"], "ffn_pre")
             if mlp_bias:
                 up = up + p["w_up_b"]
-            m = L.gelu(up)
+            m = jax.nn.relu(up) if c.activation == "relu" else L.gelu(up)
         m = checkpoint_name(m, "ffn")
         m = m @ p["w_down"]
         if mlp_bias:
@@ -389,9 +391,7 @@ class DecoderLM:
     def unembed(self, params: PyTree, x: jax.Array) -> jax.Array:
         x = self._norm(x, params["final_norm"]["scale"],
                        params["final_norm"].get("bias"))
-        if self.config.tie_embeddings:
-            return x @ params["embed"]["tokens"].T
-        return x @ params["lm_head"]
+        return self._project_vocab(params, x)
 
     # ---------------- apply / loss ----------------
     def apply(self, params: PyTree, tokens: jax.Array, *,
@@ -441,9 +441,13 @@ class DecoderLM:
         xc = x.reshape(b, n, chunk, d).swapaxes(0, 1)
         tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
 
+        bias = params.get("lm_head_b")
+
         @jax.checkpoint
         def chunk_nll(x_c, t_c):
             logits = (x_c @ W.astype(x_c.dtype)).astype(jnp.float32)
+            if bias is not None:
+                logits = logits + bias.astype(jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
             # same masking contract as ops.layers.cross_entropy_loss
             valid = t_c != -100
@@ -504,7 +508,10 @@ class DecoderLM:
         """Vocab projection of already-final-normed hidden states."""
         if self.config.tie_embeddings:
             return x @ params["embed"]["tokens"].T
-        return x @ params["lm_head"]
+        out = x @ params["lm_head"]
+        if "lm_head_b" in params:   # Phi / GPT-J biased head
+            out = out + params["lm_head_b"]
+        return out
 
     def aux_loss_coef(self) -> float:
         return getattr(self.config, "router_aux_loss_coef", 0.0)
@@ -522,7 +529,8 @@ class DecoderLM:
             (r"layers/(wo_b|w_down_b)$", P()),
             (r"layers/ln\d_(scale|bias)", P()),
             (r"final_norm", P()),
-            (r"lm_head", P(None, "tp")),
+            (r"lm_head$", P(None, "tp")),
+            (r"lm_head_b$", P("tp")),
         ]
 
 

@@ -178,7 +178,7 @@ def moe_ffn_grouped(x: jax.Array, gate_w: jax.Array, experts: dict, *,
 
 def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: dict, *,
             k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
-            activation: str = "swiglu",
+            activation: str = "swiglu", normalize_topk: bool = True,
             constrain: Callable | None = None):
     """Full MoE FFN for a [B, S, D] block input.
 
@@ -192,7 +192,8 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: dict, *,
     xt = x.reshape(n, d)
     logits = xt @ gate_w                                  # [N, E]
     combine, dispatch, aux, _ = top_k_gating(
-        logits, k, capacity_factor, min_capacity)
+        logits, k, capacity_factor, min_capacity,
+        normalize_topk=normalize_topk)
     combine = combine.astype(x.dtype)
 
     expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt,

@@ -1,0 +1,249 @@
+"""HF pretrained-checkpoint ingestion: logits parity vs transformers.
+
+The reference loads real models everywhere (huggingface_engine.py:16,
+module_inject/load_checkpoint.py:21, engine_factory.py:69
+build_hf_engine). These tests build tiny randomly-initialized HF models
+with transformers, save them as safetensors checkpoints, ingest them
+through checkpoint/huggingface.py, and assert OUR logits match the HF
+torch implementation's — the strongest possible evidence that the
+weight mapping (transposes, fused-qkv splits, rope conventions, stacked
+layout) is exact for every family.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+tr = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint.huggingface import (  # noqa: E402
+    HuggingFaceCheckpointEngine, from_pretrained)
+
+
+def _llama():
+    return tr.LlamaForCausalLM(tr.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False))
+
+
+def _mistral():
+    return tr.MistralForCausalLM(tr.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8))
+
+
+def _mixtral():
+    return tr.MixtralForCausalLM(tr.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2))
+
+
+def _gpt2():
+    return tr.GPT2LMHeadModel(tr.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=64))
+
+
+def _opt():
+    return tr.OPTForCausalLM(tr.OPTConfig(
+        vocab_size=256, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        word_embed_proj_dim=64))
+
+
+def _phi():
+    return tr.PhiForCausalLM(tr.PhiConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5))
+
+
+def _phi3():
+    return tr.Phi3ForCausalLM(tr.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0, eos_token_id=1,
+        bos_token_id=2))
+
+
+def _qwen2():
+    return tr.Qwen2ForCausalLM(tr.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False))
+
+
+def _qwen2_moe():
+    # shared expert 2x the routed width (exercises the width-multiple
+    # translation; real Qwen1.5-MoE uses 4x)
+    return tr.Qwen2MoeForCausalLM(tr.Qwen2MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=128, shared_expert_intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        decoder_sparse_step=1, norm_topk_prob=False))
+
+
+def _bloom():
+    return tr.BloomForCausalLM(tr.BloomConfig(
+        vocab_size=256, hidden_size=64, n_layer=2, n_head=4))
+
+
+def _falcon_mq():
+    return tr.FalconForCausalLM(tr.FalconConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, alibi=False,
+        parallel_attn=True, bias=False))
+
+
+def _falcon_new():
+    return tr.FalconForCausalLM(tr.FalconConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, new_decoder_architecture=True,
+        num_kv_heads=2, alibi=False, parallel_attn=True, bias=False))
+
+
+def _falcon_seq():
+    # sequential (non-parallel) falcon variant: ln2 comes from
+    # post_attention_layernorm
+    return tr.FalconForCausalLM(tr.FalconConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False, alibi=False,
+        parallel_attn=False, bias=False))
+
+
+def _gptj():
+    return tr.GPTJForCausalLM(tr.GPTJConfig(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8))
+
+
+def _gptneox():
+    return tr.GPTNeoXForCausalLM(tr.GPTNeoXConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25))
+
+
+CASES = {
+    "llama": _llama, "mistral": _mistral, "mixtral": _mixtral,
+    "gpt2": _gpt2, "opt": _opt, "phi": _phi, "phi3": _phi3,
+    "qwen2": _qwen2, "qwen2_moe": _qwen2_moe, "bloom": _bloom,
+    "falcon_mq": _falcon_mq, "falcon_new": _falcon_new,
+    "falcon_seq": _falcon_seq, "gptj": _gptj, "gptneox": _gptneox,
+}
+# MoE parity needs drop-free capacity (HF routes exactly; the training
+# einsum drops over-capacity tokens by design)
+OVERRIDES = {"mixtral": {"capacity_factor": 8.0},
+             "qwen2_moe": {"capacity_factor": 8.0}}
+
+
+def _save(tmp_path, name):
+    torch.manual_seed(0)
+    hf = CASES[name]().eval()
+    d = tmp_path / name
+    hf.save_pretrained(str(d), safe_serialization=True)
+    return hf, str(d)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_logits_match_hf(tmp_path, name):
+    hf, d = _save(tmp_path, name)
+    model, params = from_pretrained(d, **OVERRIDES.get(name, {}))
+    tokens = np.random.default_rng(0).integers(0, 250, (2, 16))
+    with torch.no_grad():
+        ref = hf(torch.tensor(tokens)).logits.float().numpy()
+    ours = np.asarray(model.apply(params, jnp.asarray(tokens)),
+                      dtype=np.float32)
+    scale = float(np.abs(ref).max())
+    np.testing.assert_allclose(ours, ref, atol=max(2e-4, 1e-3 * scale),
+                               rtol=0)
+
+
+def test_engine_reads_sharded_and_bin_checkpoints(tmp_path):
+    """Sharded safetensors (index.json) and pytorch_model.bin fallbacks
+    read identically to the single-file path."""
+    hf, d = _save(tmp_path, "llama")
+    m0, p0 = from_pretrained(d)
+    sh = tmp_path / "sharded"
+    hf.save_pretrained(str(sh), safe_serialization=True,
+                       max_shard_size="40KB")
+    assert (sh / "model.safetensors.index.json").exists()
+    m1, p1 = from_pretrained(str(sh))
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+        np.testing.assert_array_equal(a, b)
+    bn = tmp_path / "bin"
+    hf.save_pretrained(str(bn), safe_serialization=False)
+    m2, p2 = from_pretrained(str(bn))
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_config_translation_fields(tmp_path):
+    _, d = _save(tmp_path, "mistral")
+    eng = HuggingFaceCheckpointEngine(d)
+    assert eng.family == "mistral"
+    cfg = eng.model_config()
+    assert (cfg.hidden_size, cfg.num_layers, cfg.num_heads,
+            cfg.num_kv_heads) == (64, 2, 4, 2)
+    assert cfg.sliding_window == 8
+    assert cfg.norm_type == "rmsnorm"
+
+
+def test_init_inference_from_hf_dir(tmp_path):
+    """init_inference accepts an HF checkpoint path (reference:
+    inference/engine.py:326 checkpoint loading) and generates."""
+    import deepspeed_tpu as ds
+    hf, d = _save(tmp_path, "llama")
+    eng = ds.init_inference(d, dtype="float32", max_out_tokens=32)
+    out = eng.generate(jnp.asarray([[1, 2, 3, 4]]), max_new_tokens=4,
+                       do_sample=False)
+    assert out.shape == (1, 8)
+    # greedy continuation must match HF's
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([[1, 2, 3, 4]]), max_new_tokens=4,
+                          do_sample=False)
+    np.testing.assert_array_equal(np.asarray(out), ref.numpy())
+
+
+def test_v2_build_hf_engine_serves(tmp_path):
+    """FastGen parity: build_hf_engine(path) serves the real weights
+    (reference: engine_factory.py:69)."""
+    from deepspeed_tpu.inference.v2 import engine_factory
+    hf, d = _save(tmp_path, "llama")
+    eng = engine_factory.build_hf_engine(d)
+    toks = eng.generate([[1, 2, 3, 4]], max_new_tokens=3)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([[1, 2, 3, 4]]),
+                          max_new_tokens=3, do_sample=False)[0, 4:]
+    np.testing.assert_array_equal(np.asarray(toks[0]), ref.numpy())
+
+
+def test_finetune_pretrained_weights(tmp_path):
+    """initialize(model_parameters=loaded) trains from the real
+    weights — the finetuning entry (reference: initialize +
+    load_checkpoint flow)."""
+    import deepspeed_tpu as ds
+    _, d = _save(tmp_path, "llama")
+    model, params = from_pretrained(d)
+    engine, _, _, _ = ds.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    # engine starts from the loaded weights, not a fresh init
+    emb = np.asarray(jax.device_get(engine.state["params"]["embed"]["tokens"]))
+    np.testing.assert_allclose(emb, params["embed"]["tokens"], rtol=1e-6)
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(3):
+        t = rng.integers(0, 250, (8, 16))
+        losses.append(float(engine.train_batch(
+            {"tokens": t, "targets": t})))
+    assert losses[-1] < losses[0]

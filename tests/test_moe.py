@@ -147,14 +147,17 @@ def test_moe_serving_dispatch_wired(devices8):
     model = Mixtral(size="tiny", max_seq_len=64)
     assert model.moe_serving_dispatch is False
     eng = ds_.init_inference(model, dtype="float32", max_out_tokens=48)
-    assert model.moe_serving_dispatch is False     # opt-in, not default
+    assert eng.module.moe_serving_dispatch is False  # opt-in, not default
     eng = ds_.init_inference(model, dtype="float32", max_out_tokens=48,
                              moe_grouped_dispatch=True)
-    assert model.moe_serving_dispatch is True
+    # the flag binds to the engine's own shallow copy; the shared model
+    # instance is never mutated (ADVICE r4)
+    assert eng.module.moe_serving_dispatch is True
+    assert model.moe_serving_dispatch is False
     toks = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 512)
     out = eng.generate(toks, max_new_tokens=4)
     assert out.shape == (2, 12)
-    # training dispatch resets the serving flag on the shared instance
+    # training keeps the capacity einsum on the shared instance
     ds_.initialize(model=model, config={
         "train_batch_size": 8,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
