@@ -44,6 +44,21 @@ def _cpu_batch(per_dev: int = 2) -> int:
     return per_dev * len(jax.devices())
 
 
+def _mean_ci95(xs):
+    """(mean, t-distribution 95% half-width) over measurement windows.
+    A comparison claim is honest only when the CI excludes zero
+    (VERDICT r4 #7 — single best-of pairs swung with tunnel RTT)."""
+    import math
+    n = len(xs)
+    m = sum(xs) / n
+    if n < 2:
+        return m, float("inf")
+    var = sum((x - m) ** 2 for x in xs) / (n - 1)
+    t = {2: 12.706, 3: 4.303, 4: 3.182, 5: 2.776, 6: 2.571, 7: 2.447,
+         8: 2.365, 9: 2.306, 10: 2.262}.get(n, 2.0)
+    return m, t * math.sqrt(var / n)
+
+
 def _mfu_fields(tps: float, cfg, seq: int) -> dict:
     """Primary MFU is causal-physical accounting; the conventional
     full-attention figure rides along as mfu_noncausal for
@@ -304,17 +319,23 @@ def serving_bench(ds, on_tpu: bool):
                                        size=(B, P)))
     np.asarray(e.generate(prompts, max_new_tokens=N))   # warmup/compile
     np.asarray(e.generate(prompts, max_new_tokens=1))   # warm 1-token
-    reps = 3 if on_tpu else 1
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = e.generate(prompts, max_new_tokens=N)
-    np.asarray(out)
-    dt = (time.perf_counter() - t0) / reps
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out1 = e.generate(prompts, max_new_tokens=1)
-    np.asarray(out1)
-    dt1 = (time.perf_counter() - t0) / reps   # prefill + 1 decode step
+
+    def v1_pair(reps):
+        """(full-decode, one-token) wall times, best-of-reps each —
+        their difference isolates (N-1) compiled decode steps."""
+        dt_ = dt1_ = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = e.generate(prompts, max_new_tokens=N)
+            np.asarray(out)
+            dt_ = min(dt_, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            out1 = e.generate(prompts, max_new_tokens=1)
+            np.asarray(out1)
+            dt1_ = min(dt1_, time.perf_counter() - t0)
+        return dt_, dt1_
+
+    dt, dt1 = v1_pair(3 if on_tpu else 1)
     # v2 scheduler tick RTT (one bucketed decode tick through put())
     from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
                                             RaggedInferenceEngineConfig)
@@ -420,8 +441,21 @@ def serving_bench(ds, on_tpu: bool):
             ds_ = min(ds_, time.perf_counter() - t2)
         return max(dl - ds_, 1e-9) / (long_n - short_n) * 1e3, pools
 
-    v2_step_ms, pools = chain_pair_ms(e2.params, pools, args,
-                                      reps=3 if on_tpu else 1)
+    # paired windows: each window measures the v1 step AND the paged
+    # step back-to-back, so tunnel-RTT drift hits both sides alike;
+    # the per-window delta distribution carries the claim (CI95 must
+    # exclude zero — VERDICT r4 #7)
+    n_windows = 5 if on_tpu else 2
+    v1_steps, v2_steps = [], []
+    for _ in range(n_windows):
+        w_dt, w_dt1 = v1_pair(2 if on_tpu else 1)
+        v1_steps.append(max(w_dt - w_dt1, 1e-9) / max(N - 1, 1) * 1e3)
+        ms, pools = chain_pair_ms(e2.params, pools, args,
+                                  reps=2 if on_tpu else 1)
+        v2_steps.append(ms)
+    deltas = [a - b for a, b in zip(v1_steps, v2_steps)]
+    d_mean, d_ci = _mean_ci95(deltas)
+    v2_step_ms = sorted(v2_steps)[len(v2_steps) // 2]   # median window
 
     # short-context check (paged must also still win where it already
     # did): same differencing at ~32-token contexts
@@ -455,6 +489,12 @@ def serving_bench(ds, on_tpu: bool):
             "value": round(B * N / dt, 1), "unit": "tokens/s/chip",
             "batch": B, "with_prefill": round(B * (N + P) / dt, 1),
             "decode_step_ms_compute": round(decode_step_ms, 2),
+            "v1_step_ms_windows": [round(x, 2) for x in v1_steps],
+            "v2_step_ms_windows": [round(x, 2) for x in v2_steps],
+            "v1_minus_paged_delta_ms": round(d_mean, 3),
+            "paged_delta_ci95_ms": round(d_ci, 3),
+            # claimed only when the paired-window CI excludes zero
+            "paged_wins": bool(d_mean - d_ci > 0),
             "v2_paged_step_ms_compute": round(v2_step_ms, 2),
             "v2_paged_tokens_per_sec_compute": round(
                 n * 1e3 / v2_step_ms, 1),
@@ -496,22 +536,40 @@ def moe_serving_bench(ds, on_tpu: bool):
     prompts = jnp.asarray(rng.integers(0, moe.config.vocab_size,
                                        size=(B, P)))
 
-    def decode_tps(model, **ikw):
+    def make_engine(model, **ikw):
         e = ds.init_inference(model,
                               dtype="bfloat16" if on_tpu else "float32",
                               max_out_tokens=512 if on_tpu else 64,
                               **ikw)
         np.asarray(e.generate(prompts, max_new_tokens=N))  # warm
-        reps = 3 if on_tpu else 1
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = e.generate(prompts, max_new_tokens=N)
-        np.asarray(out)
-        return B * N / ((time.perf_counter() - t0) / reps)
+        return e
 
-    moe_tps = decode_tps(moe)
-    moe_q_tps = decode_tps(moe, quantize_moe_experts=True)
-    dense_tps = decode_tps(dense)
+    def timed(e, reps):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = e.generate(prompts, max_new_tokens=N)
+            np.asarray(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    e_bf16 = make_engine(moe)
+    e_int8 = make_engine(moe, quantize_moe_experts=True)
+    e_dense = make_engine(dense)
+    # paired windows (bf16 vs int8 back-to-back; the int8 claim is made
+    # only when the per-window delta's CI95 excludes zero — r4 #7)
+    n_windows = 5 if on_tpu else 2
+    t_bf16, t_int8 = [], []
+    for _ in range(n_windows):
+        t_bf16.append(timed(e_bf16, 2 if on_tpu else 1))
+        t_int8.append(timed(e_int8, 2 if on_tpu else 1))
+    dense_t = timed(e_dense, 3 if on_tpu else 1)
+    deltas = [a - b for a, b in zip(t_bf16, t_int8)]
+    d_mean, d_ci = _mean_ci95(deltas)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    moe_tps = B * N / med(t_bf16)
+    moe_q_tps = B * N / med(t_int8)
+    dense_tps = B * N / dense_t
     return {"metric": "mixtral_serving_decode_tokens_per_sec",
             "value": round(moe_q_tps, 1), "unit": "tokens/s/chip",
             "batch": B, "dense_equiv_tokens_per_sec": round(dense_tps, 1),
@@ -519,7 +577,12 @@ def moe_serving_bench(ds, on_tpu: bool):
             "experts_int8": True,
             "bf16_tokens_per_sec": round(moe_tps, 1),
             "bf16_routing_overhead": round(
-                dense_tps / max(moe_tps, 1e-9), 2)}
+                dense_tps / max(moe_tps, 1e-9), 2),
+            "bf16_s_windows": [round(x, 3) for x in t_bf16],
+            "int8_s_windows": [round(x, 3) for x in t_int8],
+            "bf16_minus_int8_delta_s": round(d_mean, 4),
+            "int8_delta_ci95_s": round(d_ci, 4),
+            "int8_wins": bool(d_mean - d_ci > 0)}
 
 
 def llama7b_streamed(ds, on_tpu: bool):
@@ -606,61 +669,52 @@ def nvme_streamed(ds, on_tpu: bool):
     reads (2 bytes/param) + a transient grad stack. Measured at ~0.9B
     params; the same path scales to any size the disk holds.
 
-    NOTE on this harness: the optimizer phase runs in the client
-    process (on a production pod the client IS the TPU host, so swap
-    reads/writes hit local NVMe at disk speed); through this dev
-    tunnel every model-scale byte the client touches crosses a WAN-
-    class link (measured as low as ~1 MB/s), so the section runs a
-    small config to bound wall time — the same code path was driven
-    at 0.9B+ and its scale bound is disk capacity, not model size.
-    tokens/s here is a tunnel-bound lower bound — the disk traffic is
-    reported separately."""
-    import shutil
-    from deepspeed_tpu.models import Llama
-    swap = "/tmp/ds_nvme_swap_bench"
-    if on_tpu:
-        model = Llama(hidden_size=512, num_layers=4, num_heads=8,
-                      num_kv_heads=8, intermediate_size=1408,
-                      vocab_size=32000, max_seq_len=512,
-                      tie_embeddings=False)
-        micro, seq, steps = 4, 512, 1
-    else:
-        model = Llama(size="tiny", max_seq_len=128, tie_embeddings=False)
-        micro, seq, steps = 2, 128, 1
-    engine, _, _, _ = ds.initialize(model=model, config={
-        "train_batch_size": micro,
-        "bf16": {"enabled": True},
-        "optimizer": {"type": "FusedAdam",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "gradient_clipping": 1.0,
-        "zero_optimization": {
-            "stage": 3,
-            "offload_param": {"device": "cpu", "stream": True},
-            "offload_optimizer": {"device": "nvme", "nvme_path": swap}},
-        "steps_per_print": 10 ** 9})
-    assert getattr(engine, "_nvme", False), type(engine)
-    tokens = jax.random.randint(jax.random.PRNGKey(0),
-                                (micro, seq + 1), 0,
-                                model.config.vocab_size)
-    data = (tokens[:, :-1], tokens[:, 1:])
-    loss = float(engine.train_batch(data))      # compile + step 1
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = float(engine.train_batch(data))
-    dt = (time.perf_counter() - t0) / steps
-    rpt = engine.host_memory_report()
-    io = engine._last_nvme_io
+    Measurement path (VERDICT r4 #4): the trajectory runs HOST-SIDE in
+    a subprocess on the local CPU backend — compute, pinned staging and
+    the AIO swap files all on one machine, exactly like a production
+    TPU host, with none of this dev harness's client<->chip tunnel in
+    the loop (through the tunnel every model-scale byte crosses a
+    WAN-class link, which benchmarks the tunnel, not the engine). The
+    config is >=1B parameters with >90% of optimizer state paged from
+    disk; a 20-step decreasing-loss run of the same tool is committed
+    at artifacts/nvme_1b_trajectory.json."""
+    import json as _json
+    import os
+    import subprocess
+    import sys as _sys
+    steps = 4 if on_tpu else 2
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORM_NAME", None)
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "nvme_1b_trajectory.py")
+    if not on_tpu:   # CPU smoke: the tiny in-process path is covered by
+        env["DS_NVME_TRAJ_TINY"] = "1"   # tests; keep the row cheap
+    try:
+        proc = subprocess.run([_sys.executable, tool, str(steps)],
+                              capture_output=True, text=True, env=env,
+                              timeout=3600)
+    except subprocess.TimeoutExpired as e:
+        return {"metric": "nvme_streamed_train_tokens_per_sec",
+                "error": f"host-side trajectory timed out after 3600s "
+                         f"({(e.stderr or '')[-200:]})"}
+    if proc.returncode != 0:
+        return {"metric": "nvme_streamed_train_tokens_per_sec",
+                "error": proc.stderr[-500:]}
+    res = _json.loads(proc.stdout.strip().splitlines()[-1])
     out = {"metric": "nvme_streamed_train_tokens_per_sec",
-           "value": round(micro * seq / dt, 1), "unit": "tokens/s/chip",
-           "params_b": round(model.config.num_params() / 1e9, 2),
-           "nvme_state_gib": round(rpt["nvme"] / 2 ** 30, 2),
-           "host_state_gib": round(rpt["pinned_host"] / 2 ** 30, 2),
-           "nvme_read_gib_per_step": round(io["read"] / 2 ** 30, 2),
-           "nvme_written_gib_per_step": round(io["written"] / 2 ** 30, 2),
-           "offloaded_fraction": round(rpt["offloaded_fraction"], 3),
-           "step_s": round(dt, 2), "loss": round(loss, 4)}
-    del engine
-    shutil.rmtree(swap, ignore_errors=True)
+           "value": res["tokens_per_sec"], "unit": "tokens/s (host-side)",
+           **{k: res[k] for k in (
+               "params_b", "offloaded_fraction", "nvme_state_gib",
+               "host_state_gib", "nvme_read_gib_per_step",
+               "nvme_written_gib_per_step", "step_s", "loss_first",
+               "loss_last", "steps", "platform")}}
+    art = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "artifacts", "nvme_1b_trajectory.json")
+    if os.path.exists(art):
+        with open(art) as f:
+            traj = _json.load(f)
+        out["trajectory_20step"] = {k: traj[k] for k in (
+            "steps", "loss_first", "loss_last", "monotone_after_2")}
     return out
 
 

@@ -10,14 +10,19 @@ as ``n_micro`` chunks inside one compiled region, and each chunk's tp
 all-reduce has no data dependence on the next chunk's GEMMs, leaving
 XLA free to interleave them.
 
-Measured status (r4, single-chip harness — see COVERAGE.md): AOT
-compilation for a v5e-2x4 topology shows XLA COMBINES the per-chunk
-all-reduces at typical sizes (equivalent comm pattern to unchunked) and
-emits per-chunk synchronous all-reduces at large payloads; whether the
-TPU runtime overlaps those with compute cannot be observed without a
-multi-chip profile. Chunking itself is measured free
-(bench.py domino_overlap_ratio ~=1), so enabling Domino never hurts;
-treat the overlap benefit as unverified on this backend.
+CLOSED as subsumed-by-XLA (r5; evidence: tools/domino_aot_evidence.py,
+AOT v5e-2x4 compilation). At typical payloads (<32 MiB/chunk) XLA's
+collective combiner MERGES the per-chunk all-reduces back into one per
+reduction point — the compiled comm pattern is identical to the
+unchunked layer, so Domino's restructuring adds nothing the compiler
+doesn't already do. At >=32 MiB/chunk the per-chunk reduces survive and
+sit between the chunk GEMM fusions in the instruction schedule, but the
+textual TPU HLO exposes no async all-reduce-start/done pairs even with
+the --xla_tpu_enable_async_collective_fusion flag family: whether those
+reduces overlap compute is the TPU runtime's scheduling decision and
+cannot be asserted at the HLO level. Chunking itself is measured free
+(bench.py domino_overlap_ratio ~=1), so enabling Domino never hurts —
+but its overlap benefit should be attributed to XLA, not this module.
 
 ``DominoTransformerLayer`` here is a functional layer usable standalone
 or as a template: given attention/mlp callables whose outputs need a tp
