@@ -294,6 +294,84 @@ def moe_bench(ds, on_tpu: bool):
             "value": round(tps, 1), "unit": "tokens/s/chip"}
 
 
+def _decode_chain_setup(model, e2, uids, use_kernel: bool):
+    """Shared scaffolding for the chain-differenced paged decode-step
+    measurement: build the single-token decode operands for `uids` (the
+    engine's own bucketing) and a make_chain(length) factory that scans
+    the paged step inside ONE jit — a whole chain of decode steps costs
+    one dispatch, so differencing two chain lengths cancels the
+    harness's per-dispatch RTT."""
+    import functools as _ft
+
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2.engine_v2 import _batch_bucket, _bucket
+    from deepspeed_tpu.inference.v2.paged import paged_forward
+
+    mgr = e2.state_manager
+    seqs = [mgr.seqs[u] for u in uids]
+    bb = _batch_bucket(len(seqs))
+    tok1 = np.zeros((bb, 1), np.int32)
+    pos0_a = np.zeros((bb,), np.int32)
+    tlen_a = np.zeros((bb,), np.int32)
+    tabs = np.stack([mgr.block_table(s) for s in seqs]
+                    + [mgr.block_table(seqs[0])] * (bb - len(seqs)))
+    for i, sq_ in enumerate(seqs):
+        tok1[i, 0] = 1
+        pos0_a[i] = sq_.seen
+        tlen_a[i] = 1
+    live_blocks = -(-int((pos0_a + tlen_a).max()) // mgr.block_size)
+    kb = min(_bucket(max(live_blocks, 1)), tabs.shape[1])
+    tabs = tabs[:, :kb]
+    fwd = _ft.partial(paged_forward, model, use_kernel=use_kernel)
+
+    def make_chain(length):
+        @jax.jit
+        def chain(params, pools, tokens, pos0, tables, tlen):
+            def body(pools, _):
+                lg, pools = fwd(params, pools, tokens, pos0, tables, tlen)
+                return pools, lg[0, 0]
+            pools, lgs = jax.lax.scan(body, pools, None, length=length)
+            return lgs, pools
+        return chain
+
+    args = (jnp.asarray(tok1), jnp.asarray(pos0_a), jnp.asarray(tabs),
+            jnp.asarray(tlen_a))
+    return make_chain, args
+
+
+def _chain_pair_ms(chain_l, chain_s, params, pools, args,
+                   long_n: int, short_n: int, reps: int = 3):
+    """best-of-reps for each chain length, then differenced: one
+    dispatch RTT (~0.1-0.5s through the dev tunnel) rides on each
+    timing, so a single pair is noise-bound — min over reps recovers
+    the device truth the differencing needs. Returns (ms/step, pools)."""
+    dl = ds_ = float("inf")
+    for _ in range(reps):
+        t2 = time.perf_counter()
+        lgs, pools = chain_l(params, pools, *args)
+        float(jnp.sum(lgs))
+        dl = min(dl, time.perf_counter() - t2)
+        t2 = time.perf_counter()
+        lgs, pools = chain_s(params, pools, *args)
+        float(jnp.sum(lgs))
+        ds_ = min(ds_, time.perf_counter() - t2)
+    return max(dl - ds_, 1e-9) / (long_n - short_n) * 1e3, pools
+
+
+def _tick_percentiles(one_tick, n: int):
+    """(p50, p99) wall-clock over n host-in-loop scheduler ticks."""
+    one_tick()                       # warm the decode bucket
+    ticks = []
+    for _ in range(n):
+        t1 = time.perf_counter()
+        one_tick()
+        ticks.append((time.perf_counter() - t1) * 1e3)
+    ticks.sort()
+    return (ticks[len(ticks) // 2],
+            ticks[min(len(ticks) - 1, int(len(ticks) * 0.99))])
+
+
 def serving_bench(ds, on_tpu: bool):
     """Serving class (BASELINE configs 1-2 / FastGen): greedy batch
     decode on the Llama-340M-class model. Reports the v1 engine's
@@ -356,15 +434,7 @@ def serving_bench(ds, on_tpu: bool):
         # (block_until_ready can return early under the remote tunnel)
         float(jnp.sum(next(iter(res.values()))))
 
-    one_tick()                  # warm the decode bucket's executable
-    ticks = []
-    for _ in range(24 if on_tpu else 4):
-        t1 = time.perf_counter()
-        one_tick()
-        ticks.append((time.perf_counter() - t1) * 1e3)
-    ticks.sort()
-    p50 = ticks[len(ticks) // 2]
-    p99 = ticks[min(len(ticks) - 1, int(len(ticks) * 0.99))]
+    p50, p99 = _tick_percentiles(one_tick, 24 if on_tpu else 4)
     # compute-basis per-token step time from the COMPILED decode loop:
     # marginal cost of (N-1) extra decode steps, so prefill + dispatch
     # are subtracted out. This is the device truth the v2 tick would see
@@ -373,73 +443,21 @@ def serving_bench(ds, on_tpu: bool):
     # per tick — a property of the measurement path, not the engine.
     decode_step_ms = max(dt - dt1, 1e-9) / max(N - 1, 1) * 1e3
 
-    # v2 paged-step device time: scan the step INSIDE one jit (pools
-    # ride the carry), so a whole chain of decode steps costs ONE
-    # dispatch, and differencing two chain lengths cancels it. The
-    # paged kernel reads only LIVE pages, vs the v1 static cache
-    # scanning all max_out_tokens slots — the FastGen memory-read
-    # advantage at realistic context lengths.
-    import functools as _ft
-
-    from deepspeed_tpu.inference.v2.engine_v2 import _batch_bucket, _bucket
-    from deepspeed_tpu.inference.v2.paged import paged_forward
-    mgr = e2.state_manager
-    seqs = [mgr.seqs[u] for u in uids]
-    bb = _batch_bucket(len(seqs))
-    tok1 = np.zeros((bb, 1), np.int32)
-    pos0_a = np.zeros((bb,), np.int32)
-    tlen_a = np.zeros((bb,), np.int32)
-    tabs = np.stack([mgr.block_table(s) for s in seqs]
-                    + [mgr.block_table(seqs[0])] * (bb - len(seqs)))
-    for i, sq_ in enumerate(seqs):
-        tok1[i, 0] = 1
-        pos0_a[i] = sq_.seen
-        tlen_a[i] = 1
-    # same live-context table narrowing the engine's _run applies
-    live_blocks = -(-int((pos0_a + tlen_a).max()) // mgr.block_size)
-    kb = min(_bucket(max(live_blocks, 1)), tabs.shape[1])
-    tabs = tabs[:, :kb]
-    fwd = _ft.partial(paged_forward, model, use_kernel=on_tpu)
-
-    def make_chain(length):
-        @jax.jit
-        def chain(params, pools, tokens, pos0, tables, tlen):
-            def body(pools, _):
-                lg, pools = fwd(params, pools, tokens, pos0, tables,
-                                tlen)
-                return pools, lg[0, 0]
-            pools, lgs = jax.lax.scan(body, pools, None, length=length)
-            return lgs, pools
-        return chain
-
-    # two chain lengths, differenced: dispatch/sync overhead (the
-    # harness tunnel's ~100 ms RTT) cancels exactly like the v1
-    # (dt - dt1) method above
+    # v2 paged-step device time (the paged kernel reads only LIVE
+    # pages, vs the v1 static cache scanning all max_out_tokens slots —
+    # the FastGen memory-read advantage at realistic context lengths)
+    make_chain, args = _decode_chain_setup(model, e2, uids,
+                                           use_kernel=on_tpu)
     long_n, short_n = (64, 8) if on_tpu else (4, 2)
     chain_l, chain_s = make_chain(long_n), make_chain(short_n)
-    args = (jnp.asarray(tok1), jnp.asarray(pos0_a), jnp.asarray(tabs),
-            jnp.asarray(tlen_a))
     pools = e2.pools
     for c in (chain_l, chain_s):                       # compile + warm
         lgs, pools = c(e2.params, pools, *args)
         float(jnp.sum(lgs))
 
     def chain_pair_ms(params, pools, args, reps=3):
-        """best-of-reps for each chain length, then differenced: one
-        dispatch RTT (~0.1-0.5s through the dev tunnel) rides on each
-        timing, so a single pair is noise-bound — min over reps
-        recovers the device truth the differencing needs."""
-        dl = ds_ = float("inf")
-        for _ in range(reps):
-            t2 = time.perf_counter()
-            lgs, pools = chain_l(params, pools, *args)
-            float(jnp.sum(lgs))
-            dl = min(dl, time.perf_counter() - t2)
-            t2 = time.perf_counter()
-            lgs, pools = chain_s(params, pools, *args)
-            float(jnp.sum(lgs))
-            ds_ = min(ds_, time.perf_counter() - t2)
-        return max(dl - ds_, 1e-9) / (long_n - short_n) * 1e3, pools
+        return _chain_pair_ms(chain_l, chain_s, params, pools, args,
+                              long_n, short_n, reps)
 
     # paired windows: each window measures the v1 step AND the paged
     # step back-to-back, so tunnel-RTT drift hits both sides alike;
@@ -465,17 +483,7 @@ def serving_bench(ds, on_tpu: bool):
             dtype="bfloat16", kv_block_size=64, num_kv_blocks=256,
             max_chunk_size=256))
         e3.put(uids, [prompts[i, :32].tolist() for i in range(n)])
-        mgr3 = e3.state_manager
-        seqs3 = [mgr3.seqs[u] for u in uids]
-        tabs3 = np.stack([mgr3.block_table(s) for s in seqs3]
-                         + [mgr3.block_table(seqs3[0])] * (bb - n))
-        pos3 = np.zeros((bb,), np.int32)
-        for i, sq_ in enumerate(seqs3):
-            pos3[i] = sq_.seen
-        kb3 = min(_bucket(max(-(-int(pos3.max() + 1)
-                               // mgr3.block_size), 1)), tabs3.shape[1])
-        args3 = (jnp.asarray(tok1), jnp.asarray(pos3),
-                 jnp.asarray(tabs3[:, :kb3]), jnp.asarray(tlen_a))
+        _, args3 = _decode_chain_setup(model, e3, uids, use_kernel=True)
         pools3 = e3.pools
         for c in (chain_l, chain_s):
             lgs, pools3 = c(e3.params, pools3, *args3)
@@ -583,6 +591,107 @@ def moe_serving_bench(ds, on_tpu: bool):
             "bf16_minus_int8_delta_s": round(d_mean, 4),
             "int8_delta_ci95_s": round(d_ci, 4),
             "int8_wins": bool(d_mean - d_ci > 0)}
+
+
+def serve7b_int8(ds, on_tpu: bool):
+    """Serve a 7B on ONE 16 GiB v5e (VERDICT r4 #5; reference serving
+    headline: FastGen Llama-2-70B on 4xA100, blogs/deepspeed-fastgen/
+    README.md:139, and the ZeRO-Inference weight-quantization recipe).
+
+    Weight-only int8 (linear/quantization.py quantize_dense_params)
+    puts the 6.74B-param dense tree at ~6.6 GiB beside a 2 GiB paged
+    KV pool. Weights are INITIALIZED ON DEVICE in bf16 and quantized
+    leaf-by-leaf with donation (peak HBM ~= bf16 tree + one leaf), so
+    nothing model-scale crosses the harness tunnel. Reported: decode
+    tokens/s from the chain-differenced paged step (device truth) +
+    host-in-loop tick p50/p99 (which ride the dev tunnel's RTT)."""
+    if not on_tpu:
+        return {"metric": "serve7b_int8", "skipped": "cpu rig"}
+    import functools as _ft
+
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    import jax.numpy as jnp
+
+    model = Llama(hidden_size=4096, num_layers=32, num_heads=32,
+                  num_kv_heads=32, intermediate_size=11008,
+                  vocab_size=32000, max_seq_len=2048, tie_embeddings=False,
+                  param_dtype=jnp.bfloat16)
+    # generate each leaf ALREADY quantized on device: the full-size
+    # bf16 tree never exists in HBM (13.4 GiB + temps + int8 would
+    # exceed the 16 GiB chip)
+    from deepspeed_tpu.linear.quantization import _q_leaf
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+
+    @_ft.partial(jax.jit, static_argnums=(1,))
+    def _rand_q(key, shape):
+        w = jax.random.normal(key, shape, jnp.bfloat16) * 0.02
+        return _q_leaf(w, jnp.bfloat16)
+
+    @_ft.partial(jax.jit, static_argnums=(1, 2))
+    def _rand(key, shape, dtype):
+        return jax.random.normal(key, shape, dtype) * 0.02
+
+    def build(tree, path=()):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = build(v, path + (k,))
+                continue
+            key = jax.random.fold_in(jax.random.PRNGKey(7),
+                                     hash(path + (k,)) % (1 << 30))
+            if (v.ndim >= 2 and min(v.shape[-2], v.shape[-1]) >= 64
+                    and "embed" not in path):
+                q, s = _rand_q(key, v.shape)
+                out[k + "_q"], out[k + "_s"] = q, s
+            else:
+                out[k] = _rand(key, v.shape, v.dtype)
+        return out
+
+    params = build(abstract)
+    B, P, N = 8, 256, 64
+    # SplitFuse chunk 64: the blocked-flash kernel carries ALL heads per
+    # grid block, and 32 heads x 256-token chunks overflow the 16 MiB
+    # VMEM scoped allocation (head-split grids are the follow-up)
+    e2 = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="bfloat16", kv_block_size=64, num_kv_blocks=72,
+        max_chunk_size=64, max_ragged_sequence_count=B), params=params)
+    int8_gib = sum(l.size for l in jax.tree.leaves(e2.params)
+                   if l.dtype == jnp.int8) / 2 ** 30
+    uids = list(range(B))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 32000, P).tolist() for _ in range(B)]
+    e2.put(uids, prompts)
+
+    def one_tick():
+        e2.schedule(uids, [[1]] * B, do_checks=False)
+        res = e2.tick()
+        float(jnp.sum(next(iter(res.values()))))
+
+    p50, p99 = _tick_percentiles(one_tick, 16)
+
+    # device-truth decode step: chain-differenced (shared scaffolding)
+    make_chain, args = _decode_chain_setup(model, e2, uids,
+                                           use_kernel=True)
+    long_n, short_n = 32, 8
+    chain_l, chain_s = make_chain(long_n), make_chain(short_n)
+    pools = e2.pools
+    for c in (chain_l, chain_s):
+        lgs, pools = c(e2.params, pools, *args)
+        float(jnp.sum(lgs))
+    step_ms, pools = _chain_pair_ms(chain_l, chain_s, e2.params, pools,
+                                    args, long_n, short_n, reps=3)
+    return {"metric": "serve7b_int8_decode_tokens_per_sec",
+            "value": round(B * 1e3 / step_ms, 1), "unit": "tokens/s/chip",
+            "batch": B, "params_b": round(
+                model.config.num_params() / 1e9, 2),
+            "weights_int8_gib": round(int8_gib, 2),
+            "context_tokens": P,
+            "decode_step_ms_compute": round(step_ms, 2),
+            "tick_p50_ms": round(p50, 1), "tick_p99_ms": round(p99, 1),
+            "tick_note": "host-in-loop ticks ride the dev tunnel RTT"}
 
 
 def llama7b_streamed(ds, on_tpu: bool):
@@ -714,7 +823,7 @@ def nvme_streamed(ds, on_tpu: bool):
         with open(art) as f:
             traj = _json.load(f)
         out["trajectory_20step"] = {k: traj[k] for k in (
-            "steps", "loss_first", "loss_last", "monotone_after_2")}
+            "steps", "loss_first", "loss_last", "decreasing")}
     return out
 
 
@@ -881,6 +990,7 @@ def main():
                      ("offload", offload_smoke),
                      ("domino", domino_bench),
                      ("kernel_smoke", lambda *_: kernel_smoke()),
+                     ("serve7b", serve7b_int8),
                      ("llama7b", llama7b_streamed),
                      ("nvme", nvme_streamed)]:
         try:

@@ -41,6 +41,11 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # and XLA fuses the dequant into the expert GEMM (moe/sharded_moe.py
     # quantize_experts). Single-replica serving only (tp=1).
     quantize_moe_experts: bool = False
+    # weight-only int8 for the WHOLE dense tree (layer matrices +
+    # lm_head; embedding stays float): ~2x fewer HBM weight bytes, the
+    # lever that fits a 7B on one 16 GiB v5e (reference: ZeRO-Inference
+    # weight quantization, blogs/README.md:36). Single-replica (tp=1).
+    quantize_weights: bool = False
     # opt-in sort-by-expert grouped-GEMM decode dispatch
     # (moe_ffn_grouped). Measured SLOWER than the einsum dispatch on
     # v5e decode shapes (ragged_dot lowering); kept for parity with the

@@ -66,6 +66,19 @@ class InferenceEngine:
                 params = self._load_checkpoint_params(config.checkpoint)
             else:
                 params = model.init(jax.random.PRNGKey(config.seed))
+        if config.quantize_weights:
+            if tp > 1:
+                raise NotImplementedError(
+                    "quantize_weights is a single-replica serving path "
+                    "(quantized leaves bypass the tp rule tables); "
+                    "shard OR quantize, not both")
+            # BEFORE the cast: host-resident checkpoints then move to
+            # HBM one leaf at a time as int8 — the full-size float tree
+            # never exists on device (a 7B bf16 tree would not fit a
+            # 16 GiB chip beside its own int8 copy)
+            from ..linear.quantization import quantize_dense_params
+            params = quantize_dense_params(params, scale_dtype=self.dtype)
+
         def cast(x):
             # inspect dtype without a device transfer (host checkpoints
             # can be huge); only floating leaves change dtype

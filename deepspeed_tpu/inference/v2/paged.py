@@ -119,9 +119,15 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                    if slopes is not None else None)
             # rows dead for EVERY q position hold pool garbage; zero
             # them on the v side too — p==0 alone doesn't protect the
-            # contraction (0 * NaN = NaN)
-            vmask = jnp.any(live, axis=0)                # [blk]
-            vclean = [jnp.where(vmask[:, None], v_ref_[0, :, g, :], 0)
+            # contraction (0 * NaN = NaN). Computed directly in [blk, 1]
+            # orientation (closed form of any(live, axis=0)): Mosaic
+            # cannot reshape an i1 vector to add a minor dim.
+            blk = k_ref_.shape[1]
+            kcol = base + jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
+            any_live = (kcol < limit) & (kcol - p0 < tl)
+            if window is not None:
+                any_live &= kcol - p0 + window > 0
+            vclean = [jnp.where(any_live, v_ref_[0, :, g, :], 0)
                       for g in range(hq // rep)]         # per kv head
             parts = []
             for h in range(hq):
@@ -263,6 +269,7 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
 
     def body(x, xs):
         p, k_pool, v_pool = xs
+        p = model._maybe_dequant(p, x.dtype)
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
         bs_ = k_pool.shape[1]

@@ -104,3 +104,74 @@ def dequantize_tree(tree: Any) -> Any:
     return jax.tree.map(
         lambda x: x.dequantized() if is_quantized(x) else x,
         tree, is_leaf=is_quantized)
+
+
+# ---------------------------------------------------------------------
+# Serving-side whole-tree weight-only int8 (reference: ZeRO-Inference
+# weight quantization + inference/v2 cutlass mixed_gemm — fp16
+# activations x int8 weights). Storage uses the same `name_q`/`name_s`
+# convention as moe/sharded_moe.quantize_experts, and DecoderLM
+# dequantizes per LAYER inside the scan body, so at no point does more
+# than one layer's bf16 weights exist in HBM — XLA fuses the
+# convert+scale into the consuming GEMM's operand read.
+
+def _q_leaf(w, scale_dtype):
+    s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.round(w.astype(jnp.float32) / s).astype(jnp.int8)
+    return q, s.astype(scale_dtype)
+
+
+def quantize_dense_params(params: Any, min_size: int = 1 << 16,
+                          scale_dtype=jnp.bfloat16,
+                          donate: bool = False) -> Any:
+    """Weight-only int8 over a DecoderLM param tree: every eligible
+    float leaf becomes `name_q` (int8) + `name_s` (per-output-channel
+    scale over the contraction dim, axis -2). Eligible = layer-stacked
+    matrices (ndim>=3 — per-layer [L, d] norm/bias VECTORS are never
+    scaled over the layer axis) and top-level 2-D matrices (lm_head);
+    the embedding table is skipped (its gather is not a GEMM).
+    Quantization runs leaf-at-a-time, so host checkpoints move to HBM
+    as int8 without the float tree ever existing on device.
+    ``donate=True`` additionally frees each input leaf's device buffer
+    as it converts (use ONLY for trees the caller owns — donated
+    arrays are deleted for every other holder)."""
+    q_jit = jax.jit(_q_leaf, static_argnums=(1,),
+                    donate_argnums=(0,) if donate else ())
+
+    def walk(tree, path=()):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = (v if k == "embed"
+                          else walk(v, path + (k,)))
+            elif (hasattr(v, "ndim")
+                    and (v.ndim >= 3
+                         or (v.ndim == 2 and "layers" not in path))
+                    and min(v.shape[-2], v.shape[-1]) >= 8
+                    and jnp.issubdtype(v.dtype, jnp.floating)
+                    and v.size >= min_size):
+                q, s = q_jit(v, scale_dtype)
+                out[k + "_q"], out[k + "_s"] = q, s
+            else:
+                out[k] = v
+        return out
+
+    return walk(params)
+
+
+def dequantize_dense(tree: dict, dtype) -> dict:
+    """Shallow inline dequant of one quantize_dense_params level (the
+    per-layer dict inside the scan body, or the top level for the
+    head); nested dicts pass through untouched (the MoE experts dict
+    dequantizes at its own use site, moe/sharded_moe.py)."""
+    if not any(k.endswith("_q") for k in tree):
+        return tree
+    out = {k: v for k, v in tree.items()
+           if not (k.endswith("_q") or k.endswith("_s"))}
+    for k in tree:
+        if k.endswith("_q"):
+            out[k[:-2]] = (tree[k].astype(dtype)
+                           * tree[k[:-2] + "_s"].astype(dtype))
+    return out

@@ -130,6 +130,15 @@ class DecoderLM:
         return params
 
     # ---------------- pieces (reused by pipeline/inference) --------------
+    def _maybe_dequant(self, p: PyTree, dtype) -> PyTree:
+        """Inline per-layer dequant of weight-only int8 serving trees
+        (linear/quantization.py quantize_dense_params): inside the layer
+        scan, at most ONE layer's bf16 weights ever exist and XLA fuses
+        the convert+scale into the consuming GEMM (reference:
+        ZeRO-Inference weight quantization / cutlass mixed_gemm)."""
+        from ..linear.quantization import dequantize_dense
+        return dequantize_dense(p, dtype)
+
     def _norm(self, x, scale, bias=None):
         if self.config.norm_type == "rmsnorm":
             return L.rms_norm(x, scale, self.config.norm_eps)
@@ -212,7 +221,7 @@ class DecoderLM:
         """One transformer block. layer_params carries per-layer slices
         (no leading L dim)."""
         c = self.config
-        p = layer_params
+        p = self._maybe_dequant(layer_params, x.dtype)
         if attn_fn is not None and c.sliding_window is not None:
             from ..utils.logging import warning_once
             warning_once(
@@ -348,7 +357,7 @@ class DecoderLM:
                      index: jax.Array):
         """One block over new tokens with cache read/write. x: [B, S_new,
         D]; caches [B, S_max, H_kv, D]. Returns (x, new_k, new_v)."""
-        p = layer_params
+        p = self._maybe_dequant(layer_params, x.dtype)
         b, s, _ = x.shape
         positions = (index + jnp.arange(s))[None, :].repeat(b, axis=0)
 
@@ -508,7 +517,12 @@ class DecoderLM:
         """Vocab projection of already-final-normed hidden states."""
         if self.config.tie_embeddings:
             return x @ params["embed"]["tokens"].T
-        out = x @ params["lm_head"]
+        if "lm_head_q" in params:   # weight-only int8 serving
+            W = (params["lm_head_q"].astype(x.dtype)
+                 * params["lm_head_s"].astype(x.dtype))
+        else:
+            W = params["lm_head"]
+        out = x @ W
         if "lm_head_b" in params:   # Phi / GPT-J biased head
             out = out + params["lm_head_b"]
         return out

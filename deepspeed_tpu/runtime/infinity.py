@@ -440,10 +440,16 @@ class StreamedZeroEngine:
         host_trees = [self.master_layers, self.m_layers, self.v_layers]
         if self._stream_separate:
             host_trees.append(self.stream_layers)
+        # arrays placed through _host_sh are the HOST TIER by design; on
+        # the CPU backend (tests, host-side nvme runs) there is no
+        # pinned_host memory kind so the designed placement is reported
+        # (everything there IS host memory)
+        on_tpu = jax.default_backend() == "tpu"
         for leaf in jax.tree.leaves([t for t in host_trees
                                      if t is not None]):
             kind = getattr(leaf.sharding, "memory_kind", None)
-            out["pinned_host" if kind == "pinned_host" else "device"] += \
+            host = kind == "pinned_host" or not on_tpu
+            out["pinned_host" if host else "device"] += \
                 int(leaf.size) * leaf.dtype.itemsize
         for leaf in jax.tree.leaves([self.dev_master, self.dev_m,
                                      self.dev_v]):

