@@ -634,16 +634,20 @@ def serve7b_int8(ds, on_tpu: bool):
     def _rand(key, shape, dtype):
         return jax.random.normal(key, shape, dtype) * 0.02
 
+    from deepspeed_tpu.linear.quantization import quantizable_leaf
+
     def build(tree, path=()):
+        import zlib
         out = {}
         for k, v in tree.items():
             if isinstance(v, dict):
                 out[k] = build(v, path + (k,))
                 continue
-            key = jax.random.fold_in(jax.random.PRNGKey(7),
-                                     hash(path + (k,)) % (1 << 30))
-            if (v.ndim >= 2 and min(v.shape[-2], v.shape[-1]) >= 64
-                    and "embed" not in path):
+            key = jax.random.fold_in(            # stable across runs
+                jax.random.PRNGKey(7),
+                zlib.crc32("/".join(path + (k,)).encode()))
+            if ("embed" not in path and v.ndim >= 2
+                    and quantizable_leaf(v.shape, v.ndim, path)):
                 q, s = _rand_q(key, v.shape)
                 out[k + "_q"], out[k + "_s"] = q, s
             else:
@@ -651,7 +655,7 @@ def serve7b_int8(ds, on_tpu: bool):
         return out
 
     params = build(abstract)
-    B, P, N = 8, 256, 64
+    B, P = 8, 256
     # SplitFuse chunk 64: the blocked-flash kernel carries ALL heads per
     # grid block, and 32 heads x 256-token chunks overflow the 16 MiB
     # VMEM scoped allocation (head-split grids are the follow-up)
@@ -704,25 +708,26 @@ def llama7b_streamed(ds, on_tpu: bool):
     is reported honestly alongside tokens/s."""
     from deepspeed_tpu.models import Llama
     if on_tpu:
+        # loss_chunk=256 (fused chunked cross-entropy) keeps the [B,S,V]
+        # logits slab out of HBM — that is what unlocks micro=12 (r4's
+        # micro=12 spilled activations at 0.042 MFU with full logits;
+        # micro=14 still OOMs). Per-token cost at ga-saturation is the
+        # per-micro weight stream, so micro 8 -> 12 is a direct 1.25x.
         model = Llama(hidden_size=4096, num_layers=32, num_heads=32,
                       num_kv_heads=32, intermediate_size=11008,
                       vocab_size=32000, max_seq_len=2048,
                       remat_policy="segments", attn_impl="flash",
-                      tie_embeddings=False)
-        # ga=16 amortizes the fixed master+moments stream over 16
-        # micro-batches (the optimizer stream runs once per step); bf16
-        # moments halve host state + D2H bytes. stream_dtype stays
-        # "master" (default): the bf16 stream stack measured NET
-        # NEGATIVE on this host (+13.5 GiB pinned pushed it into
-        # host-memory pressure: 107.5 vs 98.0 s/step at ga=8).
-        # micro=8 is the HBM sweet spot: at ga-saturation the per-TOKEN
-        # cost is the per-micro weight stream (81 GiB / 16k tokens), so
-        # a bigger micro would halve it — but micro=16 OOMs and
-        # micro=12 spills activations (measured 0.042 MFU); the ~0.31
-        # ceiling on 16 GiB HBM is set by that floor.
-        # Measured r4: ga=8 0.285 MFU, ga=16 0.308 MFU (from r3's
-        # 0.121 at ga=1).
-        micro, ga, seq, steps = 8, 16, 2048, 1
+                      loss_chunk=256, tie_embeddings=False)
+        # ga=24 amortizes the fixed master+moments stream further
+        # (runs once per step). stream_dtype stays "master": the bf16
+        # stream stack's +12 GiB pinned (60.3 GiB total) reproducibly
+        # KILLS the dev tunnel ("connection dropped 8 times") — this
+        # host's stable pinned envelope ends just above the 48.2 GiB
+        # master+moments footprint (r5, twice; r4 measured the same
+        # config net-negative before the cliff).
+        # Measured r5 ladder (ga, micro): (16,8) 0.309 -> (16,10)
+        # 0.345 -> (16,12)+loss_chunk 0.388 -> (24,12) 0.395 MFU.
+        micro, ga, seq, steps = 12, 24, 2048, 1
         batch = micro * ga
     else:
         model = Llama(size="tiny", max_seq_len=128, tie_embeddings=False)
@@ -823,7 +828,8 @@ def nvme_streamed(ds, on_tpu: bool):
         with open(art) as f:
             traj = _json.load(f)
         out["trajectory_20step"] = {k: traj[k] for k in (
-            "steps", "loss_first", "loss_last", "decreasing")}
+            "steps", "loss_first", "loss_last", "decreasing")
+            if k in traj}
     return out
 
 

@@ -170,7 +170,8 @@ class InferenceEngine:
     __call__ = forward
 
     def _build_generate(self, prompt_len: int, max_new: int,
-                        temperature: float, top_k: int, greedy: bool):
+                        temperature: float, top_k: int, top_p: float,
+                        greedy: bool):
         model = self.module
         cache_len = prompt_len + max_new
         # reference guard: _generate:608 rejects over-length sequences
@@ -193,6 +194,19 @@ class InferenceEngine:
                 logits = logits / temperature
             if top_k > 0:
                 kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -1e30, logits)
+            if 0.0 < top_p < 1.0:
+                # nucleus sampling: keep the smallest prefix of the
+                # probability-sorted vocab whose mass exceeds top_p
+                # (the first token past the threshold stays included,
+                # matching the HF implementation the reference
+                # delegates to)
+                srt = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < top_p          # [B, V] over sorted
+                kth = jnp.take_along_axis(
+                    srt, jnp.sum(keep, axis=-1, keepdims=True) - 1, -1)
                 logits = jnp.where(logits < kth, -1e30, logits)
             return jax.random.categorical(key, logits, axis=-1).astype(
                 jnp.int32)
@@ -221,18 +235,20 @@ class InferenceEngine:
 
     def generate(self, tokens, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0,
-                 do_sample: bool = False, seed: int = 0, **kwargs):
+                 top_p: float = 0.0, do_sample: bool = False,
+                 seed: int = 0, **kwargs):
         """Autoregressive generation (reference: _generate:608 delegates to
-        HF generate; here the loop itself is compiled)."""
+        HF generate; here the loop itself is compiled). top_p enables
+        nucleus sampling (composes with top_k/temperature)."""
         tokens = jnp.asarray(tokens)
         if tokens.ndim == 1:
             tokens = tokens[None, :]
         key = (tokens.shape[1], max_new_tokens, temperature, top_k,
-               not do_sample)
+               top_p, not do_sample)
         if key not in self._generate_fns:
             self._generate_fns[key] = self._build_generate(
                 tokens.shape[1], max_new_tokens, temperature, top_k,
-                greedy=not do_sample)
+                top_p, greedy=not do_sample)
         return self._generate_fns[key](self.params, tokens,
                                        jax.random.PRNGKey(seed))
 

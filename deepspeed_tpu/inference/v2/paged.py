@@ -50,7 +50,7 @@ def place_in_pages(pages: jax.Array, kv: jax.Array, pos0: jax.Array,
 
 def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                            pos0, true_len, *, window: int | None = None,
-                           alibi_slopes=None):
+                           alibi_slopes=None, sanitize_pools: bool = True):
     """Blocked-flash Pallas kernel (reference:
     inference/v2/kernels/ragged_ops/blocked_flash): attention reads KV
     pages straight from the pool through scalar-prefetched block tables —
@@ -121,14 +121,25 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
             # them on the v side too — p==0 alone doesn't protect the
             # contraction (0 * NaN = NaN). Computed directly in [blk, 1]
             # orientation (closed form of any(live, axis=0)): Mosaic
-            # cannot reshape an i1 vector to add a minor dim.
-            blk = k_ref_.shape[1]
-            kcol = base + jax.lax.broadcasted_iota(jnp.int32, (blk, 1), 0)
-            any_live = (kcol < limit) & (kcol - p0 < tl)
-            if window is not None:
-                any_live &= kcol - p0 + window > 0
-            vclean = [jnp.where(any_live, v_ref_[0, :, g, :], 0)
-                      for g in range(hq // rep)]         # per kv head
+            # cannot reshape an i1 vector to add a minor dim. Engines
+            # whose pools are zero-initialized pass sanitize_pools=False
+            # — garbage is unreachable there and the per-block selects
+            # cost real VPU time in the decode hot loop (measured ~1.8x
+            # on the 256-ctx tick).
+            if sanitize_pools:
+                blk = k_ref_.shape[1]
+                kcol = base + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk, 1), 0)
+                any_live = (kcol < limit) & (kcol - p0 < tl)
+                if window is not None:
+                    any_live &= kcol - p0 + window > 0
+                vclean = [jnp.where(any_live, v_ref_[0, :, g, :], 0)
+                          for g in range(hq // rep)]     # per kv head
+            else:
+                vclean = [v_ref_[0, :, g, :] for g in range(hq // rep)]
+                # zero-init pools: the cheap additive mask suffices
+                # (computed once, head-independent)
+                neg = jnp.where(live, 0.0, -1e30)
             parts = []
             for h in range(hq):
                 qv = q_ref[0, :, h, :]                      # [sq, d]
@@ -137,9 +148,11 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                             preferred_element_type=jnp.float32) * sc
                 if slopes is not None:
                     s = s + float(slopes[h]) * rel
-                # where() (not an additive -1e30) so NaN/Inf in dead
-                # KV-pool slots cannot poison the row softmax.
-                parts.append(jnp.where(live, s, -1e30))
+                # sanitize mode: where() (not an additive -1e30) so
+                # NaN/Inf in dead KV-pool slots cannot poison the row
+                # softmax
+                parts.append(jnp.where(live, s, -1e30)
+                             if sanitize_pools else s + neg)
             S = jnp.concatenate(parts, axis=0)           # [hq*sq, blk]
             m_prev = m_s[:, :1]
             l_prev = l_s[:, :1]
@@ -279,7 +292,11 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
             # static per-head slopes
             a = paged_attention_kernel(
                 q, k, v, k_pool, v_pool, block_tables, pos0, true_len,
-                window=model.config.sliding_window, alibi_slopes=alibi)
+                window=model.config.sliding_window, alibi_slopes=alibi,
+                # the engine's pools are zero-initialized (engine_v2
+                # __init__), so dead-slot garbage is unreachable and the
+                # sanitize selects would tax the decode hot loop
+                sanitize_pools=False)
         else:
             k_pages = place_in_pages(gather_pages(k_pool, block_tables),
                                      k, pos0, true_len)

@@ -123,6 +123,20 @@ def _q_leaf(w, scale_dtype):
     return q, s.astype(scale_dtype)
 
 
+def quantizable_leaf(shape, ndim: int, path: tuple,
+                     min_size: int = 1 << 16) -> bool:
+    """THE eligibility predicate for weight-only int8 leaves (shared by
+    quantize_dense_params and device-side generators like bench.py's
+    7B builder): layer-stacked matrices (ndim>=3 — per-layer [L, d]
+    norm/bias VECTORS must never be scaled over the layer axis) or
+    top-level 2-D matrices (lm_head), matrix-like trailing dims, and
+    big enough to be worth scales."""
+    import math
+    return ((ndim >= 3 or (ndim == 2 and "layers" not in path))
+            and min(shape[-2], shape[-1]) >= 8
+            and math.prod(shape) >= min_size)
+
+
 def quantize_dense_params(params: Any, min_size: int = 1 << 16,
                           scale_dtype=jnp.bfloat16,
                           donate: bool = False) -> Any:
@@ -146,12 +160,10 @@ def quantize_dense_params(params: Any, min_size: int = 1 << 16,
             if isinstance(v, dict):
                 out[k] = (v if k == "embed"
                           else walk(v, path + (k,)))
-            elif (hasattr(v, "ndim")
-                    and (v.ndim >= 3
-                         or (v.ndim == 2 and "layers" not in path))
-                    and min(v.shape[-2], v.shape[-1]) >= 8
+            elif (hasattr(v, "ndim") and v.ndim >= 2
                     and jnp.issubdtype(v.dtype, jnp.floating)
-                    and v.size >= min_size):
+                    and quantizable_leaf(v.shape, v.ndim, path,
+                                         min_size)):
                 q, s = q_jit(v, scale_dtype)
                 out[k + "_q"], out[k + "_s"] = q, s
             else:

@@ -12,6 +12,8 @@ and the final loss must fall below a fixed threshold for every precision
 / sharding / streaming configuration.
 """
 
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -104,3 +106,54 @@ def test_convergence_streamed(devices8):
     ), model=GPT2(size="tiny", vocab_size=VOCAB, max_seq_len=SEQ,
                   tie_embeddings=False))
     _assert_converged(losses)
+
+
+def test_real_corpus_convergence_artifact():
+    """Real-corpus convergence vs the independent flax/optax
+    implementation (VERDICT r4 #8; tools/convergence_real_corpus.py).
+    The committed artifact carries both 2000-step curves on the real
+    public-text corpus at identical hyperparameters (incl. GPT-2's
+    0.02-normal init family); this asserts the parity properties."""
+    import json
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                        "convergence_real_corpus.json")
+    if not os.path.exists(path):
+        import pytest
+        pytest.skip("artifact not present in this checkout")
+    with open(path) as f:
+        art = json.load(f)
+    eng, ref = art["engine_losses"], art["flax_losses"]
+    assert art["steps"] >= 1000 and len(eng) == len(ref)
+    # both learn substantially on real text
+    assert art["engine_final"] < 0.45 * eng[0]
+    assert art["flax_final"] < 0.45 * ref[0]
+    # final-loss parity between the engine and the independent impl
+    assert 0.9 < art["final_ratio"] < 1.1, art["final_ratio"]
+    # curves track each other throughout the second half of training
+    import numpy as np
+    e = np.asarray(eng[len(eng) // 2:])
+    r = np.asarray(ref[len(ref) // 2:])
+    assert np.abs(e - r).mean() / r.mean() < 0.12
+
+
+def test_real_corpus_tool_short_run(tmp_path):
+    """The convergence tool's code path end to end at toy scale: both
+    implementations run on the indexed real corpus and learn."""
+    import glob
+    import json
+    import subprocess
+    import sys
+    if not glob.glob("/root/reference/**/*.md", recursive=True):
+        pytest.skip("reference corpus not present on this rig")
+    tool = str(Path(__file__).resolve().parents[1]
+               / "tools" / "convergence_real_corpus.py")
+    out = tmp_path / "art.json"
+    proc = subprocess.run(
+        [sys.executable, tool, "30", "--tiny", "--out", str(out)],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    art = json.loads(out.read_text())
+    assert art["corpus_bytes"] > 500_000
+    assert art["engine_losses"][-1] < art["engine_losses"][0]
+    assert art["flax_losses"][-1] < art["flax_losses"][0]

@@ -188,3 +188,30 @@ def test_quantize_weights_int8_serving(devices8):
     a = np.array(e2.generate([[1, 2, 3, 4]], max_new_tokens=4))
     b = np.array(e2f.generate([[1, 2, 3, 4]], max_new_tokens=4))
     assert (a == b).mean() >= 0.5, (a, b)
+
+
+def test_top_p_nucleus_sampling(devices8):
+    """Nucleus sampling (reference delegates to HF generate's top_p):
+    sampled tokens must come only from the smallest probability mass
+    >= top_p, and compose with temperature/top_k."""
+    import numpy as np
+    model = Llama(size="tiny", max_seq_len=64)
+    eng = ds.init_inference(model, dtype="float32", max_out_tokens=64)
+    toks = jnp.asarray([[1, 2, 3, 4]])
+    # tight nucleus ~= greedy-ish: tokens must lie inside the nucleus
+    out = eng.generate(toks, max_new_tokens=6, do_sample=True,
+                       top_p=0.2, seed=3)
+    assert out.shape == (1, 10)
+    # verify the FIRST sampled token is inside the top-0.2 nucleus of
+    # the prefill distribution
+    logits = np.asarray(eng.forward(toks))[0, -1].astype(np.float64)
+    probs = np.exp(logits - logits.max())
+    probs /= probs.sum()
+    order = np.argsort(probs)[::-1]
+    cum = np.cumsum(probs[order])
+    nucleus = set(order[:int((cum - probs[order] < 0.2).sum())].tolist())
+    assert int(out[0, 4]) in nucleus
+    # composes with top_k and temperature without error
+    out2 = eng.generate(toks, max_new_tokens=4, do_sample=True,
+                        top_p=0.9, top_k=50, temperature=0.8, seed=0)
+    assert out2.shape == (1, 8)

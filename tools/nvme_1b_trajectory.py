@@ -104,8 +104,8 @@ def main() -> dict:
         # decisively decreasing: every loss in the last quarter of the
         # run sits below every loss in the first quarter (robust to the
         # small bounces of early Adam steps and near-zero noise)
-        "decreasing": bool(max(losses[-len(losses) // 4:])
-                           < min(losses[:len(losses) // 4])),
+        "decreasing": bool(max(losses[-max(len(losses) // 4, 1):])
+                           < min(losses[:max(len(losses) // 4, 1)])),
         "step_s": round(dt, 2),
         "tokens_per_sec": round(micro * seq / dt, 1),
         "compile_plus_first_step_s": round(compile_s, 1),
