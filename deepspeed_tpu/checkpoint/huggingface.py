@@ -119,15 +119,22 @@ class HuggingFaceCheckpointEngine:
         return key in self._torch()
 
     def get(self, key: str) -> np.ndarray:
-        """One tensor as float32 numpy (bf16/fp16 upcast here once; the
-        engine casts to its compute dtype on device_put)."""
+        """One tensor as numpy; floating dtypes upcast to float32 here
+        once (the engine casts to its compute dtype on device_put),
+        integer tensors keep their dtype — both backends agree."""
         if self._st_files:
             fname = self._st_files[key]
             if fname not in self._handles:
                 from safetensors import safe_open
                 self._handles[fname] = safe_open(fname, framework="np")
-            t = self._handles[fname].get_tensor(key)
-            return np.asarray(t, dtype=np.float32)
+            t = np.asarray(self._handles[fname].get_tensor(key))
+            # integer/bool tensors keep their dtype; anything else
+            # (incl. ml_dtypes bf16, which numpy reports as kind 'V')
+            # upcasts to f32 like the torch branch's .float()
+            if (np.issubdtype(t.dtype, np.integer)
+                    or np.issubdtype(t.dtype, np.bool_)):
+                return t
+            return t.astype(np.float32)
         t = self._torch()[key]
         return t.to_dense().float().numpy() if t.is_floating_point() \
             else t.numpy()

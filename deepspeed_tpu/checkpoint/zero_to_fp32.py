@@ -33,6 +33,14 @@ def _find_tag(checkpoint_dir: str, tag: Optional[str]) -> str:
     raise FileNotFoundError(f"no 'latest' file in {checkpoint_dir}; pass tag")
 
 
+def _tree_metadata(ckptr, path: str):
+    """The checkpoint's tree metadata across orbax versions: newer
+    releases wrap it in an object with ``.item_metadata``, 0.7.x
+    returns the tree directly."""
+    meta = ckptr.metadata(path)
+    return getattr(meta, "item_metadata", meta)
+
+
 def _restore_numpy(path: str):
     """Restore an orbax checkpoint as host numpy arrays (no shardings)."""
     import jax
@@ -40,7 +48,7 @@ def _restore_numpy(path: str):
     ckptr = ocp.PyTreeCheckpointer()
     # restore_args molded on the saved structure force plain-numpy leaves,
     # so consolidation works on any host (no accelerator, any device count)
-    meta = ckptr.metadata(path).item_metadata
+    meta = _tree_metadata(ckptr, path)
     restore_args = jax.tree.map(
         lambda _: ocp.RestoreArgs(restore_type=np.ndarray), meta)
     return ckptr.restore(path, restore_args=restore_args)
@@ -58,7 +66,7 @@ def _leaf_paths(path: str):
     WITHOUT restoring it."""
     import jax
     import orbax.checkpoint as ocp
-    meta = ocp.PyTreeCheckpointer().metadata(path).item_metadata
+    meta = _tree_metadata(ocp.PyTreeCheckpointer(), path)
     return [(tuple(_key_str(k) for k in p), m)
             for p, m in jax.tree_util.tree_flatten_with_path(meta)[0]], meta
 

@@ -16,6 +16,7 @@ compiled step so KV writes are in-place.
 from __future__ import annotations
 
 import functools
+from collections import deque
 from typing import Any, Optional, Sequence
 
 import jax
@@ -24,7 +25,7 @@ import numpy as np
 
 from ...utils.logging import log_dist
 from ..config import DeepSpeedInferenceConfig
-from .paged import paged_forward
+from .paged import fused_decode_loop, paged_forward
 from .ragged import DSStateManager, SequenceDescriptor
 
 PyTree = Any
@@ -51,11 +52,24 @@ def _batch_bucket(n: int) -> int:
 
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
-    (state_manager block/pool sizing knobs)."""
+    (state_manager block/pool sizing knobs + the fused-decode loop)."""
     kv_block_size: int = 64
     num_kv_blocks: int = 256
     max_ragged_sequence_count: int = 32   # decode-batch bucket ceiling
     max_chunk_size: int = 256             # prefill chunk (SplitFuse budget)
+    # K decode ticks fused into one on-device loop per host dispatch
+    # (decode_fused/generate_fused): forward, sampling, KV writes and
+    # EOS/budget termination all run in-graph, so decode throughput
+    # rides device compute instead of host dispatch RTT. 0/1 disables
+    # fusion (per-tick behavior).
+    fused_decode_steps: int = 8
+    # in-graph sampling defaults (per-call overrides win). temperature
+    # 0.0 = greedy; top_k/top_p 0 = no filter.
+    sampling_temperature: float = 0.0
+    sampling_top_k: int = 0
+    sampling_top_p: float = 0.0
+    # sequences terminate in-graph when they sample this token
+    eos_token_id: Optional[int] = None
 
 
 class InferenceEngineV2:
@@ -121,6 +135,14 @@ class InferenceEngineV2:
             donate_argnums=(1,),
             out_shardings=(None, {"k": self._pool_sharding,
                                   "v": self._pool_sharding}))
+        # fused-decode executables: one per (num_steps, sampling, eos)
+        # combination; XLA adds a per-bucket-shape cache underneath
+        self._fused_cache: dict = {}
+        # serving counters behind serving_metrics(): host dispatches vs
+        # decoded tokens measures how host-free the decode loop is
+        self.serving_stats = dict(
+            host_dispatches=0, fused_dispatches=0, fused_steps=0,
+            fused_slots=0, fused_slot_tokens=0, decoded_tokens=0)
         # SplitFuse budget, floored to a power of two (bucket shapes must
         # never exceed the configured compute budget)
         self._chunk = 1 << (max(1, config.max_chunk_size).bit_length() - 1)
@@ -164,6 +186,7 @@ class InferenceEngineV2:
         # padded rows must not write: true_len 0 drops their scatters.
         # logits come back already gathered at each row's last valid
         # token (logits_gather fused into the compiled step)
+        self.serving_stats["host_dispatches"] += 1
         logits, self.pools = self._step(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(pos0), jnp.asarray(tables), jnp.asarray(true_len))
@@ -285,13 +308,158 @@ class InferenceEngineV2:
             self._finished_stash.pop(int(u), None)
 
     # ------------------------------------------------------------------
+    # fused multi-step decode: K ticks per host dispatch, sampling and
+    # termination in-graph (the FastGen kernel-resident decode loop)
+
+    def _sampling_args(self, temperature, top_k, top_p, eos_id):
+        """Per-call overrides over the config's sampling defaults."""
+        c = self._config
+        return (float(c.sampling_temperature if temperature is None
+                      else temperature),
+                int(c.sampling_top_k if top_k is None else top_k),
+                float(c.sampling_top_p if top_p is None else top_p),
+                (c.eos_token_id if eos_id is None else int(eos_id)))
+
+    def _fused_fn(self, num_steps: int, temperature: float, top_k: int,
+                  top_p: float, eos_id: Optional[int]):
+        key = (num_steps, temperature, top_k, top_p, eos_id)
+        if key not in self._fused_cache:
+            tp = self._v1.topology.model_parallel_size
+            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            self._fused_cache[key] = jax.jit(
+                functools.partial(
+                    fused_decode_loop, self.model, num_steps=num_steps,
+                    eos_id=eos_id, temperature=temperature, top_k=top_k,
+                    top_p=top_p, use_kernel=(tp <= 1)),
+                donate_argnums=(1,),
+                out_shardings=(None, None, None, None, None, None,
+                               pool_sh))
+        return self._fused_cache[key]
+
+    def _fused_operands(self, uids: list[int], k: int,
+                        budgets: dict[int, int], seed: int):
+        """Host-side build of one fused dispatch's operands. Every uid
+        must have exactly ONE pending token (its next input — the last
+        sampled/committed token); blocks covering the dispatch horizon
+        are preallocated here so the in-graph KV writes always land in
+        real blocks."""
+        mgr = self.state_manager
+        seqs = [mgr.seqs[u] for u in uids]
+        for u, s in zip(uids, seqs):
+            if s.pending != 1:
+                raise RuntimeError(
+                    f"fused decode: sequence {u} must have exactly one "
+                    f"pending token (the dispatch input), got {s.pending}")
+            mgr.reserve(u, min(k, max(int(budgets[u]), 1)))
+        bb = _batch_bucket(len(seqs))
+        tokens = np.zeros((bb,), np.int32)
+        pos = np.zeros((bb,), np.int32)
+        act = np.zeros((bb,), bool)
+        rem = np.zeros((bb,), np.int32)
+        for i, (u, s) in enumerate(zip(uids, seqs)):
+            tokens[i] = s.tokens[-1]
+            pos[i] = s.seen
+            act[i] = budgets[u] > 0
+            rem[i] = budgets[u]
+        tables = np.stack([mgr.block_table(s) for s in seqs]
+                          + [mgr.block_table(seqs[0])] * (bb - len(seqs)))
+        # narrow to the blocks actually held (context + reserved
+        # horizon) — bounded executables per power-of-two width
+        kb = min(_bucket(max(max(len(s.blocks) for s in seqs), 1)),
+                 tables.shape[1])
+        tables = tables[:, :kb]
+        # per-row PRNG keys: uid folded into the base key (pad rows get
+        # sentinel ids); each loop step folds in the token position, so
+        # sampling is invariant to the dispatch grouping
+        base = jax.random.PRNGKey(seed)
+        ids = jnp.asarray(list(uids)
+                          + [(1 << 30) + i for i in range(bb - len(uids))],
+                          jnp.uint32)
+        row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(ids)
+        return (jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(tables),
+                jnp.asarray(act), jnp.asarray(rem), row_keys)
+
+    def decode_fused(self, batch_uids: Sequence[int],
+                     k_steps: Optional[int] = None, *,
+                     budgets: Optional[dict[int, int]] = None,
+                     temperature: Optional[float] = None,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
+                     eos_id: Optional[int] = None,
+                     seed: int = 0) -> dict[int, list[int]]:
+        """ONE fused dispatch: advance every uid up to
+        ``min(k_steps, budgets[uid])`` tokens inside a single compiled
+        while_loop — forward, sampling, KV writes and EOS/budget
+        termination all on device. Each uid needs exactly one pending
+        token (e.g. from put() + a sampled continuation, or a previous
+        decode_fused). Generated tokens are committed to the sequence
+        state; the last one stays pending as the next dispatch's input.
+        Returns {uid: [sampled tokens]} (a row that sampled ``eos_id``
+        includes it and stops)."""
+        uids = [int(u) for u in batch_uids]
+        if not uids:
+            return {}
+        cfg = self._config
+        k = max(1, int(k_steps if k_steps is not None
+                       else (cfg.fused_decode_steps or 8)))
+        temperature, top_k, top_p, eos = self._sampling_args(
+            temperature, top_k, top_p, eos_id)
+        b = {u: int(budgets[u]) if budgets is not None else k
+             for u in uids}
+        ops = self._fused_operands(uids, k, b, seed)
+        fn = self._fused_fn(k, temperature, top_k, top_p, eos)
+        st = self.serving_stats
+        st["host_dispatches"] += 1
+        st["fused_dispatches"] += 1
+        out, steps, _, _, _, _, self.pools = fn(
+            self.params, self.pools, *ops)
+        toks = np.asarray(out)[:len(uids)]
+        mgr = self.state_manager
+        res: dict[int, list[int]] = {}
+        for i, u in enumerate(uids):
+            row = [int(t) for t in toks[i] if t >= 0]
+            mgr.commit_device_tokens(u, row)
+            res[u] = row
+            st["decoded_tokens"] += len(row)
+            st["fused_slot_tokens"] += len(row)
+        n_exec = int(steps)
+        st["fused_steps"] += n_exec
+        st["fused_slots"] += n_exec * len(uids)
+        return res
+
+    def serving_metrics(self) -> dict:
+        """Decode-loop efficiency counters (monitor/bench surface):
+        ``dispatches_per_token`` — host dispatches per decoded token
+        (1.0 = per-tick; ~1/K with the fused loop) and
+        ``fused_occupancy`` — fraction of LIVE (row, step) slots in
+        fused dispatches that produced a token (1.0 = every scheduled
+        row decoded every step; rows going EOS/budget-inactive mid-loop
+        lower it). Pad rows added by the batch bucketing are not
+        counted — this measures scheduling efficiency over real
+        sequences, not device utilization of the padded bucket."""
+        st = dict(self.serving_stats)
+        st["dispatches_per_token"] = (
+            st["host_dispatches"] / max(st["decoded_tokens"], 1))
+        st["fused_occupancy"] = (
+            st["fused_slot_tokens"] / max(st["fused_slots"], 1))
+        return st
+
+    def reset_serving_metrics(self) -> None:
+        for k in self.serving_stats:
+            self.serving_stats[k] = 0
+
+    # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
-                 max_new_tokens: int = 32) -> list[list[int]]:
+                 max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None) -> list[list[int]]:
         """Greedy continuous batching driver over schedule()/tick():
         admits prompts as KV blocks free up — including mid-prefill of
         other prompts, since admission happens between ticks — and
         decodes all live sequences together each tick. What DeepSpeed-MII
-        implements on top of put() (reference: mii serving loop)."""
+        implements on top of put() (reference: mii serving loop).
+        ``eos_id`` stops a sequence once it samples that token (the
+        token is included in its output). One host round trip per
+        decoded token — generate_fused() is the production path."""
         mgr = self.state_manager
         bs = mgr.block_size
         pending = list(enumerate([list(map(int, p)) for p in prompts]))
@@ -348,7 +516,9 @@ class InferenceEngineV2:
                     self._finished_stash[u] = finished[u]
                     continue
                 live[u].append(int(jnp.argmax(finished[u])))
-                if len(live[u]) >= max_new_tokens:
+                self.serving_stats["decoded_tokens"] += 1
+                if (len(live[u]) >= max_new_tokens
+                        or (eos_id is not None and live[u][-1] == eos_id)):
                     results[u] = live.pop(u)[:max_new_tokens]
                     reserved.pop(u)
                     self.flush(u)
@@ -359,4 +529,207 @@ class InferenceEngineV2:
                               [[live[u][-1]] for u in decode_uids],
                               do_checks=False)  # blocks pre-reserved
             admit()
+        return [results[i] for i in range(len(prompts))]
+
+    # ------------------------------------------------------------------
+    def generate_fused(self, prompts: Sequence[Sequence[int]],
+                       max_new_tokens: int = 32, *,
+                       k_steps: Optional[int] = None,
+                       temperature: Optional[float] = None,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None,
+                       eos_id: Optional[int] = None,
+                       seed: int = 0) -> list[list[int]]:
+        """Continuous batching where the host is ONLY an admission
+        layer: every live sequence advances up to K tokens per dispatch
+        inside the fused on-device loop (sampling, KV writes and
+        EOS/budget termination in-graph), so decode throughput rides
+        K·compute per host round trip instead of one RTT per token.
+
+        Between dispatches the host admits new prompts, prefills them
+        through the bucketed chunk path, and drains finished tokens
+        from the dispatch's output ring buffer. Transfers are
+        double-buffered: while a dispatch runs on device, the host
+        drains the PREVIOUS dispatch's ring buffer — chaining works
+        because the loop's carry (next tokens, positions, active masks)
+        stays on device, so dispatch N+1 needs no host read of
+        dispatch N. Greedy decode is token-identical to generate();
+        stochastic decode is dispatch-schedule-invariant (position-keyed
+        sampling), so per-tick and fused-K agree there too."""
+        cfg = self._config
+        k = max(1, int(k_steps if k_steps is not None
+                       else (cfg.fused_decode_steps or 8)))
+        temperature, top_k, top_p, eos = self._sampling_args(
+            temperature, top_k, top_p, eos_id)
+        mgr = self.state_manager
+        bs = mgr.block_size
+        stats = self.serving_stats
+        pending = list(enumerate([list(map(int, p)) for p in prompts]))
+        live: dict[int, list[int]] = {}
+        results: dict[int, list[int]] = {}
+        to_flush: list[int] = []
+        max_live = self._config.max_ragged_sequence_count
+
+        def admit() -> list[int]:
+            """Admit pending prompts, ALLOCATING the full worst-case
+            block budget (prompt + max_new) up front: fused dispatches
+            write KV in-graph through a table fixed at build time, so
+            every block a sequence can ever touch must exist before its
+            first dispatch (the per-tick driver only *reserves* this
+            budget arithmetically)."""
+            batch: list[tuple[int, list[int]]] = []
+            free = mgr.allocator.free_blocks
+            while pending and len(live) + len(batch) < max_live:
+                uid, prompt = pending[0]
+                need = -(-(len(prompt) + max_new_tokens) // bs)
+                if need > mgr.max_blocks_per_seq or \
+                        need > mgr.allocator.num_blocks:
+                    raise ValueError(
+                        f"prompt {uid}: {len(prompt)} tokens + "
+                        f"{max_new_tokens} new can never fit the KV pool "
+                        f"(needs {need} blocks)")
+                if need > free:
+                    break
+                pending.pop(0)
+                free -= need
+                batch.append((uid, prompt))
+            if not batch:
+                return []
+            self.schedule([u for u, _ in batch], [p for _, p in batch])
+            for uid, _ in batch:
+                mgr.reserve(uid, max_new_tokens)
+                live[uid] = []
+            return [u for u, _ in batch]
+
+        def finish(uid: int) -> None:
+            results[uid] = live.pop(uid)[:max_new_tokens]
+            to_flush.append(uid)
+
+        def prefill(uids_new: list[int]) -> None:
+            """Chunked prefill of newly admitted prompts, then the first
+            generated token — sampled with the same op and position
+            keying as the in-graph loop, so it belongs to the same
+            stochastic stream."""
+            from ...ops import sampling
+            filling = list(uids_new)
+            firsts: dict[int, jnp.ndarray] = {}
+            while filling:
+                run = [u for u in filling if mgr.seqs[u].pending]
+                logits = self._run(run)
+                for i, u in enumerate(run):
+                    if not mgr.seqs[u].pending:
+                        firsts[u] = logits[i]
+                        filling.remove(u)
+            for u, lg in firsts.items():
+                key = sampling.position_keys(
+                    jax.random.fold_in(jax.random.PRNGKey(seed),
+                                       jnp.uint32(u))[None],
+                    jnp.asarray([mgr.seqs[u].seen]))
+                tok = int(sampling.sample_tokens_batched(
+                    jnp.asarray(lg)[None].astype(jnp.float32), key,
+                    temperature=temperature, top_k=top_k, top_p=top_p)[0])
+                live[u].append(tok)
+                stats["decoded_tokens"] += 1
+                if max_new_tokens <= 1 or (eos is not None and tok == eos):
+                    finish(u)
+                else:
+                    # the first token becomes the pending input of the
+                    # first fused dispatch (blocks preallocated)
+                    mgr.extend(u, [tok])
+
+        fn = self._fused_fn(k, temperature, top_k, top_p, eos)
+        infl: deque = deque()   # in-flight dispatches (double buffer)
+        carry = None            # device-side loop carry for `rowset`
+        rowset: list[int] = []
+        budgets: dict[int, int] = {}
+        tables = row_keys = None
+        n_enq = 0               # dispatches chained since last rebuild
+
+        while live or pending or infl:
+            if not live and not infl:
+                for u in to_flush:
+                    self.flush(u)
+                to_flush.clear()
+                ids = admit()
+                if not ids:
+                    raise RuntimeError(
+                        "continuous-batching deadlock: pending prompts "
+                        "but nothing admissible")
+                carry = None
+                prefill(ids)
+                continue
+
+            # enqueue: ≤2 dispatches in flight. The first after a
+            # rebuild always goes; a chained one only when no admission
+            # is waiting and some row's budget can outlast the chain.
+            while live and len(infl) < 2:
+                if carry is None and infl:
+                    # rebuild needs the in-flight dispatch's commits
+                    # first — rebuilding from stale host state would
+                    # replay its decode steps
+                    break
+                if carry is None:
+                    rowset = sorted(live)
+                    budgets = {u: max_new_tokens - len(live[u])
+                               for u in rowset}
+                    (tok_a, pos_a, tables, act_a, rem_a,
+                     row_keys) = self._fused_operands(
+                         rowset, k, budgets, seed)
+                    n_enq = 0
+                else:
+                    tok_a, pos_a, act_a, rem_a = carry
+                if n_enq > 0 and (pending
+                                  or max(budgets.values()) <= k * n_enq):
+                    break
+                out, steps, t2, p2, a2, r2, self.pools = fn(
+                    self.params, self.pools, tok_a, pos_a, tables,
+                    act_a, rem_a, row_keys)
+                carry = (t2, p2, a2, r2)
+                n_enq += 1
+                infl.append((list(rowset), out, steps))
+                stats["host_dispatches"] += 1
+                stats["fused_dispatches"] += 1
+
+            if not infl:          # chain declined to enqueue: rebuild
+                carry = None
+                continue
+            # drain the OLDEST dispatch's ring buffer (device may still
+            # be running the newer chained one — that's the overlap)
+            rows, out, steps = infl.popleft()
+            toks = np.asarray(out)
+            n_exec = int(steps)
+            stats["fused_steps"] += n_exec
+            stats["fused_slots"] += n_exec * len(rows)
+            membership_changed = False
+            for i, u in enumerate(rows):
+                if u not in live:     # finished in an earlier dispatch
+                    continue
+                row = [int(t) for t in toks[i] if t >= 0]
+                if not row:
+                    continue
+                mgr.commit_device_tokens(u, row)
+                live[u].extend(row)
+                stats["decoded_tokens"] += len(row)
+                stats["fused_slot_tokens"] += len(row)
+                if (len(live[u]) >= max_new_tokens
+                        or (eos is not None and row[-1] == eos)):
+                    finish(u)
+                    membership_changed = True
+            if membership_changed or pending:
+                # a finished row's slot should go to a waiting prompt;
+                # rebuild operands once the in-flight chain drains
+                carry = None
+            if not infl:
+                # nothing in flight references the old tables/blocks:
+                # safe to recycle KV blocks and admit
+                for u in to_flush:
+                    self.flush(u)
+                to_flush.clear()
+                ids = admit()
+                if ids:
+                    carry = None
+                    prefill(ids)
+
+        for u in to_flush:
+            self.flush(u)
         return [results[i] for i in range(len(prompts))]

@@ -332,3 +332,78 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     x_last = x[jnp.arange(b), idx]                      # [B, D]
     logits = model.unembed(params, x_last[:, None, :])[:, 0]
     return logits, new_pools
+
+
+def fused_decode_loop(model, params: PyTree, pools: PyTree,
+                      tokens: jax.Array, pos: jax.Array,
+                      block_tables: jax.Array, active: jax.Array,
+                      remaining: jax.Array, row_keys: jax.Array, *,
+                      num_steps: int, eos_id: int | None,
+                      temperature: float, top_k: int, top_p: float,
+                      use_kernel: bool = True):
+    """Up to ``num_steps`` decode ticks in ONE compiled program: forward
+    -> in-graph sampling -> feed the sampled token back as the next
+    step's input, with KV writes, EOS/budget termination masks and the
+    output ring buffer all on device (the kernel-resident analogue of
+    the reference FastGen's ragged decode loop — no host in the loop).
+
+    Per-sequence state rides the ``lax.while_loop`` carry:
+
+    - ``tokens`` [B] int32 — each row's last sampled token, committed to
+      the history but NOT yet in the KV cache (iteration j writes it at
+      position ``pos`` and samples its successor).
+    - ``pos`` [B] int32 — tokens already cached (= the write position).
+    - ``active`` [B] bool — rows that still decode. A row goes inactive
+      in-graph when it samples ``eos_id`` or exhausts ``remaining``;
+      inactive rows stop writing KV (true_len 0) and stop emitting, so
+      sequences finish mid-loop without a host check.
+    - ``remaining`` [B] int32 — how many more tokens the row may emit.
+    - ``row_keys`` [B, 2] — per-row PRNG keys; each step folds in the
+      sampled token's absolute position (ops/sampling.position_keys),
+      so stochastic decode is invariant to how steps group into
+      dispatches.
+
+    ``block_tables`` must already cover every position the loop can
+    write (``pos + num_steps``) — the host preallocates blocks
+    (``DSStateManager.reserve``) so the table is static across the
+    fused dispatch while the per-token block/offset arithmetic happens
+    in-graph. The loop exits early once every row is inactive.
+
+    Returns ``(out_tokens [B, num_steps] (-1 beyond each row's emits),
+    steps_run [], tokens, pos, active, remaining, pools)`` — the carry
+    comes back so the host (or a chained dispatch) can continue without
+    reading anything but the ring buffer.
+    """
+    from ...ops import sampling
+
+    b = tokens.shape[0]
+    out0 = jnp.full((b, num_steps), -1, jnp.int32)
+    eos = -1 if eos_id is None else int(eos_id)
+
+    def cond(st):
+        step, _, _, active = st[0], st[1], st[2], st[3]
+        return (step < num_steps) & jnp.any(active)
+
+    def body(st):
+        step, tokens, pos, active, remaining, pools, out = st
+        tl = active.astype(jnp.int32)   # inactive rows write nothing
+        logits, pools = paged_forward(
+            model, params, pools, tokens[:, None], pos, block_tables,
+            tl, use_kernel=use_kernel)
+        # the sampled token's absolute index is pos + 1 (its input sits
+        # at pos); keying on it makes sampling dispatch-schedule-free
+        keys = sampling.position_keys(row_keys, pos + 1)
+        nxt = sampling.sample_tokens_batched(
+            logits, keys, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+        out = out.at[:, step].set(jnp.where(active, nxt, -1))
+        pos = pos + tl
+        remaining = remaining - tl
+        alive = active & (remaining > 0) & (nxt != eos)
+        tokens = jnp.where(active, nxt, tokens)
+        return step + 1, tokens, pos, alive, remaining, pools, out
+
+    step, tokens, pos, active, remaining, pools, out = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), tokens, pos, active,
+                     remaining, pools, out0))
+    return out, step, tokens, pos, active, remaining, pools

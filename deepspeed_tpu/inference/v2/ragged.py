@@ -97,6 +97,50 @@ class DSStateManager:
         seq.tokens.extend(int(t) for t in tokens)
         return seq
 
+    def reserve(self, uid: int, future_tokens: int) -> int:
+        """Preallocate blocks so the sequence can grow by
+        ``future_tokens`` WITHOUT further allocation. Required before a
+        fused decode dispatch: its in-graph KV writes advance through
+        the block table with no host in the loop, so every position the
+        device may write must already map to a real block. Idempotent —
+        only the missing delta is allocated. Returns the number of
+        blocks newly allocated."""
+        seq = self.seqs[uid]
+        need = self.blocks_needed(seq, future_tokens)
+        if need == 0:
+            return 0
+        if len(seq.blocks) + need > self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"sequence {uid}: reserving {future_tokens} future tokens "
+                f"exceeds the max length "
+                f"({self.max_blocks_per_seq * self.block_size} tokens)")
+        seq.blocks.extend(self.allocator.allocate(need))
+        return need
+
+    def commit_device_tokens(self, uid: int, tokens: list[int]) -> None:
+        """Append tokens a fused dispatch generated ON DEVICE. Their KV
+        entries (all but the last token's) were already written in-graph,
+        so ``seen`` advances with the history: afterwards exactly the
+        last generated token is pending — it is the next dispatch's
+        input. Blocks must have been preallocated via :meth:`reserve`
+        (the device wrote through them)."""
+        if not tokens:
+            return
+        seq = self.seqs[uid]
+        if seq.pending != 1:
+            raise RuntimeError(
+                f"sequence {uid}: commit_device_tokens expects exactly "
+                f"one pending token (the dispatch input), got "
+                f"{seq.pending}")
+        total = len(seq.tokens) + len(tokens)
+        if -(-total // self.block_size) > len(seq.blocks):
+            raise RuntimeError(
+                f"sequence {uid}: device wrote past its reserved blocks "
+                f"({total} tokens, {len(seq.blocks)} blocks) — reserve() "
+                "was not called before the fused dispatch")
+        seq.tokens.extend(int(t) for t in tokens)
+        seq.seen += len(tokens)
+
     def flush(self, uid: int) -> None:
         """Release a finished sequence (reference: engine_v2.flush:242)."""
         seq = self.seqs.pop(uid, None)

@@ -134,7 +134,12 @@ class Qwen2MoE(Mixtral):
         out, aux = super()._mlp(p, h)
         if "shared" not in p:
             return out, aux
-        sh = p["shared"]
+        # weight-only int8 serving (quantize_dense_params) quantizes the
+        # shared-expert matrices like any other layer-stacked leaves;
+        # dequantize inline at the use site (XLA fuses into the GEMMs),
+        # mirroring how the routed experts dict handles its own dequant
+        from ..linear.quantization import dequantize_dense
+        sh = dequantize_dense(p["shared"], h.dtype)
         shared = (L.silu(h @ sh["w_gate"]) * (h @ sh["w_up"])) @ sh["w_down"]
         gate = jax.nn.sigmoid(h @ sh["gate_proj"])
         return out + gate * shared, aux

@@ -112,6 +112,25 @@ class CometMonitor(Monitor):
             self.experiment.log_metric(name, float(value), step=int(step))
 
 
+# serving counters worth charting per admission cycle: the two ratios
+# say how host-free the decode loop is (ISSUE 1 — dispatches_per_token
+# ~1/K with the fused loop, 1.0 per-tick; fused_occupancy = live
+# (row, step) slot fraction inside fused dispatches), the raw counters
+# give the denominators
+SERVING_METRIC_KEYS = ("dispatches_per_token", "fused_occupancy",
+                       "decoded_tokens", "host_dispatches",
+                       "fused_dispatches", "fused_steps")
+
+
+def serving_events(metrics: dict, step: int,
+                   prefix: str = "Serving") -> List[Event]:
+    """Flatten ``InferenceEngineV2.serving_metrics()`` into monitor
+    events (``Serving/dispatches_per_token`` etc.). Unknown/missing
+    keys are skipped so the surface tolerates engine-version skew."""
+    return [(f"{prefix}/{k}", float(metrics[k]), step)
+            for k in SERVING_METRIC_KEYS if k in metrics]
+
+
 class MonitorMaster(Monitor):
     """reference: monitor.py:30 — rank-0-only fan-out."""
 
@@ -136,3 +155,11 @@ class MonitorMaster(Monitor):
     def write_events(self, events: List[Event]):
         for m in self.monitors:
             m.write_events(events)
+
+    def write_serving_metrics(self, metrics: dict, step: int,
+                              prefix: str = "Serving"):
+        """Chart a serving engine's decode-loop counters (the dict from
+        ``InferenceEngineV2.serving_metrics()``) at ``step`` — typically
+        once per admission cycle or drain interval."""
+        if self.monitors:
+            self.write_events(serving_events(metrics, step, prefix))

@@ -61,8 +61,14 @@ def _resident_max_seq(d: int) -> int:
 
 # the row-resident kernels hold [S, D] slabs (q/do/dq + temps) in VMEM;
 # Mosaic's default 16MB scoped-vmem ceiling trips at long seq x D=128 —
-# raise it (v5e/v5p have 128MB)
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+# raise it (v5e/v5p have 128MB). CompilerParams was TPUCompilerParams
+# before jax 0.5; on a jaxlib with neither, fall back to the default
+# ceiling (interpret-mode tests don't need it, real-chip long-seq runs
+# on such a jaxlib hit the 16MB limit with a clear Mosaic error).
+_CP_CLS = (getattr(pltpu, "CompilerParams", None)
+           or getattr(pltpu, "TPUCompilerParams", None))
+_COMPILER_PARAMS = (_CP_CLS(vmem_limit_bytes=100 * 1024 * 1024)
+                    if _CP_CLS is not None else None)
 
 
 def _interpret() -> bool:

@@ -1,0 +1,100 @@
+"""In-graph token sampling (reference: the HF sampling stack DeepSpeed's
+``_generate:608`` delegates to, and inference/v2's greedy/top-k logit
+post-processing).
+
+The point of this module is that sampling is an *op*, not host code: the
+serving engines call :func:`sample_tokens` inside their compiled decode
+step, so a token is chosen on device and fed straight back into the next
+decode iteration — no logits transfer, no host round trip. This is what
+lets the fused multi-step decode loop (inference/v2) advance K tokens
+per host dispatch.
+
+Filters compose in the standard order: temperature -> top-k -> top-p ->
+categorical. ``greedy=True`` (or a ``None`` key) short-circuits to
+argmax. All filter parameters are static (Python) values — each
+(temperature, top_k, top_p, greedy) combination compiles once.
+
+For sampling that is *schedule-invariant* — the same tokens whether the
+engine decodes per-tick (one dispatch per token) or fused (K tokens per
+dispatch) — derive the per-step key from the sequence position, not from
+a split chain whose length depends on the dispatch pattern:
+:func:`position_keys` folds each row's absolute position into a base
+key, so row r sampling its token at position p always consumes the same
+randomness.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def apply_logit_filters(logits: jax.Array, *, temperature: float = 1.0,
+                        top_k: int = 0, top_p: float = 0.0) -> jax.Array:
+    """Temperature / top-k / top-p logit warping over the last axis.
+    Filtered entries are set to -1e30 (drop out of the softmax); the
+    top-p boundary token stays included, matching the HF implementation
+    the reference delegates to."""
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        logits = logits / max(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if 0.0 < top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p              # [..., V] over sorted
+        kth = jnp.take_along_axis(
+            srt, jnp.sum(keep, axis=-1, keepdims=True) - 1, -1)
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return logits
+
+
+def sample_tokens(logits: jax.Array, key: jax.Array | None = None, *,
+                  temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 0.0, greedy: bool = False) -> jax.Array:
+    """Pick one token id per row of ``logits`` [..., V] -> int32 [...].
+
+    ``greedy=True`` or ``key=None`` -> argmax (no randomness consumed).
+    Otherwise: temperature/top-k/top-p filters, then a categorical draw.
+    ``temperature <= 0`` also means greedy (the serving configs use
+    0.0 as the greedy sentinel).
+    """
+    if greedy or key is None or temperature <= 0.0:
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+            jnp.int32)
+    logits = apply_logit_filters(logits, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def position_keys(base_key: jax.Array, positions: jax.Array) -> jax.Array:
+    """Per-row PRNG keys derived from absolute sequence positions:
+    ``fold_in(key, position)`` vmapped over rows. A row sampling its
+    token at position p consumes the same randomness regardless of how
+    decode steps are grouped into dispatches — per-tick and fused-K
+    schedules produce identical stochastic generations for the same
+    base key. ``base_key`` is either one key [2] (shared by all rows)
+    or a per-row key stack [B, 2] (e.g. the engine folds each row's
+    uid in first, decorrelating rows at equal positions)."""
+    positions = positions.astype(jnp.int32)
+    if base_key.ndim == positions.ndim + 1:     # per-row keys
+        return jax.vmap(jax.random.fold_in)(base_key, positions)
+    return jax.vmap(lambda p: jax.random.fold_in(base_key, p))(positions)
+
+
+def sample_tokens_batched(logits: jax.Array, keys: jax.Array, *,
+                          temperature: float = 1.0, top_k: int = 0,
+                          top_p: float = 0.0) -> jax.Array:
+    """:func:`sample_tokens` with one independent key PER ROW (e.g. from
+    :func:`position_keys`). logits [B, V], keys [B, ...] -> int32 [B]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+            jnp.int32)
+    logits = apply_logit_filters(logits, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
+    return jax.vmap(
+        lambda k, lg: jax.random.categorical(k, lg, axis=-1))(
+            keys, logits).astype(jnp.int32)

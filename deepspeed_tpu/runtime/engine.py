@@ -337,7 +337,14 @@ class DeepSpeedEngine:
         zcfg = self.config.zero_optimization
         have_master = self._mixed and not self._nvme_offload
 
+        from ..utils.jax_compat import supports_pinned_host
+        pin_ok = supports_pinned_host()
+
         def host(s):
+            # backend without a pinned_host tier (e.g. CPU, where
+            # arrays are host-resident anyway): keep the default
+            if not pin_ok:
+                return s
             return NamedSharding(s.mesh, s.spec, memory_kind="pinned_host")
 
         def with_host(shardings, offloaded: bool, abstract=None,
@@ -1145,6 +1152,14 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, NamedSharding))
 
         done = getattr(self, "_offloaded_states", set())
+        from ..utils.jax_compat import supports_pinned_host
+        if not supports_pinned_host():
+            # backend has no pinned_host tier at all (e.g. the 0.4.x CPU
+            # backend): nothing moves, nothing is marked offloaded
+            logger.warning("offload_states: backend has no pinned_host "
+                           "memory; state stays in device memory")
+            self._offloaded_states = done
+            return
         for k in moved:
             try:
                 self.state[k] = jax.device_put(

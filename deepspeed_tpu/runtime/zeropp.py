@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..ops.pallas.quantization import (QBLOCK, quantize_int8,

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.layers import dot_product_attention
+from ..utils.jax_compat import get_abstract_mesh, shard_map
 
 
 def _seq_all_to_all(x, axis_name: str, *, scatter_idx: int, gather_idx: int):
@@ -40,12 +41,12 @@ def _shard_map_sp(body, mesh, sp_axis, n_args):
     inside other manual regions (e.g. the compiled pipeline): when an
     abstract mesh is already active (inside jit), it is used instead of the
     concrete one so nested shard_maps agree."""
-    active = jax.sharding.get_abstract_mesh()
+    active = get_abstract_mesh()
     use = active if (active is not None and active.shape) else mesh
     spec = P(*([None] * 1), sp_axis)  # [B, S(sp), H, D]: dim1 manual
     specs = tuple([spec] * n_args)
-    return jax.shard_map(body, mesh=use, axis_names={sp_axis},
-                         in_specs=specs, out_specs=spec, check_vma=False)
+    return shard_map(body, mesh=use, axis_names={sp_axis},
+                     in_specs=specs, out_specs=spec, check_vma=False)
 
 
 class DistributedAttention:

@@ -1,0 +1,54 @@
+"""Compatibility shims over the jax API surface that moved between the
+0.4.x and 0.5+ lines. The repo is written against the current API
+(``jax.shard_map`` with ``axis_names``/``check_vma``,
+``jax.sharding.get_abstract_mesh``); on older jax these fall back to
+``jax.experimental.shard_map`` (``auto``/``check_rep``) so the same
+call sites run on both.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: bool = False):
+    """``jax.shard_map`` when available; otherwise the experimental
+    entry point with ``axis_names`` translated to its complement
+    (``auto``) and ``check_vma`` to ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # No ``auto=``: 0.4.x's partial-manual mode CHECK-fails in the SPMD
+    # partitioner once an auto axis has size > 1 (ManualSubgroup
+    # mismatch, spmd_partitioner.cc:512). Full manual instead — axes
+    # outside ``axis_names`` are simply unmentioned by the specs, so
+    # inputs replicate and compute is redundant along them (correct,
+    # incl. transpose: unmentioned-axis grads verified unscaled on
+    # 0.4.37); the perf cost only exists on this fallback.
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def get_abstract_mesh():
+    """``jax.sharding.get_abstract_mesh()``, or None before it existed
+    (callers treat None as "no mesh context active")."""
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    return getter() if getter is not None else None
+
+
+def supports_pinned_host() -> bool:
+    """Whether the backend exposes a ``pinned_host`` memory tier (the
+    0.4.x CPU backend only has ``unpinned_host``). The single source of
+    truth for offload placement decisions and the placement asserts in
+    tests — False on any probe failure, so callers skip host placement
+    rather than crash constructing a NamedSharding."""
+    try:
+        return any(m.kind == "pinned_host"
+                   for m in jax.devices()[0].addressable_memories())
+    except Exception:
+        return False

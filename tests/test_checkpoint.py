@@ -159,6 +159,9 @@ def hwm():
     for line in open("/proc/self/status"):
         if line.startswith("VmHWM"):
             return int(line.split()[1])  # KiB
+    # /proc/self/status has no VmHWM on some sandboxed kernels
+    import resource
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KiB on Linux
 sys.path.insert(0, {repo!r})
 import jax; jax.config.update("jax_platforms", "cpu")
 from deepspeed_tpu.checkpoint.universal import ds_to_universal

@@ -232,6 +232,128 @@ def test_readmission_invalidates_stashed_logits(devices8):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_sampling_op_greedy_and_filters():
+    from deepspeed_tpu.ops import sampling
+    logits = jnp.asarray([[0.1, 2.0, -1.0, 0.5], [3.0, -2.0, 0.0, 1.0]])
+    key = jax.random.PRNGKey(0)
+    # key=None, greedy=True and the temperature<=0 sentinel all argmax
+    assert sampling.sample_tokens(logits).tolist() == [1, 0]
+    assert sampling.sample_tokens(logits, key, greedy=True).tolist() == [1, 0]
+    assert sampling.sample_tokens(logits, key,
+                                  temperature=0.0).tolist() == [1, 0]
+    # top_k=1 pins the categorical to the argmax at any temperature
+    assert sampling.sample_tokens(logits, key, temperature=5.0,
+                                  top_k=1).tolist() == [1, 0]
+    # tiny top_p keeps only the head of the distribution
+    assert sampling.sample_tokens(logits, key, temperature=1.0,
+                                  top_p=1e-6).tolist() == [1, 0]
+
+
+def test_sampling_position_keys():
+    from deepspeed_tpu.ops import sampling
+    base = jax.random.PRNGKey(7)
+    rows = jax.vmap(lambda u: jax.random.fold_in(base, u))(
+        jnp.arange(3, dtype=jnp.uint32))
+    a = sampling.position_keys(rows, jnp.asarray([5, 9, 2]))
+    b = sampling.position_keys(rows, jnp.asarray([5, 9, 2]))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # same position, different rows -> different keys (uid fold-in)
+    assert not np.array_equal(
+        np.asarray(sampling.position_keys(rows, jnp.asarray([4, 4, 4]))[0]),
+        np.asarray(sampling.position_keys(rows, jnp.asarray([4, 4, 4]))[1]))
+    # single-key broadcast form: equal positions share randomness
+    c = sampling.position_keys(base, jnp.asarray([5, 5]))
+    np.testing.assert_array_equal(np.asarray(c[0]), np.asarray(c[1]))
+
+
+# --- fused multi-step decode (host-free inner loop) -------------------
+
+def test_fused_greedy_matches_per_tick(devices8):
+    """The acceptance gate: K decode ticks fused into one on-device
+    while_loop must emit bit-identical greedy tokens to the per-tick
+    host loop, including a K that does not divide max_new_tokens."""
+    model = Llama(size="tiny")
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [6, 7, 8, 9, 10, 11]]
+    ref = _engine(model).generate(prompts, max_new_tokens=10)
+    e = _engine(model)
+    got = e.generate_fused(prompts, max_new_tokens=10, k_steps=3)
+    assert ref == got
+    m = e.serving_metrics()
+    # the acceptance gate: >=4x fewer host dispatches per decoded token
+    # than the per-tick loop's 1.0 (prefill dispatches included)
+    assert m["dispatches_per_token"] <= 0.25, m
+    assert m["fused_occupancy"] > 0.9, m
+
+
+def test_fused_mid_loop_eos_and_inter_dispatch_admission(devices8):
+    """EOS must terminate a sequence IN-GRAPH mid-loop, and a pool too
+    small for both prompts must admit the second BETWEEN fused
+    dispatches — both paths token-identical to the per-tick driver."""
+    model = Llama(size="tiny")
+    probe = _engine(model)
+    free = probe.generate([[1, 2, 3, 4, 5]], max_new_tokens=10)[0]
+    eos = free[4]            # 5th greedy token -> mid-loop stop at k=4
+    ref = _engine(model).generate([[1, 2, 3, 4, 5], [9, 8, 7]],
+                                  max_new_tokens=10, eos_id=eos)
+    e = _engine(model)
+    got = e.generate_fused([[1, 2, 3, 4, 5], [9, 8, 7]],
+                           max_new_tokens=10, k_steps=4, eos_id=eos)
+    assert ref == got
+    assert len(got[0]) == 5 and got[0][-1] == eos
+    # constrained pool: 6 blocks x 8 tokens cannot hold both sequences
+    # at once -> the second prompt is admitted after the first finishes,
+    # between fused dispatches
+    p = [list(range(10)), list(range(12))]
+    ref2 = _engine(model, num_kv_blocks=6).generate(p, max_new_tokens=12)
+    e2 = _engine(model, num_kv_blocks=6)
+    got2 = e2.generate_fused(p, max_new_tokens=12, k_steps=3)
+    assert ref2 == got2
+
+
+def test_fused_sampled_decode_schedule_invariant(devices8):
+    """Stochastic decode keys randomness by (uid, position), so the
+    sampled tokens cannot depend on how steps group into dispatches."""
+    model = Llama(size="tiny")
+    prompts = [[1, 2, 3], [7, 6, 5, 4]]
+    kw = dict(max_new_tokens=8, temperature=0.9, top_k=50, seed=13)
+    a = _engine(model).generate_fused(prompts, k_steps=2, **kw)
+    b = _engine(model).generate_fused(prompts, k_steps=4, **kw)
+    assert a == b
+
+
+def test_decode_fused_single_dispatch_api(devices8):
+    """decode_fused(): one dispatch advances a put() sequence up to K
+    tokens and commits them (last token left pending as the next
+    input); agrees with the continuous-batching drivers."""
+    model = Llama(size="tiny")
+    ref = _engine(model).generate([[1, 2, 3, 4, 5]], max_new_tokens=6)[0]
+    e = _engine(model)
+    logits = e.put([0], [[1, 2, 3, 4, 5]])
+    t0 = int(jnp.argmax(logits[0]))
+    e.state_manager.extend(0, [t0])
+    out = e.decode_fused([0], k_steps=5)
+    assert [t0] + out[0] == ref
+    assert e.query(0)[0] == 5 + 5      # prompt + 5 cached (last pending)
+    # budget cap: a second dispatch with budget 2 emits exactly 2
+    out2 = e.decode_fused([0], k_steps=5, budgets={0: 2})
+    assert len(out2[0]) == 2
+
+
+def test_fused_reserve_and_commit_bookkeeping():
+    m = DSStateManager(block_size=4, num_blocks=8, max_blocks_per_seq=4)
+    m.extend(0, [1, 2, 3, 4, 5])       # 2 blocks
+    assert m.reserve(0, 6) == 1        # 11 tokens -> 3 blocks
+    assert m.reserve(0, 6) == 0        # idempotent
+    with pytest.raises(RuntimeError, match="max length"):
+        m.reserve(0, 100)
+    m.seqs[0].seen = 4                 # pending=1, fused entry invariant
+    m.commit_device_tokens(0, [7, 8, 9])
+    assert m.seqs[0].seen == 7 and m.seqs[0].pending == 1
+    with pytest.raises(RuntimeError, match="pending"):
+        m.seqs[0].seen = 5
+        m.commit_device_tokens(0, [1])
+
+
 def test_paged_kernel_sliding_window(devices8):
     """The blocked-flash kernel's sliding-window mask (Mistral SWA) must
     match the jnp paged_attention reference over pages + fresh chunk at

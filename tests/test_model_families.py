@@ -225,6 +225,26 @@ def test_qwen2_moe_shared_expert_contributes():
     assert np.abs(np.asarray(base) - np.asarray(off)).max() > 1e-6
 
 
+def test_qwen2_moe_quantized_shared_expert():
+    """quantize_weights=True used to KeyError at trace time on
+    Qwen2-MoE (ADVICE r5): quantize_dense_params walks layers/shared
+    into w_gate_q/w_up_q/w_down_q, so _mlp must dequantize the shared
+    subtree at its use site like the routed experts dict does.
+    min_size is lowered so the tiny model's shared matrices actually
+    quantize (real-scale models clear the default threshold)."""
+    from deepspeed_tpu.linear.quantization import quantize_dense_params
+    model = Qwen2MoE(size="tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    qparams = quantize_dense_params(params, min_size=1024)
+    # the shared subtree really is quantized (fix must not just skip it)
+    assert "w_gate_q" in qparams["layers"]["shared"]
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 512)
+    a = np.asarray(model.apply(qparams, tok))       # KeyError before fix
+    b = np.asarray(model.apply(params, tok))
+    rel = np.abs(a - b).max() / max(np.abs(b).max(), 1e-9)
+    assert rel < 0.05, rel
+
+
 def test_inference_v2_factory_dispatch():
     """reference: engine_factory.py build_hf_engine model_type table."""
     from deepspeed_tpu.inference.v2 import (SUPPORTED_MODEL_TYPES,

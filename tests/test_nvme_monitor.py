@@ -79,6 +79,31 @@ def test_csv_monitor_and_master(tmp_path):
     assert len(lines) == 3
 
 
+def test_serving_metrics_events(tmp_path):
+    """serving_metrics() -> monitor events: the fused-decode efficiency
+    ratios (ISSUE 1) chart through the same fan-out as training."""
+    from deepspeed_tpu.monitor.monitor import MonitorMaster, serving_events
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    metrics = {"dispatches_per_token": 0.127, "fused_occupancy": 0.94,
+               "decoded_tokens": 512, "host_dispatches": 65,
+               "fused_dispatches": 60, "fused_steps": 480,
+               "fused_slot_tokens": 3840}     # raw counter: not charted
+    ev = serving_events(metrics, step=7)
+    assert ("Serving/dispatches_per_token", 0.127, 7) in ev
+    assert ("Serving/fused_occupancy", 0.94, 7) in ev
+    assert not any(n.endswith("fused_slot_tokens") for n, _, _ in ev)
+    # missing keys are skipped, not KeyError'd
+    assert serving_events({"decoded_tokens": 3}, 0) == \
+        [("Serving/decoded_tokens", 3.0, 0)]
+    cfg = DeepSpeedConfig.from_any({
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "serve"}})
+    m = MonitorMaster(cfg)
+    m.write_serving_metrics(metrics, step=7)
+    body = open(os.path.join(str(tmp_path), "serve.csv")).read()
+    assert "Serving/dispatches_per_token" in body
+
+
 def test_comet_monitor_degrades_gracefully():
     from deepspeed_tpu.monitor.monitor import CometMonitor
     from deepspeed_tpu.runtime.config import CometConfig

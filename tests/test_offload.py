@@ -20,6 +20,15 @@ def _engine(zero_over=None, **cfg_over):
     return engine
 
 
+def _pinned_host_ok():
+    """Whether the backend has a pinned_host memory tier at all (the
+    0.4.x CPU backend only exposes unpinned_host; the engine then keeps
+    state in default memory). Placement asserts are gated on this —
+    numerics checks run either way."""
+    from deepspeed_tpu.utils.jax_compat import supports_pinned_host
+    return supports_pinned_host()
+
+
 def test_cpu_offload_matches_baseline(devices8):
     """cpu tier: pinned_host master/moments at init; numerics unchanged.
     (The CPU-emulation backend's SPMD partitioner rejects host placement
@@ -27,12 +36,13 @@ def test_cpu_offload_matches_baseline(devices8):
     TPU the pinned_host placement sticks.)"""
     ref = _engine()
     off = _engine({"offload_optimizer": {"device": "cpu"}})
-    master = off.state["master"]["embed"]["tokens"]
-    assert master.sharding.memory_kind == "pinned_host"
-    opt_leaf = next(x for x in
-                    __import__("jax").tree.leaves(off.state["opt_state"])
-                    if hasattr(x, "sharding") and x.size > 1)
-    assert opt_leaf.sharding.memory_kind == "pinned_host"
+    if _pinned_host_ok():
+        master = off.state["master"]["embed"]["tokens"]
+        assert master.sharding.memory_kind == "pinned_host"
+        opt_leaf = next(x for x in
+                        __import__("jax").tree.leaves(off.state["opt_state"])
+                        if hasattr(x, "sharding") and x.size > 1)
+        assert opt_leaf.sharding.memory_kind == "pinned_host"
     l_ref = run_steps(ref, n=3)
     l_off = run_steps(off, n=3)
     np.testing.assert_allclose(l_off, l_ref, rtol=1e-4, atol=1e-4)
@@ -46,26 +56,29 @@ def test_twin_flow_partial_offload_ratio(devices8):
     import jax
     ref = _engine()
     off = _engine({"offload_optimizer": {"device": "cpu", "ratio": 0.5}})
-    kinds = {getattr(l.sharding, "memory_kind", None)
-             for l in jax.tree.leaves(off.state["opt_state"])
-             if hasattr(l, "sharding")}
-    assert "pinned_host" in kinds and len(kinds) > 1, kinds
-    # ratio is an upper BOUND on host-resident bytes (ADVICE r3: leaves
-    # that would overshoot the budget are skipped, so a dominant leaf
-    # can no longer drag everything to host); the report reads the
-    # REQUESTED shardings only before a fallback, so measure from
-    # state_shardings (CPU emulation falls back on compute)
-    from jax.sharding import NamedSharding
-    total = host = 0
-    for sh, leaf in zip(
-            jax.tree.leaves(off.state_shardings["opt_state"],
-                            is_leaf=lambda x: isinstance(x, NamedSharding)),
-            jax.tree.leaves(off.state["opt_state"])):
-        b = int(leaf.size) * leaf.dtype.itemsize
-        total += b
-        if getattr(sh, "memory_kind", None) == "pinned_host":
-            host += b
-    assert 0.0 < host / total <= 0.5, host / total
+    if _pinned_host_ok():
+        kinds = {getattr(l.sharding, "memory_kind", None)
+                 for l in jax.tree.leaves(off.state["opt_state"])
+                 if hasattr(l, "sharding")}
+        assert "pinned_host" in kinds and len(kinds) > 1, kinds
+        # ratio is an upper BOUND on host-resident bytes (ADVICE r3:
+        # leaves that would overshoot the budget are skipped, so a
+        # dominant leaf can no longer drag everything to host); the
+        # report reads the REQUESTED shardings only before a fallback,
+        # so measure from state_shardings (CPU emulation falls back on
+        # compute)
+        from jax.sharding import NamedSharding
+        total = host = 0
+        for sh, leaf in zip(
+                jax.tree.leaves(
+                    off.state_shardings["opt_state"],
+                    is_leaf=lambda x: isinstance(x, NamedSharding)),
+                jax.tree.leaves(off.state["opt_state"])):
+            b = int(leaf.size) * leaf.dtype.itemsize
+            total += b
+            if getattr(sh, "memory_kind", None) == "pinned_host":
+                host += b
+        assert 0.0 < host / total <= 0.5, host / total
     l_ref = run_steps(ref, n=3)
     l_off = run_steps(off, n=3)
     np.testing.assert_allclose(l_off, l_ref, rtol=1e-4, atol=1e-4)
@@ -86,8 +99,9 @@ def test_offload_ratio_zero_stays_on_device(devices8):
 
 def test_param_offload_cpu(devices8):
     off = _engine({"stage": 3, "offload_param": {"device": "cpu"}})
-    p = off.state["params"]["embed"]["tokens"]
-    assert p.sharding.memory_kind == "pinned_host"
+    if _pinned_host_ok():
+        p = off.state["params"]["embed"]["tokens"]
+        assert p.sharding.memory_kind == "pinned_host"
     losses = run_steps(off, n=3)
     assert losses[-1] < losses[0]
 

@@ -163,7 +163,7 @@ def test_sparse_tensor_roundtrip():
 
 
 def test_sparse_allreduce(devices8):
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
     mesh = Mesh(np.array(devices8).reshape(8), ("dp",))
 

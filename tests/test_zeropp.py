@@ -120,7 +120,7 @@ def test_qwz_quantized_weights_close_to_exact(devices8):
 
 def test_quantized_collectives_roundtrip(devices8):
     """quantized all-gather + reduce-scatter against exact collectives."""
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh
     from deepspeed_tpu.runtime import zeropp
 

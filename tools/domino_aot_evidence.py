@@ -29,7 +29,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import numpy as np  # noqa: E402
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-from jax import shard_map  # noqa: E402
+from deepspeed_tpu.utils.jax_compat import shard_map  # noqa: E402
 from jax.experimental import topologies  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
