@@ -1152,6 +1152,11 @@ def main(argv=None):
                     help="per-stage wall-clock budget in seconds, "
                          "enforced with SIGALRM (0 = platform default: "
                          "600 on TPU, 240 on CPU)")
+    ap.add_argument("--telemetry", metavar="DIR", default="",
+                    help="activate the telemetry subsystem (ISSUE 2) and "
+                         "write per-stage artifacts into DIR: "
+                         "<stage>.trace.json (Perfetto), <stage>.prom "
+                         "(Prometheus text), <stage>.metrics.json")
     ap.add_argument("--list-stages", action="store_true",
                     help="print stage names and exit")
     args = ap.parse_args(argv)
@@ -1162,6 +1167,10 @@ def main(argv=None):
     import gc
 
     import deepspeed_tpu as ds
+
+    if args.telemetry:
+        from deepspeed_tpu import telemetry
+        telemetry.configure()
 
     on_tpu = jax.devices()[0].platform != "cpu"
     budget = args.budget_s or (600 if on_tpu else 240)
@@ -1215,6 +1224,22 @@ def main(argv=None):
                       f"{str(e)[:160]}", file=sys.stderr)
             finally:
                 signal.alarm(0)
+                if args.telemetry:
+                    # per-stage artifacts, then a clean slate for the
+                    # next stage (written even when the stage timed out
+                    # or failed — partial telemetry is still evidence)
+                    from deepspeed_tpu import telemetry
+                    paths = telemetry.export_artifacts(args.telemetry,
+                                                       prefix=name)
+                    if paths:
+                        print(f"# {name} telemetry: {paths['trace']} "
+                              f"{paths['prometheus']}", file=sys.stderr)
+                    telemetry.clear()
+                    # keep the comms tallies paired with the cleared
+                    # span window (log_summary's bandwidth bound)
+                    lg = ds.comm.get_comms_logger()
+                    if lg is not None:
+                        lg.reset()
             print(f"# {name} took {time.perf_counter() - t0:.1f}s",
                   file=sys.stderr)
             gc.collect()

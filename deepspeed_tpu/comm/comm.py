@@ -112,9 +112,15 @@ def get_comms_logger() -> Optional[comms_logging.CommsLogger]:
     return _comms_logger
 
 
-def log_summary() -> None:
+def log_summary(duration_s: float | None = None,
+                world_size: int | None = None) -> None:
+    """Print the per-op comms summary table (reference comm.py
+    log_summary). Bandwidth columns are computed from the telemetry
+    span window when telemetry is active (see
+    CommsLogger.log_summary)."""
     if _comms_logger is not None:
-        _comms_logger.log_all()
+        _comms_logger.log_summary(duration_s=duration_s,
+                                  world_size=world_size)
 
 
 def _axes(group) -> tuple[str, ...]:

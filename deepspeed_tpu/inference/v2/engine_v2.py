@@ -16,6 +16,7 @@ compiled step so KV writes are in-place.
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 from typing import Any, Optional, Sequence
 
@@ -24,11 +25,62 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...utils.logging import log_dist
+# telemetry guard: sys.modules probe, NOT an import — a disabled
+# serving loop allocates nothing and pays one dict lookup per
+# *dispatch* (never per token)
+from ...utils.telemetry_probe import (NULL_CM as _NULLCM,
+                                      active_telemetry as _telemetry)
 from ..config import DeepSpeedInferenceConfig
 from .paged import fused_decode_loop, paged_forward
 from .ragged import DSStateManager, SequenceDescriptor
 
 PyTree = Any
+
+
+class _LatencyProbe:
+    """Serving-latency telemetry for one generation drive: TTFT and
+    inter-token-latency histograms plus the admission-queue-depth gauge.
+    Constructed only when telemetry is active; all call sites are
+    guarded, so the disabled path carries none of this."""
+
+    __slots__ = ("_ttft", "_itl", "_queue", "_admit_t", "_last_t")
+
+    def __init__(self, reg):
+        self._ttft = reg.histogram(
+            "ds_serving_ttft_seconds",
+            "time from admission to a sequence's first generated token")
+        self._itl = reg.histogram(
+            "ds_serving_itl_seconds",
+            "inter-token latency (observed once per generated token; "
+            "tokens landing in one fused drain share the drain "
+            "interval evenly)")
+        self._queue = reg.gauge(
+            "ds_serving_queue_depth",
+            "prompts still waiting for admission to the decode batch")
+        self._admit_t: dict[int, float] = {}
+        self._last_t: dict[int, float] = {}
+
+    def admitted(self, uids, waiting: int) -> None:
+        now = time.perf_counter()
+        for u in uids:
+            self._admit_t[u] = now
+        self._queue.set(waiting, engine="v2")
+
+    def tokens(self, uid: int, n: int, first: bool = False) -> None:
+        """``n`` new tokens landed for ``uid`` (``first``: the batch
+        starts with the sequence's first generated token)."""
+        now = time.perf_counter()
+        last = self._last_t.get(uid)
+        if first:
+            self._ttft.observe(now - self._admit_t.pop(uid, now))
+            n -= 1
+            if last is None:
+                last = now
+        if n > 0 and last is not None:
+            per = (now - last) / n
+            for _ in range(n):
+                self._itl.observe(per)
+        self._last_t[uid] = now
 
 
 def _bucket(n: int, lo: int = 1) -> int:
@@ -187,9 +239,17 @@ class InferenceEngineV2:
         # logits come back already gathered at each row's last valid
         # token (logits_gather fused into the compiled step)
         self.serving_stats["host_dispatches"] += 1
-        logits, self.pools = self._step(
-            self.params, self.pools, jnp.asarray(tokens),
-            jnp.asarray(pos0), jnp.asarray(tables), jnp.asarray(true_len))
+        tel = _telemetry()
+        # span measures the host-side dispatch (enqueue; the device work
+        # itself lands in the XPlane via the TraceAnnotation mirror)
+        with (tel.span("v2/dispatch",
+                       dispatch_id=self.serving_stats["host_dispatches"],
+                       rows=len(seqs), chunk=s_bucket)
+              if tel is not None else _NULLCM):
+            logits, self.pools = self._step(
+                self.params, self.pools, jnp.asarray(tokens),
+                jnp.asarray(pos0), jnp.asarray(tables),
+                jnp.asarray(true_len))
         for i, seq in enumerate(seqs):
             seq.seen += int(true_len[i])
         return logits[:len(seqs)]
@@ -406,26 +466,50 @@ class InferenceEngineV2:
             temperature, top_k, top_p, eos_id)
         b = {u: int(budgets[u]) if budgets is not None else k
              for u in uids}
-        ops = self._fused_operands(uids, k, b, seed)
-        fn = self._fused_fn(k, temperature, top_k, top_p, eos)
         st = self.serving_stats
-        st["host_dispatches"] += 1
-        st["fused_dispatches"] += 1
-        out, steps, _, _, _, _, self.pools = fn(
-            self.params, self.pools, *ops)
-        toks = np.asarray(out)[:len(uids)]
-        mgr = self.state_manager
-        res: dict[int, list[int]] = {}
-        for i, u in enumerate(uids):
-            row = [int(t) for t in toks[i] if t >= 0]
-            mgr.commit_device_tokens(u, row)
-            res[u] = row
-            st["decoded_tokens"] += len(row)
-            st["fused_slot_tokens"] += len(row)
-        n_exec = int(steps)
-        st["fused_steps"] += n_exec
-        st["fused_slots"] += n_exec * len(uids)
+        tel = _telemetry()
+        t0 = time.perf_counter() if tel is not None else 0.0
+        with (tel.span("v2/fused_dispatch",
+                       dispatch_id=st["fused_dispatches"] + 1,
+                       rows=len(uids), k=k)
+              if tel is not None else _NULLCM):
+            ops = self._fused_operands(uids, k, b, seed)
+            fn = self._fused_fn(k, temperature, top_k, top_p, eos)
+            st["host_dispatches"] += 1
+            st["fused_dispatches"] += 1
+            out, steps, _, _, _, _, self.pools = fn(
+                self.params, self.pools, *ops)
+            toks = np.asarray(out)[:len(uids)]
+            mgr = self.state_manager
+            res: dict[int, list[int]] = {}
+            for i, u in enumerate(uids):
+                row = [int(t) for t in toks[i] if t >= 0]
+                mgr.commit_device_tokens(u, row)
+                res[u] = row
+                st["decoded_tokens"] += len(row)
+                st["fused_slot_tokens"] += len(row)
+            n_exec = int(steps)
+            st["fused_steps"] += n_exec
+            st["fused_slots"] += n_exec * len(uids)
+        if tel is not None:
+            self._record_dispatch_telemetry(
+                tel, time.perf_counter() - t0)
         return res
+
+    def _record_dispatch_telemetry(self, tel, dt: float) -> None:
+        """Fused-dispatch boundary metrics (per DISPATCH — K tokens'
+        worth of work — never per token)."""
+        reg = tel.get_registry()
+        if reg is None:
+            return
+        reg.histogram(
+            "ds_serving_fused_dispatch_seconds",
+            "wall time of one fused decode dispatch (K in-graph steps, "
+            "incl. device sync)").observe(dt)
+        tel.bridges.collect_serving(reg, self.serving_metrics())
+        reg.gauge("ds_serving_free_kv_blocks",
+                  "free blocks in the paged KV pool").set(
+            self.free_blocks, engine="v2")
 
     def serving_metrics(self) -> dict:
         """Decode-loop efficiency counters (monitor/bench surface):
@@ -467,6 +551,12 @@ class InferenceEngineV2:
         reserved: dict[int, int] = {}   # uid -> worst-case block budget
         results: dict[int, list[int]] = {}
         max_live = self._config.max_ragged_sequence_count
+        # serving-latency telemetry (resolved once per generate call; a
+        # per-token observe is one float append when enabled, nothing
+        # when disabled)
+        tel = _telemetry()
+        reg = tel.get_registry() if tel is not None else None
+        lat = _LatencyProbe(reg) if reg is not None else None
 
         def admit():
             """Admit as many pending prompts as fit, reserving each one's
@@ -496,6 +586,8 @@ class InferenceEngineV2:
                               [p for _, p in batch])
                 for uid, _ in batch:
                     live[uid] = []
+            if lat is not None:
+                lat.admitted([u for u, _ in batch], waiting=len(pending))
 
         admit()
         while live or pending:
@@ -517,6 +609,8 @@ class InferenceEngineV2:
                     continue
                 live[u].append(int(jnp.argmax(finished[u])))
                 self.serving_stats["decoded_tokens"] += 1
+                if lat is not None:
+                    lat.tokens(u, 1, first=len(live[u]) == 1)
                 if (len(live[u]) >= max_new_tokens
                         or (eos_id is not None and live[u][-1] == eos_id)):
                     results[u] = live.pop(u)[:max_new_tokens]
@@ -569,6 +663,11 @@ class InferenceEngineV2:
         results: dict[int, list[int]] = {}
         to_flush: list[int] = []
         max_live = self._config.max_ragged_sequence_count
+        # telemetry resolved once per call; every probe below is
+        # per-admission/per-dispatch/per-drain — never per token
+        tel = _telemetry()
+        reg = tel.get_registry() if tel is not None else None
+        lat = _LatencyProbe(reg) if reg is not None else None
 
         def admit() -> list[int]:
             """Admit pending prompts, ALLOCATING the full worst-case
@@ -593,6 +692,8 @@ class InferenceEngineV2:
                 pending.pop(0)
                 free -= need
                 batch.append((uid, prompt))
+            if lat is not None:
+                lat.admitted([u for u, _ in batch], waiting=len(pending))
             if not batch:
                 return []
             self.schedule([u for u, _ in batch], [p for _, p in batch])
@@ -613,13 +714,15 @@ class InferenceEngineV2:
             from ...ops import sampling
             filling = list(uids_new)
             firsts: dict[int, jnp.ndarray] = {}
-            while filling:
-                run = [u for u in filling if mgr.seqs[u].pending]
-                logits = self._run(run)
-                for i, u in enumerate(run):
-                    if not mgr.seqs[u].pending:
-                        firsts[u] = logits[i]
-                        filling.remove(u)
+            with (tel.span("v2/prefill", rows=len(filling))
+                  if tel is not None else _NULLCM):
+                while filling:
+                    run = [u for u in filling if mgr.seqs[u].pending]
+                    logits = self._run(run)
+                    for i, u in enumerate(run):
+                        if not mgr.seqs[u].pending:
+                            firsts[u] = logits[i]
+                            filling.remove(u)
             for u, lg in firsts.items():
                 key = sampling.position_keys(
                     jax.random.fold_in(jax.random.PRNGKey(seed),
@@ -630,6 +733,8 @@ class InferenceEngineV2:
                     temperature=temperature, top_k=top_k, top_p=top_p)[0])
                 live[u].append(tok)
                 stats["decoded_tokens"] += 1
+                if lat is not None:
+                    lat.tokens(u, 1, first=True)
                 if max_new_tokens <= 1 or (eos is not None and tok == eos):
                     finish(u)
                 else:
@@ -681,9 +786,13 @@ class InferenceEngineV2:
                 if n_enq > 0 and (pending
                                   or max(budgets.values()) <= k * n_enq):
                     break
-                out, steps, t2, p2, a2, r2, self.pools = fn(
-                    self.params, self.pools, tok_a, pos_a, tables,
-                    act_a, rem_a, row_keys)
+                with (tel.span("v2/fused_enqueue",
+                               dispatch_id=stats["fused_dispatches"] + 1,
+                               rows=len(rowset), k=k)
+                      if tel is not None else _NULLCM):
+                    out, steps, t2, p2, a2, r2, self.pools = fn(
+                        self.params, self.pools, tok_a, pos_a, tables,
+                        act_a, rem_a, row_keys)
                 carry = (t2, p2, a2, r2)
                 n_enq += 1
                 infl.append((list(rowset), out, steps))
@@ -696,8 +805,11 @@ class InferenceEngineV2:
             # drain the OLDEST dispatch's ring buffer (device may still
             # be running the newer chained one — that's the overlap)
             rows, out, steps = infl.popleft()
-            toks = np.asarray(out)
-            n_exec = int(steps)
+            t_drain = time.perf_counter() if tel is not None else 0.0
+            with (tel.span("v2/fused_drain", rows=len(rows))
+                  if tel is not None else _NULLCM):
+                toks = np.asarray(out)
+                n_exec = int(steps)
             stats["fused_steps"] += n_exec
             stats["fused_slots"] += n_exec * len(rows)
             membership_changed = False
@@ -711,10 +823,15 @@ class InferenceEngineV2:
                 live[u].extend(row)
                 stats["decoded_tokens"] += len(row)
                 stats["fused_slot_tokens"] += len(row)
+                if lat is not None:
+                    lat.tokens(u, len(row))
                 if (len(live[u]) >= max_new_tokens
                         or (eos is not None and row[-1] == eos)):
                     finish(u)
                     membership_changed = True
+            if tel is not None:
+                self._record_dispatch_telemetry(
+                    tel, time.perf_counter() - t_drain)
             if membership_changed or pending:
                 # a finished row's slot should go to a waiting prompt;
                 # rebuild operands once the in-flight chain drains

@@ -33,7 +33,7 @@ class CSVMonitor(Monitor):
         out = config.output_path or "./csv_monitor"
         os.makedirs(out, exist_ok=True)
         self.path = os.path.join(out, f"{config.job_name}.csv")
-        self._writer = None
+        self._warned_bad_value = False
 
     def write_events(self, events: List[Event]):
         new = not os.path.exists(self.path)
@@ -42,7 +42,19 @@ class CSVMonitor(Monitor):
             if new:
                 w.writerow(["name", "value", "step"])
             for name, value, step in events:
-                w.writerow([name, float(value), int(step)])
+                try:
+                    row = [name, float(value), int(step)]
+                except (TypeError, ValueError):
+                    # one bad event must not kill the run's monitor
+                    # flush; warn once, keep writing the rest
+                    if not self._warned_bad_value:
+                        self._warned_bad_value = True
+                        logger.warning(
+                            f"CSVMonitor: skipping non-numeric event "
+                            f"{name!r}={value!r} (warned once; further "
+                            f"bad events are dropped silently)")
+                    continue
+                w.writerow(row)
 
 
 class TensorBoardMonitor(Monitor):

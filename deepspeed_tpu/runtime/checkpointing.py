@@ -19,6 +19,7 @@ import jax
 import numpy as np
 
 from ..utils.logging import log_dist, logger
+from ..utils.telemetry_probe import tel_span as _tel_span
 from .checkpoint_engine import build_checkpoint_engine
 
 LATEST_FILE = "latest"
@@ -39,6 +40,12 @@ def _ckpt_engine(engine):
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
                     save_latest: bool = True) -> bool:
+    with _tel_span("checkpoint_save", step=engine.global_steps):
+        return _save_checkpoint(engine, save_dir, tag, client_state,
+                                save_latest)
+
+
+def _save_checkpoint(engine, save_dir, tag, client_state, save_latest):
     tag = _tag(engine, tag)
     _validate_tag(engine, tag)
     path = os.path.join(os.path.abspath(save_dir), tag)
@@ -100,6 +107,13 @@ def _validate_tag(engine, tag: str):
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_module_only: bool = False):
+    with _tel_span("checkpoint_load", step=engine.global_steps):
+        return _load_checkpoint(engine, load_dir, tag,
+                                load_optimizer_states, load_module_only)
+
+
+def _load_checkpoint(engine, load_dir, tag, load_optimizer_states,
+                     load_module_only):
     load_dir = os.path.abspath(load_dir)
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)

@@ -172,6 +172,29 @@ class CommsLoggerConfig(DeepSpeedConfigModel):
     debug: bool = False
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Unified observability (``deepspeed_tpu/telemetry/``): host-side
+    span tracing with Chrome-trace (Perfetto) export plus a process-wide
+    metrics registry with Prometheus text exposition. Activated by the
+    engine when ``enabled`` is true; ``wall_clock_breakdown: true`` also
+    activates the span tracer (the fwd/bwd/step breakdown events are
+    sourced from span data). When disabled nothing is imported or
+    allocated — hot-loop call sites are guarded. See
+    docs/observability.md."""
+    enabled: bool = False
+    # span ring-buffer capacity (events; oldest dropped first).
+    # Cumulative per-name totals survive eviction.
+    span_buffer_size: int = 8192
+    # mirror every span into a jax.profiler.TraceAnnotation so it also
+    # lands in the XPlane trace captured by jax.profiler.trace()
+    profiler_annotations: bool = True
+    # capture jit compile count/time via jax.monitoring
+    jax_compile_events: bool = True
+    # registry -> MonitorMaster flush cadence in engine steps
+    # (0 = follow steps_per_print)
+    flush_interval_steps: int = 0
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -294,6 +317,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     sequence_parallel: SequenceParallelConfig = Field(
         default_factory=SequenceParallelConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

@@ -32,8 +32,9 @@ from ..models.base import ModelConfig
 from ..parallel.mesh import MeshTopology, TopologyConfig, set_topology
 from ..parallel.partition import constrain, named_shardings
 from ..utils.logging import log_dist, logger
-from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
-                           TRAIN_BATCH_TIMER)
+from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
+                           STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
+                           ThroughputTimer, TRAIN_BATCH_TIMER)
 from .config import DeepSpeedConfig
 from .loss_scaler import LossScaleState, init_loss_scale, update_loss_scale
 from .lr_schedules import LRSchedulerShim, build_schedule
@@ -41,6 +42,18 @@ from .optimizers import build_optimizer
 from .zero import ZeroShardingPlan
 
 PyTree = Any
+
+# telemetry guard (ISSUE 2): sys.modules probe, NOT an import — the
+# disabled path never imports the package or allocates tracer state
+from ..utils.telemetry_probe import (NULL_CM as _NULLCM,  # noqa: E402
+                                     active_telemetry as _telemetry)
+
+# span-name -> reference _write_monitor label for the wall_clock_breakdown
+# events (reference engine.py:2348: Train/Samples/elapsed_time_ms_*)
+_BREAKDOWN_SPANS = ((FORWARD_GLOBAL_TIMER, "forward"),
+                    (BACKWARD_GLOBAL_TIMER, "backward"),
+                    (STEP_GLOBAL_TIMER, "step"),
+                    (TRAIN_BATCH_TIMER, "train_batch"))
 
 
 def fetch_to_device(tree: PyTree, tree_shardings: PyTree) -> PyTree:
@@ -285,6 +298,12 @@ class DeepSpeedEngine:
                 or self.config.comet.enabled):
             from ..monitor.monitor import MonitorMaster
             self.monitor = MonitorMaster(self.config)
+        # telemetry (ISSUE 2): explicit opt-in, or implied by
+        # wall_clock_breakdown — the fwd/bwd/step breakdown events are
+        # sourced from span data, so the tracer must be live for them
+        if self.config.telemetry.enabled or self.config.wall_clock_breakdown:
+            from .. import telemetry
+            telemetry.configure(self.config.telemetry)
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} "
             f"dtype={self.compute_dtype.__name__} mesh={self.topology} "
@@ -710,29 +729,47 @@ class DeepSpeedEngine:
             if data_iter is None:
                 raise ValueError("train_batch needs a batch or data_iter")
             batch = next(data_iter)
-        batch = self._apply_curriculum(batch)
-        batch = self._put_batch(batch)
-        self.tput_timer.start()
-        if self._offload_opt is not None:
-            metrics = self._train_batch_offload(batch)
-        else:
-            try:
-                self.state, metrics = self._train_step(self.state, batch)
-            except jax.errors.JaxRuntimeError as e:
-                if not (self._uses_host_memory
-                        and ("annotate_device_placement" in str(e)
-                             or "Side-effect" in str(e))):
-                    raise
-                self._disable_host_memory(e)
-                self.state, metrics = self._train_step(self.state, batch)
-        self.global_steps += 1
-        self.global_samples += self.train_batch_size_
-        self._last_metrics = metrics
-        if self.global_steps % self.config.steps_per_print == 0:
-            self.tput_timer.stop(sync=metrics["loss"])
-            self._report(metrics)
-        else:
-            self.tput_timer.stop(report_speed=False)
+        # sys.modules probe — None (and zero telemetry work) when off
+        tel = _telemetry()
+        with (tel.span(TRAIN_BATCH_TIMER, step=self.global_steps + 1)
+              if tel is not None else _NULLCM):
+            batch = self._apply_curriculum(batch)
+            with (tel.span("batch_to_device")
+                  if tel is not None else _NULLCM):
+                batch = self._put_batch(batch)
+            self.tput_timer.start()
+            if self._offload_opt is not None:
+                metrics = self._train_batch_offload(batch)
+            else:
+                # span measures the host-visible step boundary: the
+                # dispatch is async, but with donated state the NEXT
+                # call blocks on this step, so steady-state span
+                # durations track true per-step wall time
+                with (tel.span("compiled_step")
+                      if tel is not None else _NULLCM):
+                    try:
+                        self.state, metrics = self._train_step(
+                            self.state, batch)
+                    except jax.errors.JaxRuntimeError as e:
+                        if not (self._uses_host_memory
+                                and ("annotate_device_placement" in str(e)
+                                     or "Side-effect" in str(e))):
+                            raise
+                        self._disable_host_memory(e)
+                        self.state, metrics = self._train_step(
+                            self.state, batch)
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size_
+            self._last_metrics = metrics
+            if self.global_steps % self.config.steps_per_print == 0:
+                self.tput_timer.stop(sync=metrics["loss"])
+                self._report(metrics)
+            else:
+                self.tput_timer.stop(report_speed=False)
+        # flushes run OUTSIDE the train_batch span so export/monitor
+        # cost never pollutes the step timing
+        if tel is not None:
+            self._telemetry_boundary(tel, metrics)
         if self.monitor is not None:
             # reference event set (engine.py:2348 _write_monitor): loss,
             # lr, and the loss scale when fp16 is live
@@ -769,6 +806,54 @@ class DeepSpeedEngine:
             + (f" loss_scale={float(metrics['loss_scale']):.0f}"
                if self.fp16_enabled else ""))
 
+    def _telemetry_boundary(self, tel, metrics):
+        """Boundary-cadence telemetry work (never per step): the
+        wall_clock_breakdown monitor events at steps_per_print, and the
+        registry refresh + registry->MonitorMaster flush at the
+        telemetry flush cadence."""
+        on_print = self.global_steps % self.config.steps_per_print == 0
+        if on_print:
+            self._write_monitor_breakdown(tel)
+        interval = (self.config.telemetry.flush_interval_steps
+                    or self.config.steps_per_print)
+        if self.global_steps % interval == 0:
+            reg = tel.get_registry()
+            if reg is not None:
+                # loss/grad-norm gauges need float() — a device sync.
+                # Only pass metrics on steps_per_print boundaries, where
+                # _report already paid it; off-cadence flushes refresh
+                # counters/memory/comms without blocking dispatch-ahead
+                tel.bridges.record_train_step(
+                    reg, self, metrics if on_print else None)
+                if self.monitor is not None and self.monitor.enabled:
+                    tel.bridges.flush_to_monitor(
+                        self.monitor, self.global_samples)
+
+    def _write_monitor_breakdown(self, tel):
+        """``wall_clock_breakdown`` -> monitor events at steps_per_print
+        boundaries (reference parity: engine.py:2348 _write_monitor's
+        ``Train/Samples/elapsed_time_ms_*`` set), sourced from the span
+        totals accumulated since the previous boundary. The compiled
+        ``train_batch`` path reports the whole-step region; the eager
+        forward/backward/step triple reports each phase."""
+        if not self.config.wall_clock_breakdown:
+            return
+        tracer = tel.get_tracer()
+        if tracer is None:
+            return
+        totals = tracer.drain_totals("monitor_breakdown")
+        events, parts = [], []
+        for span_name, label in _BREAKDOWN_SPANS:
+            if span_name in totals:
+                sec, _count = totals[span_name]
+                events.append((f"Train/Samples/elapsed_time_ms_{label}",
+                               sec * 1000.0, self.global_samples))
+                parts.append(f"{label}: {sec * 1000.0:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts))
+        if events and self.monitor is not None and self.monitor.enabled:
+            self.monitor.write_events(events)
+
     def _put_batch(self, batch):
         bat = self.topology.batch_axes()
         sp = self.topology.sequence_parallel_size
@@ -786,12 +871,15 @@ class DeepSpeedEngine:
     def forward(self, batch):
         """Compute loss on one micro-batch (reference: engine.forward).
         Stores the batch for the subsequent backward()."""
-        batch = self._put_batch(batch)
-        self._pending_batch = batch
-        if self.compressor is not None:
-            return self._eval_loss(self.state["params"], batch,
-                                   self.state["step"])
-        return self._eval_loss(self.state["params"], batch)
+        tel = _telemetry()
+        with (tel.span(FORWARD_GLOBAL_TIMER)
+              if tel is not None else _NULLCM):
+            batch = self._put_batch(batch)
+            self._pending_batch = batch
+            if self.compressor is not None:
+                return self._eval_loss(self.state["params"], batch,
+                                       self.state["step"])
+            return self._eval_loss(self.state["params"], batch)
 
     def __call__(self, batch):
         return self.forward(batch)
@@ -822,6 +910,12 @@ class DeepSpeedEngine:
         ``is_gradient_accumulation_boundary``. Otherwise (ZeRO>=2
         partitioned grads, tp/sp meshes, offloaded params) grads are
         constrained to grad_specs per micro as before."""
+        tel = _telemetry()
+        with (tel.span(BACKWARD_GLOBAL_TIMER)
+              if tel is not None else _NULLCM):
+            self._backward_impl()
+
+    def _backward_impl(self):
         if self._defer_grads_ok():
             if self._local_grads_jit is None:
                 from .zeropp import local_value_and_grad
@@ -922,8 +1016,21 @@ class DeepSpeedEngine:
             "context manager (reference engine.py:1992)"
         if not self.is_gradient_accumulation_boundary():
             return
+        tel = _telemetry()
+        with (tel.span(STEP_GLOBAL_TIMER, step=self.global_steps + 1)
+              if tel is not None else _NULLCM):
+            self._step_impl(tel)
+        if tel is not None:
+            self._telemetry_boundary(tel,
+                                     getattr(self, "_last_metrics", None))
+
+    def _step_impl(self, tel):
         if self._deferred_acc is not None:
-            self._accum_grads = self._finish_deferred_grads()
+            # THE one dp reduction of the eager GAS window (grad-norm +
+            # clip ride the apply step below)
+            with (tel.span("grad_reduce")
+                  if tel is not None else _NULLCM):
+                self._accum_grads = self._finish_deferred_grads()
         if self._offload_opt is not None:
             import math
             scale = float(self.state["loss_scale"].scale)
@@ -969,6 +1076,7 @@ class DeepSpeedEngine:
         self.global_samples += self.train_batch_size_
         if bool(metrics["overflow"]):
             self.skipped_steps += 1
+        self._last_metrics = metrics
         if self.global_steps % self.config.steps_per_print == 0:
             self._report({"loss": jnp.nan, **metrics})
 

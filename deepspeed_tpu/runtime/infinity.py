@@ -66,6 +66,8 @@ import numpy as np
 from jax.sharding import SingleDeviceSharding
 
 from ..utils.logging import log_dist, logger
+from ..utils.telemetry_probe import (NULL_CM as _NULLCM,
+                                     active_telemetry as _tel)
 from .config import DeepSpeedConfig
 from .lr_schedules import build_schedule
 
@@ -187,6 +189,9 @@ class StreamedZeroEngine:
         self.global_samples = 0
         self.skipped_steps = 0
         self._last_metrics = None
+        if config.telemetry.enabled or config.wall_clock_breakdown:
+            from .. import telemetry
+            telemetry.configure(config.telemetry)
         n = self.model_config.num_params()
         cdt_size = jnp.dtype(self.compute_dtype).itemsize
         if self._nvme:
@@ -846,6 +851,13 @@ class StreamedZeroEngine:
                 "reload from a checkpoint before using this engine")
 
     def train_batch(self, batch=None, data_iter=None):
+        tel = _tel()
+        with (tel.span("train_batch", step=self.global_steps + 1,
+                       engine="streamed")
+              if tel is not None else _NULLCM):
+            return self._train_batch_impl(batch, data_iter)
+
+    def _train_batch_impl(self, batch=None, data_iter=None):
         self._check_usable()
         ga = self.gradient_accumulation_steps_
         if self._phase_a is None:

@@ -37,6 +37,8 @@ import numpy as np
 
 from ..checkpoint.universal import flatten_with_names
 from ..utils.logging import log_dist
+from ..utils.telemetry_probe import (NULL_CM as _NULLCM,
+                                     active_telemetry as _tel)
 
 PyTree = Any
 
@@ -179,6 +181,26 @@ class NVMeOffloadOptimizer:
         """One optimizer step over all shards, moments pipelined through
         NVMe: read shard i+1's moments from disk while shard i computes;
         write shard i's right after. RAM high-water: 2 shards of moments."""
+        tel = _tel()
+        with (tel.span("nvme_opt_step", step=self._step + 1)
+              if tel is not None else _NULLCM):
+            out = self._step_impl(grads, lr, grad_scale)
+        if tel is not None:
+            reg = tel.get_registry()
+            if reg is not None:
+                reg.counter("ds_offload_nvme_steps_total",
+                            "NVMe-tier host optimizer steps").inc()
+                moment_bytes = sum(
+                    r.master.nbytes * len(self._opt.moment_names())
+                    for r in self._shards)
+                reg.counter(
+                    "ds_offload_nvme_moment_bytes_total",
+                    "moment bytes round-tripped through NVMe per step "
+                    "(read + written each)").inc(2 * moment_bytes)
+        return out
+
+    def _step_impl(self, grads: PyTree, lr: float,
+                   grad_scale: float = 1.0) -> int:
         grad_leaves = dict(flatten_with_names(grads))
         self._step += 1
 

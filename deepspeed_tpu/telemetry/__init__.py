@@ -1,0 +1,141 @@
+"""Unified telemetry (ISSUE 2 tentpole): span tracing + metrics registry
++ Perfetto/Prometheus export across training and serving.
+
+Three parts:
+
+- :mod:`.spans` — host-side span tracer (context manager + decorator,
+  nested, per-rank ring buffer) that mirrors each span into a
+  ``jax.profiler.TraceAnnotation`` (XPlane) and exports
+  Chrome-trace-event JSON loadable in Perfetto.
+- :mod:`.registry` — process-wide Counter/Gauge/Histogram registry with
+  ``snapshot()``, JSON dump, and Prometheus text exposition.
+- :mod:`.bridges` — collectors from existing sources (jax compile
+  events, ThroughputTimer, CommsLogger, serving_metrics, memory) and a
+  registry -> MonitorMaster flush.
+
+Activation::
+
+    from deepspeed_tpu import telemetry
+    telemetry.configure()                  # or via the engine's
+                                           # {"telemetry": {"enabled": true}}
+    ... run training / serving ...
+    telemetry.export_artifacts("/tmp/tel", prefix="run1")
+
+Overhead contract: nothing in this package is imported by the framework
+until telemetry is activated; instrumented call sites probe
+``sys.modules`` for this module instead of importing it, so a
+telemetry-disabled run allocates no tracer/registry state and pays one
+dict lookup per *dispatch* (never per token). See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import bridges, registry as _registry_mod, spans as _spans_mod
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, get_registry)
+from .spans import NULL_CONTEXT, SpanTracer, get_tracer  # noqa: F401
+
+_ACTIVE = False
+
+
+def is_active() -> bool:
+    """True iff ``configure()`` ran (and ``shutdown()`` has not)."""
+    return _ACTIVE
+
+
+def configure(config=None, *, span_buffer_size: Optional[int] = None,
+              profiler_annotations: Optional[bool] = None,
+              jax_compile_events: Optional[bool] = None) -> None:
+    """Activate telemetry for this process. ``config`` may be the
+    engine's ``TelemetryConfig`` block; keyword overrides win.
+    Idempotent: re-configuring while active keeps the existing
+    tracer/registry (so engine init cannot wipe a bench harness's
+    already-collected spans)."""
+    global _ACTIVE
+    if _ACTIVE:
+        return
+
+    def pick(kw, attr, default):
+        if kw is not None:
+            return kw
+        return getattr(config, attr, default) if config is not None \
+            else default
+
+    capacity = pick(span_buffer_size, "span_buffer_size", 8192)
+    annotations = pick(profiler_annotations, "profiler_annotations", True)
+    compile_events = pick(jax_compile_events, "jax_compile_events", True)
+    _spans_mod.set_tracer(SpanTracer(
+        capacity=capacity, profiler_annotations=annotations))
+    _registry_mod.set_registry(MetricsRegistry())
+    if compile_events:
+        bridges.install_jax_compile_listener()
+    _ACTIVE = True
+
+
+def shutdown() -> None:
+    """Deactivate and drop all telemetry state. The jax.monitoring
+    listener stays registered (jax has no per-listener removal) but
+    no-ops once the registry is gone."""
+    global _ACTIVE
+    _ACTIVE = False
+    _spans_mod.set_tracer(None)
+    _registry_mod.set_registry(None)
+
+
+def clear() -> None:
+    """Reset spans + metrics in place (e.g. between bench stages)."""
+    t = get_tracer()
+    if t is not None:
+        t.clear()
+    r = get_registry()
+    if r is not None:
+        r.clear()
+
+
+def span(name: str, **tags):
+    """Module-level span helper; shared no-op context when inactive."""
+    return _spans_mod.span(name, **tags)
+
+
+def trace(func=None, *, name: Optional[str] = None):
+    """Decorator recording a span per call; pass-through when inactive
+    at call time (the check happens per call, not at decoration)."""
+    import functools
+
+    def wrap(f):
+        label = name or f.__qualname__
+
+        @functools.wraps(f)
+        def inner(*a, **kw):
+            with _spans_mod.span(label):
+                return f(*a, **kw)
+        return inner
+    return wrap(func) if func is not None else wrap
+
+
+def export_artifacts(out_dir: str, prefix: str = "telemetry",
+                     serving_metrics: Optional[dict] = None) -> dict:
+    """Write ``<prefix>.trace.json`` (Perfetto), ``<prefix>.prom``
+    (Prometheus text) and ``<prefix>.metrics.json`` (snapshot) into
+    ``out_dir``, refreshing the memory/comms collectors first. Returns
+    the written paths (empty when telemetry is inactive)."""
+    tracer, reg = get_tracer(), get_registry()
+    if tracer is None or reg is None:
+        return {}
+    os.makedirs(out_dir, exist_ok=True)
+    bridges.collect_memory(reg)
+    bridges.collect_comms(reg)
+    if serving_metrics is not None:
+        bridges.collect_serving(reg, serving_metrics)
+    out = {
+        "trace": tracer.export_chrome_trace(
+            os.path.join(out_dir, f"{prefix}.trace.json")),
+        "prometheus": reg.dump_prometheus(
+            os.path.join(out_dir, f"{prefix}.prom")),
+        "metrics_json": reg.dump_json(
+            os.path.join(out_dir, f"{prefix}.metrics.json")),
+    }
+    return out

@@ -1,0 +1,173 @@
+"""Bridges from existing signal sources into the telemetry registry
+(ISSUE 2 tentpole part 2b).
+
+Each collector reads one legacy/framework surface and mirrors it into
+Prometheus-style metrics:
+
+- ``install_jax_compile_listener`` — ``jax.monitoring`` duration events
+  (jit trace / lowering / backend compile) -> compile count + seconds.
+- ``collect_memory`` — /proc/self/status VmRSS+VmHWM and PJRT device
+  ``memory_stats()`` -> host/device memory gauges.
+- ``collect_comms`` — ``CommsLogger`` per-op call/byte tallies ->
+  ``ds_comm_*_total`` counters.
+- ``collect_serving`` — ``InferenceEngineV2.serving_metrics()`` ->
+  serving counters + efficiency gauges.
+- ``collect_throughput`` — ``ThroughputTimer`` -> samples/s + TFLOPS.
+- ``flush_to_monitor`` — registry snapshot -> ``MonitorMaster`` events,
+  so CSV/TensorBoard/W&B see everything the registry holds.
+
+All collectors are cheap, idempotent, and safe to call at flush
+boundaries only — never per token.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import registry as _registry_mod
+from .registry import MetricsRegistry
+
+_JAX_LISTENER_INSTALLED = False
+
+
+def install_jax_compile_listener() -> None:
+    """Capture jit compile count/time via ``jax.monitoring``. Installed
+    once per process; the listener reads the live registry on each
+    event, so it becomes a no-op after ``telemetry.shutdown()`` (jax
+    offers no per-listener removal)."""
+    global _JAX_LISTENER_INSTALLED
+    if _JAX_LISTENER_INSTALLED:
+        return
+    import jax
+
+    def _on_duration(name: str, dur_s: float, **kw) -> None:
+        reg = _registry_mod.get_registry()
+        if reg is None or "/compile/" not in name:
+            return
+        phase = name.rsplit("/", 1)[-1]
+        if phase.endswith("_duration"):
+            phase = phase[: -len("_duration")]
+        reg.counter("ds_jax_compile_total",
+                    "jax compile-path events by phase").inc(phase=phase)
+        reg.counter("ds_jax_compile_seconds_total",
+                    "cumulative seconds in jax compile phases").inc(
+            dur_s, phase=phase)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    _JAX_LISTENER_INSTALLED = True
+
+
+def collect_memory(reg: MetricsRegistry) -> None:
+    """Host VmRSS/VmHWM + device memory stats as gauges."""
+    host = reg.gauge("ds_host_memory_bytes",
+                     "host process memory from /proc/self/status")
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    host.set(int(line.split()[1]) * 1024, kind="rss")
+                elif line.startswith("VmHWM:"):
+                    host.set(int(line.split()[1]) * 1024, kind="hwm")
+    except OSError:
+        pass  # no procfs (VmHWM is also absent on some sandboxed kernels)
+    from ..utils.memory import device_memory_stats
+    stats = device_memory_stats()
+    if stats:
+        dev = reg.gauge("ds_device_memory_bytes",
+                        "PJRT device memory stats (device 0)")
+        for key, kind in (("bytes_in_use", "in_use"),
+                          ("peak_bytes_in_use", "peak"),
+                          ("bytes_limit", "limit")):
+            if key in stats:
+                dev.set(float(stats[key]), kind=kind)
+
+
+def collect_comms(reg: MetricsRegistry, comms_logger=None) -> None:
+    """CommsLogger per-op tallies -> counters (absolute mirror)."""
+    if comms_logger is None:
+        from .. import comm as dist
+        comms_logger = dist.get_comms_logger()
+    if comms_logger is None:
+        return
+    calls = reg.counter("ds_comm_calls_total",
+                        "collective calls recorded at trace time")
+    byts = reg.counter("ds_comm_bytes_total",
+                       "collective payload bytes recorded at trace time")
+    for op, sizes in comms_logger.comms_dict.items():
+        n = sum(sizes.values())
+        b = sum(cnt * sz for sz, cnt in sizes.items())
+        calls.set_total(n, op=op)
+        byts.set_total(b, op=op)
+
+
+# serving counters mirrored 1:1 from InferenceEngineV2.serving_stats
+_SERVING_COUNTERS = ("decoded_tokens", "host_dispatches",
+                     "fused_dispatches", "fused_steps")
+
+
+def collect_serving(reg: MetricsRegistry, serving_metrics: dict,
+                    engine_label: str = "v2") -> None:
+    """``InferenceEngineV2.serving_metrics()`` -> registry."""
+    for key in _SERVING_COUNTERS:
+        if key in serving_metrics:
+            reg.counter(f"ds_serving_{key}_total",
+                        f"serving counter {key}").set_total(
+                serving_metrics[key], engine=engine_label)
+    for key in ("dispatches_per_token", "fused_occupancy"):
+        if key in serving_metrics:
+            reg.gauge(f"ds_serving_{key}",
+                      f"decode-loop efficiency ratio {key}").set(
+                serving_metrics[key], engine=engine_label)
+
+
+def collect_throughput(reg: MetricsRegistry, tput_timer) -> None:
+    """``ThroughputTimer`` -> samples/s (+ TFLOPS when configured)."""
+    sps = tput_timer.avg_samples_per_sec()
+    reg.gauge("ds_train_samples_per_second",
+              "training throughput (ThroughputTimer)").set(sps)
+    if getattr(tput_timer, "flops_per_sample", None):
+        reg.gauge("ds_train_tflops",
+                  "estimated training TFLOPS").set(tput_timer.tflops())
+
+
+def record_train_step(reg: MetricsRegistry, engine, metrics) -> None:
+    """Engine step-boundary metrics (called at steps_per_print
+    boundaries, where the device sync is already paid)."""
+    reg.counter("ds_train_steps_total",
+                "engine steps taken").set_total(engine.global_steps)
+    reg.counter("ds_train_samples_total",
+                "samples consumed").set_total(engine.global_samples)
+    reg.counter("ds_train_skipped_steps_total",
+                "overflow-skipped optimizer steps").set_total(
+        engine.skipped_steps)
+    if metrics:
+        if "loss" in metrics:
+            reg.gauge("ds_train_loss", "last reported loss").set(
+                float(metrics["loss"]))
+        if "grad_norm" in metrics:
+            reg.gauge("ds_train_grad_norm",
+                      "last reported global gradient norm").set(
+                float(metrics["grad_norm"]))
+        if "loss_scale" in metrics:
+            reg.gauge("ds_train_loss_scale", "live fp16 loss scale").set(
+                float(metrics["loss_scale"]))
+    tput = getattr(engine, "tput_timer", None)
+    if tput is not None:
+        collect_throughput(reg, tput)
+    collect_memory(reg)
+    collect_comms(reg)
+
+
+def flush_to_monitor(monitor, step: int,
+                     reg: Optional[MetricsRegistry] = None,
+                     prefix: str = "Telemetry") -> int:
+    """Write the registry's scalar view through MonitorMaster so the
+    CSV/TensorBoard/W&B backends chart it. Returns event count."""
+    reg = reg if reg is not None else _registry_mod.get_registry()
+    if reg is None or monitor is None or not getattr(monitor, "enabled",
+                                                     False):
+        return 0
+    events = reg.events_for_monitor(step, prefix=prefix)
+    if events:
+        monitor.write_events(events)
+    return len(events)

@@ -1,0 +1,252 @@
+"""Host-side span tracer (ISSUE 2 tentpole part 1).
+
+A span is a named host-wall-clock interval with tags (step, dispatch_id,
+request_id, ...). Spans nest (per-thread depth counter), land in a
+bounded per-rank ring buffer, and — when ``profiler_annotations`` is on —
+simultaneously open a ``jax.profiler.TraceAnnotation`` so the same range
+appears in XLA's XPlane trace next to the device timeline.
+
+Export is Chrome-trace-event JSON (``ph:"X"`` complete events with
+``ts``/``dur`` in microseconds), loadable directly in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+
+Overhead contract: this module is only imported once telemetry is
+configured; call sites in the hot loops (runtime/engine.py,
+inference/v2/engine_v2.py) probe ``sys.modules`` instead of importing,
+so the disabled path allocates nothing and pays one dict lookup.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+
+class Span:
+    """One recorded interval. ``ts_us`` is microseconds since the
+    tracer's epoch; ``dur_us`` the measured duration."""
+
+    __slots__ = ("name", "ts_us", "dur_us", "depth", "tid", "args")
+
+    def __init__(self, name: str, ts_us: float, dur_us: float,
+                 depth: int, tid: int, args: Optional[dict]):
+        self.name = name
+        self.ts_us = ts_us
+        self.dur_us = dur_us
+        self.depth = depth
+        self.tid = tid
+        self.args = args
+
+    def to_chrome(self, pid: int) -> dict:
+        ev = {"name": self.name, "ph": "X", "ts": round(self.ts_us, 3),
+              "dur": round(self.dur_us, 3), "pid": pid, "tid": self.tid,
+              "cat": "host"}
+        if self.args:
+            ev["args"] = dict(self.args)
+        return ev
+
+
+class _NullContext:
+    """Shared no-op context manager — what ``span()`` hands out when
+    tracing is off, so disabled call sites allocate nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_CONTEXT = _NullContext()
+
+
+class _SpanContext:
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_ann", "_depth")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+        self._ann = None
+
+    def __enter__(self):
+        tls = self._tracer._tls
+        self._depth = getattr(tls, "depth", 0)
+        tls.depth = self._depth + 1
+        if self._tracer.profiler_annotations:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        self._tracer._tls.depth = self._depth
+        self._tracer._record(
+            self._name,
+            (self._t0 - self._tracer._epoch_ns) / 1e3,
+            (t1 - self._t0) / 1e3,
+            self._depth, threading.get_ident() & 0xFFFFFFFF, self._args)
+        return False
+
+
+class SpanTracer:
+    """Per-process span recorder with a bounded ring buffer.
+
+    The ring (``capacity`` spans, oldest dropped first) bounds memory on
+    long runs; cumulative per-name totals survive ring eviction, so
+    breakdown reporting and the comms-bandwidth window stay exact even
+    when individual events have rotated out.
+    """
+
+    def __init__(self, capacity: int = 8192,
+                 profiler_annotations: bool = True):
+        self.capacity = int(capacity)
+        self.profiler_annotations = bool(profiler_annotations)
+        self._epoch_ns = time.perf_counter_ns()
+        self.epoch_unix = time.time()
+        self._buf: deque[Span] = deque(maxlen=self.capacity)
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        # name -> [total_seconds, count]; never evicted (bounded by the
+        # number of distinct span names, not the number of events)
+        self._totals: dict[str, list] = {}
+        # drain marks: consumer key -> {name: [seconds, count]} snapshot
+        self._marks: dict[str, dict[str, tuple]] = {}
+        # depth-0 seconds only (survives ring eviction); kept separate
+        # from _totals so a name recorded at BOTH top level and nested
+        # (e.g. v2/dispatch standalone vs under v2/prefill) never
+        # double-counts in window_seconds()
+        self._depth0_seconds = 0.0
+        self.recorded = 0
+
+    # ------------------------------------------------------------------
+    def span(self, name: str, **args: Any) -> _SpanContext:
+        """Context manager recording one span; kwargs become Chrome
+        ``args`` tags (step / dispatch_id / request_id / ...)."""
+        return _SpanContext(self, name, args or None)
+
+    def trace(self, func: Optional[Callable] = None, *,
+              name: Optional[str] = None):
+        """Decorator form: ``@tracer.trace`` or ``@tracer.trace(name=...)``."""
+        def wrap(f):
+            label = name or f.__qualname__
+
+            @functools.wraps(f)
+            def inner(*a, **kw):
+                with self.span(label):
+                    return f(*a, **kw)
+            return inner
+        return wrap(func) if func is not None else wrap
+
+    def _record(self, name, ts_us, dur_us, depth, tid, args):
+        with self._lock:
+            self._buf.append(Span(name, ts_us, dur_us, depth, tid, args))
+            tot = self._totals.setdefault(name, [0.0, 0])
+            tot[0] += dur_us / 1e6
+            tot[1] += 1
+            if depth == 0:
+                self._depth0_seconds += dur_us / 1e6
+            self.recorded += 1
+
+    # ------------------------------------------------------------------
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._buf)
+
+    def totals(self) -> dict[str, tuple[float, int]]:
+        """Cumulative {name: (seconds, count)} since construction/clear."""
+        with self._lock:
+            return {k: (v[0], v[1]) for k, v in self._totals.items()}
+
+    def drain_totals(self, consumer: str = "default") \
+            -> dict[str, tuple[float, int]]:
+        """Per-name (seconds, count) accumulated since this consumer's
+        previous drain. Independent consumers (monitor flush, comms
+        window) each get their own mark, so one reader cannot starve
+        another."""
+        with self._lock:
+            mark = self._marks.get(consumer, {})
+            out = {}
+            for name, (sec, cnt) in ((k, v) for k, v in
+                                     self._totals.items()):
+                psec, pcnt = mark.get(name, (0.0, 0))
+                if cnt > pcnt:
+                    out[name] = (sec - psec, cnt - pcnt)
+            self._marks[consumer] = {k: (v[0], v[1])
+                                     for k, v in self._totals.items()}
+            return out
+
+    def window_seconds(self) -> float:
+        """Total measured wall time of top-level (depth-0) spans. The
+        comms logger uses this as the measured window over which
+        collective bytes moved — a lower bound on bandwidth, since XLA
+        overlaps collectives with compute inside the window. Only
+        depth-0 durations count, so nested occurrences (even of a name
+        that also appears at top level) are never double counted."""
+        with self._lock:
+            return self._depth0_seconds
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._totals.clear()
+            self._marks.clear()
+            self._depth0_seconds = 0.0
+            self.recorded = 0
+            self._epoch_ns = time.perf_counter_ns()
+            self.epoch_unix = time.time()
+
+    # ------------------------------------------------------------------
+    def chrome_trace(self) -> dict:
+        """Chrome-trace-event JSON object (Perfetto-loadable)."""
+        import jax
+        pid = jax.process_index()
+        events: list[dict] = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"deepspeed_tpu rank {pid} (host)"}},
+        ]
+        for s in self.spans():
+            events.append(s.to_chrome(pid))
+        return {"traceEvents": events,
+                "displayTimeUnit": "ms",
+                "otherData": {
+                    "epoch_unix_s": self.epoch_unix,
+                    "recorded_spans": self.recorded,
+                    "ring_capacity": self.capacity,
+                }}
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+# --- module-level current tracer (wired by telemetry.configure) ---------
+
+_TRACER: Optional[SpanTracer] = None
+
+
+def get_tracer() -> Optional[SpanTracer]:
+    return _TRACER
+
+
+def set_tracer(tracer: Optional[SpanTracer]) -> None:
+    global _TRACER
+    _TRACER = tracer
+
+
+def span(name: str, **args: Any):
+    """Record a span under the current tracer; no-op (shared null
+    context, zero allocation) when tracing is off."""
+    t = _TRACER
+    return t.span(name, **args) if t is not None else NULL_CONTEXT

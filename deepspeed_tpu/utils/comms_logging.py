@@ -9,9 +9,40 @@ reference's bus-bandwidth formulas (comms_logging.py:32).
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
 
 from .logging import log_dist, logger
+from .telemetry_probe import active_telemetry
+
+
+def _telemetry_window_s(started_unix: float) -> float:
+    """Measured wall-time window (seconds) from the telemetry span
+    tracer's top-level spans, when telemetry is active; 0.0 otherwise.
+
+    The window is only trusted when the tracer started recording no
+    later than this logger did (``started_unix``): a tracer configured
+    — or ``clear()``ed — after collectives were already tallied would
+    pair a short window with a long run's bytes and OVERSTATE
+    bandwidth, breaking the lower-bound claim. In that case the caller
+    gets 0.0 and the bandwidth columns render ``-`` (call
+    ``CommsLogger.reset()`` alongside ``telemetry.clear()`` to re-pair
+    them, as ``bench.py --telemetry`` does between stages)."""
+    mod = active_telemetry()
+    if mod is None:
+        return 0.0
+    tracer = mod.get_tracer()
+    if tracer is None or tracer.epoch_unix > started_unix + 1.0:
+        return 0.0
+    return tracer.window_seconds()
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} PB"
 
 
 def get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple[float, float]:
@@ -40,6 +71,16 @@ class CommsLogger:
         # op_name -> msg_size -> call count (total bytes = count * msg_size)
         self.comms_dict: dict[str, dict[int, int]] = defaultdict(
             lambda: defaultdict(int))
+        # when this tally window opened (paired against the telemetry
+        # tracer's epoch in log_summary's bandwidth accounting)
+        self.started_unix = time.time()
+
+    def reset(self) -> None:
+        """Drop all tallies and reopen the window (pair with
+        ``telemetry.clear()`` so bytes and measured duration keep
+        covering the same interval)."""
+        self.comms_dict.clear()
+        self.started_unix = time.time()
 
     def append(self, op_name: str, msg_size: int, group=None) -> None:
         if not self.enabled:
@@ -58,6 +99,69 @@ class CommsLogger:
                 lines.append(
                     f"{op_name:<25}{msg_size:>15}{count:>10}"
                     f"{count * msg_size / 1e6:>14.2f}")
+        text = "\n".join(lines)
+        if print_log:
+            log_dist("\n" + text)
+        return text
+
+    def log_summary(self, duration_s: float | None = None,
+                    world_size: int | None = None,
+                    print_log: bool = True) -> str:
+        """Reference-format per-op summary table (comms_logging.py
+        log_summary) with the latency/bandwidth columns filled from a
+        MEASURED duration instead of per-call timing (which XLA's fused
+        collectives make unobservable eagerly).
+
+        ``duration_s`` defaults to the telemetry span tracer's top-level
+        window (sum of train_batch / dispatch span durations). Every op
+        ran somewhere inside that window, so ``bytes / window`` is an
+        honest LOWER BOUND on each op's achieved algorithm bandwidth —
+        collectives overlap compute inside the window, so true bandwidth
+        is at least this. The bound only holds when the window and the
+        tallies cover the same interval, so a tracer that started (or
+        was cleared) AFTER this logger began recording is rejected; the
+        bandwidth columns then print ``-``, as they do with telemetry
+        off and no explicit duration.
+
+        Zero-call ops / zero sizes / zero duration never divide by zero;
+        such rows render ``-`` in the derived columns.
+        """
+        if duration_s is None:
+            duration_s = _telemetry_window_s(self.started_unix)
+        if world_size is None:
+            import jax
+            world_size = max(jax.device_count(), 1)
+        header = (f"{'Comm. Op':<28}{'Message Size':>14}{'Count':>8}"
+                  f"{'Total Bytes':>14}{'Window(ms)':>12}"
+                  f"{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}")
+        lines = [header]
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            n_calls = sum(sizes.values())
+            total_bytes = sum(cnt * sz for sz, cnt in sizes.items())
+            if n_calls == 0:
+                # defensive: an op key with no recorded calls renders a
+                # placeholder row instead of dividing by zero
+                lines.append(f"{op_name:<28}{'-':>14}{0:>8}{'-':>14}"
+                             f"{'-':>12}{'-':>13}{'-':>13}")
+                continue
+            if duration_s > 0 and total_bytes > 0:
+                algbw, busbw = get_bw(op_name, total_bytes, duration_s,
+                                      world_size)
+                win = f"{duration_s * 1e3:.2f}"
+                alg, bus = f"{algbw:.3f}", f"{busbw:.3f}"
+            else:
+                win = alg = bus = "-"
+            for msg_size, count in sorted(sizes.items()):
+                lines.append(
+                    f"{op_name:<28}{_human_bytes(msg_size):>14}"
+                    f"{count:>8}{_human_bytes(count * msg_size):>14}"
+                    f"{'':>12}{'':>13}{'':>13}")
+            lines.append(
+                f"{op_name + ' (total)':<28}{'':>14}{n_calls:>8}"
+                f"{_human_bytes(total_bytes):>14}{win:>12}"
+                f"{alg:>13}{bus:>13}")
+        if len(lines) == 1:
+            lines.append("(no collectives recorded)")
         text = "\n".join(lines)
         if print_log:
             log_dist("\n" + text)

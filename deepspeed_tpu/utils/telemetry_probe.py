@@ -1,0 +1,33 @@
+"""Zero-import probe for the telemetry subsystem (ISSUE 2).
+
+Instrumented call sites across the framework (engine, inference v2,
+infinity, offload, checkpointing, comms logging) must pay NOTHING when
+telemetry is off: this module — which deliberately never imports
+``deepspeed_tpu.telemetry`` — gives them one shared guard. A
+``sys.modules`` lookup finds the package only if something already
+imported it (``telemetry.configure()`` / the engine's config block),
+and ``is_active()`` gates shutdown. One helper, one set of semantics;
+call sites stay in lockstep.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+
+# shared reusable no-op context manager for disabled spans
+NULL_CM = contextlib.nullcontext()
+
+
+def active_telemetry():
+    """The live ``deepspeed_tpu.telemetry`` module iff it has been
+    imported AND ``configure()`` ran (and ``shutdown()`` has not);
+    ``None`` otherwise. Never imports the package."""
+    mod = sys.modules.get("deepspeed_tpu.telemetry")
+    return mod if mod is not None and mod.is_active() else None
+
+
+def tel_span(name: str, **tags):
+    """A telemetry span when active, else the shared no-op context."""
+    mod = active_telemetry()
+    return mod.span(name, **tags) if mod is not None else NULL_CM

@@ -126,6 +126,9 @@ _SLOW = {
     ("test_infinity.py", "test_streamed_ga_data_iter_draws_per_micro"),
     ("test_compression.py", "test_engine_trains_with_compression"),
     ("test_data_pipeline.py", "test_engine_curriculum_seqlen"),
+    # fresh-interpreter subprocess (two small compiles); the in-process
+    # disabled-mode test covers the same hot paths in the default tier
+    ("test_telemetry.py", "test_disabled_guard_no_import_no_state"),
 }
 
 

@@ -104,6 +104,27 @@ def test_serving_metrics_events(tmp_path):
     assert "Serving/dispatches_per_token" in body
 
 
+def test_csv_monitor_skips_bad_values(tmp_path):
+    """One non-float-convertible event must not kill the flush: it is
+    skipped with a single warning, numeric events still land, and the
+    dead `_writer` attribute is gone (ISSUE 2 satellite)."""
+    from deepspeed_tpu.monitor.monitor import CSVMonitor
+    from deepspeed_tpu.runtime.config import CSVConfig
+    m = CSVMonitor(CSVConfig(enabled=True, output_path=str(tmp_path),
+                             job_name="bad"))
+    assert not hasattr(m, "_writer")
+    assert m._warned_bad_value is False
+    m.write_events([("ok", 1.0, 1), ("bad", "not-a-number", 2),
+                    ("also_bad", None, 3), ("ok2", 2.5, 4)])
+    m.write_events([("later", "nope", 5), ("ok3", 3, 6)])
+    lines = open(os.path.join(str(tmp_path), "bad.csv")).read().splitlines()
+    assert lines[0] == "name,value,step"
+    names = [l.split(",")[0] for l in lines[1:]]
+    assert names == ["ok", "ok2", "ok3"]
+    # warned once (the flag latches after the first bad event)
+    assert m._warned_bad_value is True
+
+
 def test_comet_monitor_degrades_gracefully():
     from deepspeed_tpu.monitor.monitor import CometMonitor
     from deepspeed_tpu.runtime.config import CometConfig

@@ -1,0 +1,141 @@
+"""Summarize a telemetry dump (ISSUE 2): span trace + metrics.
+
+Usage::
+
+    python tools/telemetry_report.py TRACE.trace.json [METRICS.prom | METRICS.metrics.json] [--json]
+
+Reads the Chrome-trace JSON written by
+``telemetry.export_artifacts()`` (or any Chrome-trace file with ``X``
+events) and prints a per-span-name table — count, total/mean/max ms,
+share of top-level wall time — plus, when a metrics file is given, the
+scalar metric values (Prometheus text or the registry's JSON snapshot).
+
+``--json`` emits one machine-readable JSON object instead of tables
+(the smoke path CI exercises).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_trace(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def span_table(events: list[dict]) -> list[dict]:
+    """Per-name aggregate over complete ('X') events, sorted by total
+    duration descending."""
+    agg: dict[str, dict] = {}
+    for e in events:
+        dur_ms = float(e.get("dur", 0.0)) / 1e3
+        a = agg.setdefault(e["name"], {
+            "name": e["name"], "count": 0, "total_ms": 0.0,
+            "max_ms": 0.0})
+        a["count"] += 1
+        a["total_ms"] += dur_ms
+        a["max_ms"] = max(a["max_ms"], dur_ms)
+    rows = sorted(agg.values(), key=lambda r: -r["total_ms"])
+    for r in rows:
+        r["mean_ms"] = r["total_ms"] / max(r["count"], 1)
+    return rows
+
+
+def parse_prometheus(path: str) -> dict[str, float]:
+    """Flat {series: value} from Prometheus text exposition."""
+    out: dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                series, value = line.rsplit(None, 1)
+                out[series] = float(value)
+            except ValueError:
+                continue
+    return out
+
+
+def parse_metrics_json(path: str) -> dict[str, float]:
+    """Flat {series: value} from the registry's JSON snapshot (scalar
+    metrics + histogram count/sum/mean)."""
+    with open(path) as f:
+        snap = json.load(f)
+    out: dict[str, float] = {}
+    for name, meta in snap.items():
+        for entry in meta.get("values", []):
+            labels = entry.get("labels") or {}
+            suffix = "".join(f"/{k}={v}" for k, v in sorted(labels.items()))
+            if meta.get("type") == "histogram":
+                out[f"{name}{suffix}_count"] = entry.get("count", 0)
+                out[f"{name}{suffix}_sum"] = entry.get("sum", 0.0)
+                out[f"{name}{suffix}_mean"] = entry.get("mean", 0.0)
+            else:
+                out[f"{name}{suffix}"] = entry.get("value", 0.0)
+    return out
+
+
+def build_report(trace_path: str, metrics_path: str | None) -> dict:
+    events = load_trace(trace_path)
+    rows = span_table(events)
+    report = {
+        "trace": trace_path,
+        "n_events": len(events),
+        "span_names": len(rows),
+        "spans": rows,
+    }
+    if metrics_path:
+        if metrics_path.endswith(".json"):
+            report["metrics"] = parse_metrics_json(metrics_path)
+        else:
+            report["metrics"] = parse_prometheus(metrics_path)
+    return report
+
+
+def print_report(report: dict) -> None:
+    print(f"trace: {report['trace']} — {report['n_events']} events, "
+          f"{report['span_names']} span names")
+    print(f"{'span':<28}{'count':>8}{'total ms':>12}{'mean ms':>10}"
+          f"{'max ms':>10}")
+    for r in report["spans"]:
+        print(f"{r['name'][:27]:<28}{r['count']:>8}"
+              f"{r['total_ms']:>12.2f}{r['mean_ms']:>10.2f}"
+              f"{r['max_ms']:>10.2f}")
+    metrics = report.get("metrics")
+    if metrics:
+        print()
+        print(f"{'metric':<64}{'value':>14}")
+        for series in sorted(metrics):
+            v = metrics[series]
+            sval = f"{v:.6g}" if isinstance(v, float) else str(v)
+            print(f"{series[:63]:<64}{sval:>14}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a deepspeed_tpu telemetry dump")
+    ap.add_argument("trace", help="Chrome-trace JSON "
+                                  "(telemetry export_artifacts *.trace.json)")
+    ap.add_argument("metrics", nargs="?", default=None,
+                    help="optional *.prom (Prometheus text) or "
+                         "*.metrics.json (registry snapshot)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON object")
+    args = ap.parse_args(argv)
+    report = build_report(args.trace, args.metrics)
+    if args.json:
+        json.dump(report, sys.stdout)
+        print()
+    else:
+        print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
