@@ -55,10 +55,12 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         return len([d for d in jax.devices() if d.platform == "tpu"])
 
     def synchronize(self, device_index: Optional[int] = None) -> None:
-        # Drain the async dispatch queue on every local device.
+        # Drain the async dispatch queue on every local device. This IS
+        # the synchronization primitive: the per-device sync is its
+        # contract, not an accident.
         for d in self._devices():
             try:
-                jax.block_until_ready(
+                jax.block_until_ready(   # graftlint: disable=GL003
                     jax.device_put(0, d))
             except Exception:
                 pass

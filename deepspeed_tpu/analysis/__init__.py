@@ -1,0 +1,25 @@
+"""graftlint (ISSUE 3): JAX-aware static analysis + runtime sentinels.
+
+Two halves with opposite costs:
+
+- :mod:`.linter` / :mod:`.rules` — pure-``ast`` static analysis
+  (GL001-GL040: host syncs in jit-reachable code, recompile hazards,
+  donation gaps, dtype promotion, telemetry-probe enforcement). Imports
+  only the stdlib; run via ``python tools/graftlint.py`` or the tier-1
+  gate in ``tests/test_graftlint.py``. Catalog: docs/static-analysis.md.
+- :mod:`.sentinels` — runtime enforcement on the hot paths the linter
+  cannot see into: a recompile sentinel (piggybacking on the telemetry
+  bridges' jax.monitoring compile listener) asserting warmed-up steps
+  never retrace, and ``jax.transfer_guard``-based hot-path guards wired
+  into ``engine.train_batch`` and the v2 fused-decode dispatch/drain.
+  Imports jax — keep it out of linter import paths.
+
+Import note: this ``__init__`` stays jax-free so the CLI lints without
+paying a jax import; reach sentinels via
+``from deepspeed_tpu.analysis import sentinels``.
+"""
+
+from .core import Finding  # noqa: F401
+from .linter import (apply_baseline, diff_against_baseline,  # noqa: F401
+                     format_text, lint_paths, load_baseline, save_baseline)
+from .rules import ALL_RULES, RULES_BY_ID  # noqa: F401

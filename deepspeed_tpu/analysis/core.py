@@ -1,0 +1,551 @@
+"""graftlint core (ISSUE 3 tentpole): AST plumbing shared by every rule.
+
+The linter is pure ``ast`` — it never imports jax or the modules it
+checks, so it runs in milliseconds over the whole package and can lint
+broken/in-progress code. Precision comes from two analyses:
+
+- **jit-reachability** (:class:`ModuleIndex`): which functions' bodies
+  execute under a JAX trace. Roots are functions passed to
+  ``jax.jit`` / ``grad`` / ``vmap`` / ``shard_map`` / ``lax`` control
+  flow (directly, decorated, or through ``functools.partial``), plus —
+  because this framework jits across module boundaries
+  (``engine_v2.py`` jits ``paged.fused_decode_loop``) — any def whose
+  name the driver saw traced *anywhere* in the lint run
+  (``traced_names``). Functions lexically nested in, or called by name
+  from, reachable code are reachable.
+
+- **traced-value inference** (:meth:`ModuleIndex.traced_locals`): which
+  local names inside a reachable function hold device values. Seeded
+  from calls into ``jnp.*`` / ``jax.*`` (minus a host-metadata
+  allowlist: ``finfo``, ``eval_shape``, ``tree.map`` …) and propagated
+  through assignments. Deliberately does NOT treat bare parameters as
+  traced — partial-bound configs (``model``, ``use_kernel``) are
+  indistinguishable from arrays by name, and a linter that cries wolf
+  gets disabled. The cost is missing ``float(param)`` on a genuine
+  array param; the trace would raise loudly there anyway.
+
+Suppression syntax (same line or the line directly above)::
+
+    x = float(loss)   # graftlint: disable=GL001
+    # graftlint: disable=GL001,GL004 <optional justification>
+    # graftlint: disable            <all rules, use sparingly>
+
+File-level, in the first ten lines::
+
+    # graftlint: disable-file=GL020
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# --------------------------------------------------------------------
+# findings & suppressions
+# --------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    text: str = ""          # stripped source line (baseline matching key)
+
+    @property
+    def key(self) -> tuple:
+        """Line-number-free identity used by the baseline: a finding
+        only counts as NEW if its (rule, path, source text) triple is
+        not already in the baseline — pure line drift never trips the
+        gate."""
+        return (self.rule, self.path, self.text)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "text": self.text}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(rule=d["rule"], path=d["path"], line=int(d.get("line", 0)),
+                   col=int(d.get("col", 0)), message=d.get("message", ""),
+                   text=d.get("text", ""))
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?!-file)(?:=([A-Z0-9, ]+))?")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Z0-9, ]+)")
+
+
+def _comment_lines(source: str):
+    """(lineno, comment text) for every real COMMENT token — a
+    'graftlint: disable' inside a string/docstring must not suppress
+    anything. Falls back to a line scan on tokenize failure (the caller
+    already ast-parsed the source, so that's near-unreachable)."""
+    import io
+    import tokenize
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "#" in line:
+                yield i, line[line.index("#"):]
+
+
+class Suppressions:
+    """Per-file suppression table parsed from comments."""
+
+    def __init__(self, source: str):
+        self.by_line: dict[int, Optional[set[str]]] = {}  # None = all rules
+        self.file_rules: set[str] = set()
+        for i, comment in _comment_lines(source):
+            if "graftlint" not in comment:
+                continue
+            mf = _SUPPRESS_FILE_RE.search(comment)
+            if mf:
+                # file-level form is only honored near the top; further
+                # down it is ignored outright (NOT downgraded to a line
+                # suppression — `disable(?!-file)` above cannot match it)
+                if i <= 10:
+                    self.file_rules |= {r.strip()
+                                        for r in mf.group(1).split(",")
+                                        if r.strip()}
+                continue
+            m = _SUPPRESS_RE.search(comment)
+            if m:
+                rules = (None if m.group(1) is None else
+                         {r.strip() for r in m.group(1).split(",")
+                          if r.strip()})
+                self.by_line[i] = rules
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_rules:
+            return True
+        for ln in (line, line - 1):
+            if ln in self.by_line:
+                rules = self.by_line[ln]
+                if rules is None or rule in rules:
+                    return True
+        return False
+
+
+# --------------------------------------------------------------------
+# AST helpers
+# --------------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# jnp/jax attribute tails that return host metadata, not device values
+HOST_META_ATTRS = {
+    "finfo", "iinfo", "dtype", "shape", "ndim", "size", "result_type",
+    "promote_types", "issubdtype", "can_cast", "eval_shape",
+    "ShapeDtypeStruct", "default_backend", "devices", "device_count",
+    "local_device_count", "process_index", "process_count",
+    "make_jaxpr", "typeof", "named_scope", "debug",
+}
+
+# attribute accesses on a value that yield static (host) information
+STATIC_VALUE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize",
+                      "sharding", "aval", "weak_type"}
+
+# callables that introduce a traced context for a function argument
+TRACE_WRAPPERS = {
+    "jit", "grad", "value_and_grad", "vmap", "pmap", "checkpoint",
+    "remat", "shard_map", "scan", "while_loop", "cond", "fori_loop",
+    "switch", "custom_vjp", "custom_jvp",
+    "associative_scan", "named_call", "linearize", "vjp",
+    "jvp", "make_jaxpr",
+}
+# names too generic to match bare: builtin map(f, xs) / jax.tree.map
+# must not mark f as traced — require the lax prefix
+_PREFIX_REQUIRED = {"map": ("lax",)}
+
+# host-introspection builtins: a Name inside these is a type/shape
+# probe, not a device-value use
+HOST_INTROSPECTION = {"isinstance", "hasattr", "getattr", "len", "type",
+                      "id", "repr", "callable"}
+
+
+def attr_chain(node: ast.AST) -> list[str]:
+    """``jax.lax.scan`` Attribute/Name chain -> ["jax", "lax", "scan"];
+    empty when the chain bottoms out in a call/subscript."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return []
+
+
+def is_device_call(node: ast.AST) -> bool:
+    """A Call that produces a device value: rooted at jnp/jax (or
+    jax.numpy/lax/nn/random/scipy...), excluding host-metadata tails."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = attr_chain(node.func)
+    if not chain or chain[0] not in ("jnp", "jax", "lax"):
+        return False
+    if chain[-1] in HOST_META_ATTRS:
+        return False
+    # jax.tree.map / jax.tree_util.* operate on host containers
+    if len(chain) >= 2 and chain[1] in ("tree", "tree_util", "monitoring",
+                                        "profiler", "errors", "config",
+                                        "sharding", "debug"):
+        return False
+    if chain[-1] in ("jit", "vmap", "pmap", "grad", "value_and_grad",
+                     "checkpoint", "remat", "partial", "device_put"):
+        # transform constructors / explicit transfers are not *hidden*
+        # device computations at this site
+        return False
+    return True
+
+
+def contains_device_call(node: ast.AST) -> bool:
+    return any(is_device_call(n) for n in ast.walk(node))
+
+
+def _func_name_args(call: ast.Call) -> list[str]:
+    """Names of functions handed to a trace wrapper call: bare names,
+    ``functools.partial(f, ...)`` targets, and the terminal attribute of
+    method references (``self.module.loss`` -> ``loss``)."""
+    out: list[str] = []
+
+    def visit(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Name):
+            out.append(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            out.append(arg.attr)
+        elif isinstance(arg, ast.Call):
+            chain = attr_chain(arg.func)
+            if chain and chain[-1] == "partial" and arg.args:
+                visit(arg.args[0])
+    for a in call.args:
+        visit(a)
+    return out
+
+
+def iter_trace_wrapper_calls(tree: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] not in TRACE_WRAPPERS:
+                continue
+            need = _PREFIX_REQUIRED.get(chain[-1])
+            if need and (len(chain) < 2 or chain[-2] not in need):
+                continue
+            # jax.tree.map / tree_util.* never trace their argument
+            if len(chain) >= 2 and chain[-2] in ("tree", "tree_util"):
+                continue
+            yield node
+
+
+def collect_traced_names(tree: ast.AST) -> set[str]:
+    """Pass-1 API for the driver: function names this module hands to a
+    trace wrapper that it does NOT define itself (imported functions,
+    method references). Locally-defined jitted names are resolved by the
+    module's own ModuleIndex — exporting them would mark unrelated
+    same-named defs across the package (engine.py's local ``put``
+    closure must not make engine_v2's ``put`` method jit-reachable)."""
+    local_defs = {getattr(n, "name", None) for n in ast.walk(tree)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    names: set[str] = set()
+    for call in iter_trace_wrapper_calls(tree):
+        names.update(_func_name_args(call))
+    return names - local_defs
+
+
+# --------------------------------------------------------------------
+# per-module analysis
+# --------------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    node: ast.AST                       # FunctionDef/AsyncFunctionDef/Lambda
+    name: str
+    parent: Optional["FuncInfo"]
+    is_root: bool = False               # directly handed to a trace wrapper
+    reachable: bool = False             # body may run under trace
+    traced: set[str] = field(default_factory=set)   # device-valued locals
+
+
+class ModuleIndex:
+    """One file's parsed AST plus jit-reachability + traced-local facts.
+
+    ``external_traced_names``: function names known (from the whole lint
+    run's pass 1) to be traced somewhere — how cross-module jit sites
+    (engine_v2 jitting paged.fused_decode_loop) mark defs here.
+    """
+
+    def __init__(self, path: str, source: str,
+                 external_traced_names: Optional[set[str]] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = Suppressions(source)
+        self._external = external_traced_names or set()
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.functions: dict[ast.AST, FuncInfo] = {}
+        self._by_name: dict[str, list[FuncInfo]] = {}
+        self._build_functions()
+        self._mark_roots()
+        self._propagate_reachability()
+        for info in self.functions.values():
+            if info.reachable:
+                info.traced = self._infer_traced_locals(info)
+
+    # -- structure -------------------------------------------------
+    def _build_functions(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, _FUNC_NODES):
+                name = getattr(node, "name", "<lambda>")
+                parent = self.enclosing_function(node)
+                info = FuncInfo(node=node, name=name, parent=None)
+                self.functions[node] = info
+                self._by_name.setdefault(name, []).append(info)
+        for node, info in self.functions.items():
+            enc = self.enclosing_function(node)
+            info.parent = self.functions.get(enc) if enc is not None else None
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_NODES):
+                return cur
+            cur = self._parents.get(cur)
+        return None
+
+    def enclosing_info(self, node: ast.AST) -> Optional[FuncInfo]:
+        enc = self.enclosing_function(node)
+        return self.functions.get(enc) if enc is not None else None
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Node sits inside a for/while loop or comprehension within its
+        own function (loops outside the enclosing def don't count)."""
+        cur = self._parents.get(node)
+        while cur is not None and not isinstance(cur, _FUNC_NODES):
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor,
+                                ast.comprehension, ast.ListComp,
+                                ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                return True
+            cur = self._parents.get(cur)
+        return False
+
+    # -- jit-reachability ------------------------------------------
+    def _mark_roots(self) -> None:
+        for call in iter_trace_wrapper_calls(self.tree):
+            for name in _func_name_args(call):
+                for info in self._resolve_name_at(call, name):
+                    info.is_root = True
+            # inline lambda argument: jax.jit(lambda t: t, ...)
+            for a in call.args:
+                if isinstance(a, ast.Lambda) and a in self.functions:
+                    self.functions[a].is_root = True
+        for info in self.functions.values():
+            if info.name in self._external:
+                info.is_root = True
+            # decorator form: @jax.jit / @functools.partial(jax.jit, ...)
+            for dec in getattr(info.node, "decorator_list", []):
+                chain = attr_chain(dec if not isinstance(dec, ast.Call)
+                                   else dec.func)
+                if chain and chain[-1] in TRACE_WRAPPERS:
+                    info.is_root = True
+                if isinstance(dec, ast.Call) and chain \
+                        and chain[-1] == "partial":
+                    inner = attr_chain(dec.args[0]) if dec.args else []
+                    if inner and inner[-1] in TRACE_WRAPPERS:
+                        info.is_root = True
+
+    def _resolve_name_at(self, call: ast.AST, name: str) -> list[FuncInfo]:
+        """Defs `name` could refer to at this call site, innermost scope
+        first: a jit of a nested closure must not mark a same-named
+        method elsewhere in the module (hybrid_engine jits a local
+        ``generate``; the engine's ``generate`` METHOD is host code).
+        Falls back to every same-named def when no scope matches."""
+        candidates = self._by_name.get(name, [])
+        if len(candidates) <= 1:
+            return candidates
+        scope = self.enclosing_function(call)
+        while scope is not None:
+            scope_info = self.functions.get(scope)
+            local = [c for c in candidates if c.parent is scope_info]
+            if local:
+                return local
+            scope = self.enclosing_function(scope)
+        top = [c for c in candidates if c.parent is None]
+        return top or candidates
+
+    def _propagate_reachability(self) -> None:
+        for info in self.functions.values():
+            info.reachable = info.is_root
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions.values():
+                if info.reachable:
+                    continue
+                # lexically nested in a reachable function
+                if info.parent is not None and info.parent.reachable:
+                    info.reachable = True
+                    changed = True
+                    continue
+            # call edges: f() by name inside a reachable body
+            for info in list(self.functions.values()):
+                if not info.reachable:
+                    continue
+                for node in ast.walk(info.node):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        for callee in self._by_name.get(node.func.id, []):
+                            if not callee.reachable:
+                                callee.reachable = True
+                                changed = True
+
+    # -- traced locals ---------------------------------------------
+    def _infer_traced_locals(self, info: FuncInfo) -> set[str]:
+        """Names assigned (directly or transitively) from jnp/jax device
+        calls, in statement order, one forward pass per fixpoint round."""
+        traced: set[str] = set()
+
+        def expr_traced(expr: ast.AST) -> bool:
+            return self.mentions_device_value(expr, traced)
+
+        def name_targets(t: ast.AST) -> list[str]:
+            # only plain-Name (and tuple/list-of-Name) targets become
+            # traced: `x[i] = v` / `x.a = v` / `self.x = v` say nothing
+            # about the base name holding a device value
+            if isinstance(t, ast.Name):
+                return [t.id]
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out: list[str] = []
+                for e in t.elts:
+                    out.extend(name_targets(e))
+                return out
+            if isinstance(t, ast.Starred):
+                return name_targets(t.value)
+            return []
+
+        body = getattr(info.node, "body", None)
+        if body is None or isinstance(body, ast.AST):   # lambda
+            return traced
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(info.node):
+                targets: list[ast.AST] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                if value is None or not expr_traced(value):
+                    continue
+                for t in targets:
+                    for name in name_targets(t):
+                        if name not in traced:
+                            traced.add(name)
+                            changed = True
+        return traced
+
+    def mentions_device_value(self, expr: ast.AST, traced: set[str]) -> bool:
+        """Expression touches a device value: a jnp/jax device call, or
+        a traced local used as a value (not via .shape/.dtype/... and
+        not inside isinstance/hasattr/len/... host introspection)."""
+        intro_spans: list[tuple[int, int, int, int]] = []
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in HOST_INTROSPECTION:
+                if n.end_lineno is not None:
+                    intro_spans.append((n.lineno, n.col_offset,
+                                        n.end_lineno, n.end_col_offset))
+
+        def in_intro(n: ast.AST) -> bool:
+            for (l0, c0, l1, c1) in intro_spans:
+                if (l0, c0) <= (n.lineno, n.col_offset) \
+                        and (n.end_lineno, n.end_col_offset) <= (l1, c1):
+                    return True
+            return False
+
+        for n in ast.walk(expr):
+            if is_device_call(n) and not in_intro(n):
+                return True
+            if isinstance(n, ast.Name) and n.id in traced \
+                    and n.id not in ("self", "cls"):
+                p = self._parents.get(n)
+                if isinstance(p, ast.Attribute) \
+                        and p.attr in STATIC_VALUE_ATTRS:
+                    continue
+                if in_intro(n):
+                    continue
+                return True
+        return False
+
+    def traced_union(self, info: "FuncInfo") -> set[str]:
+        """Traced locals visible in ``info``: its own plus every
+        enclosing function's (closure reads)."""
+        out: set[str] = set()
+        cur: Optional[FuncInfo] = info
+        while cur is not None:
+            out |= cur.traced
+            cur = cur.parent
+        return out
+
+    # -- convenience -----------------------------------------------
+    def reachable_functions(self) -> list[FuncInfo]:
+        return [i for i in self.functions.values() if i.reachable]
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+# --------------------------------------------------------------------
+# rule protocol
+# --------------------------------------------------------------------
+
+
+class Context:
+    """What one rule sees for one file."""
+
+    def __init__(self, index: ModuleIndex, relpath: str):
+        self.index = index
+        self.relpath = relpath
+        self.findings: list[Finding] = []
+
+    def report(self, rule_id: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if self.index.suppressions.suppressed(rule_id, line):
+            return
+        self.findings.append(Finding(
+            rule=rule_id, path=self.relpath, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            text=self.index.line_text(line)))
+
+
+class Rule:
+    """Base class; subclasses set id/name/summary and implement check."""
+
+    id: str = "GL000"
+    name: str = "base"
+    summary: str = ""
+
+    def check(self, ctx: Context) -> None:     # pragma: no cover
+        raise NotImplementedError

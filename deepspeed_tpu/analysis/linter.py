@@ -1,0 +1,215 @@
+"""graftlint driver: walk files, run rules, diff against a baseline.
+
+Two passes over the file set:
+
+1. collect every function name handed to a trace wrapper anywhere
+   (``jax.jit``/``grad``/``lax.scan``/... — including through
+   ``functools.partial`` and method references), because this codebase
+   jits across module boundaries (engine_v2 jits paged.fused_decode_loop;
+   the engines jit model loss methods);
+2. lint each file with that global traced-name set seeding its
+   jit-reachability analysis.
+
+The gate is "no NEW violations": findings are matched against the
+baseline by (rule, path, source-line text) — not line numbers — so
+unrelated edits never trip it, while a pre-existing violation that gets
+*duplicated* does (counts are compared per key).
+
+This module imports only the stdlib — no jax — so the CLI and the
+tier-1 gate run in milliseconds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+from .core import Context, Finding, ModuleIndex, collect_traced_names
+from .rules import ALL_RULES, RULES_BY_ID
+
+BASELINE_DEFAULT = ".graftlint-baseline.json"
+BASELINE_VERSION = 1
+
+# directories never linted when walking a package tree
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "build", "dist"}
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    new: list[Finding] = field(default_factory=list)
+    errors: list[Finding] = field(default_factory=list)   # parse failures
+    files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.errors
+
+    def to_dict(self) -> dict:
+        return {
+            "version": BASELINE_VERSION,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.findings],
+            "new": [f.to_dict() for f in self.new],
+            "errors": [f.to_dict() for f in self.errors],
+        }
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def _relpath(path: str, root: Optional[str]) -> str:
+    if root:
+        try:
+            return os.path.relpath(path, root).replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def lint_paths(paths: Sequence[str], *,
+               rules: Optional[Sequence[str]] = None,
+               disable: Sequence[str] = (),
+               root: Optional[str] = None) -> LintResult:
+    """Lint files/trees. ``rules`` restricts to those ids; ``disable``
+    removes ids; ``root`` makes finding paths relative (baselines should
+    be repo-root-relative so they survive checkouts)."""
+    active = list(ALL_RULES)
+    if rules is not None:
+        unknown = [r for r in rules if r not in RULES_BY_ID]
+        if unknown:
+            raise ValueError(f"unknown rule id(s): {unknown}")
+        active = [RULES_BY_ID[r] for r in rules]
+    active = [r for r in active if r.id not in set(disable)]
+
+    files = list(iter_python_files(paths))
+    result = LintResult(files=len(files))
+
+    # pass 1: global traced-name registry
+    sources: dict[str, str] = {}
+    traced_names: set[str] = set()
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[path] = f.read()
+        except OSError as e:
+            result.errors.append(Finding(
+                rule="GL000", path=_relpath(path, root), line=0, col=0,
+                message=f"unreadable: {e}"))
+            continue
+        try:
+            import ast
+            traced_names |= collect_traced_names(ast.parse(sources[path]))
+        except SyntaxError:
+            pass    # reported in pass 2
+
+    # pass 2: per-file rules
+    for path in files:
+        if path not in sources:
+            continue
+        rel = _relpath(path, root)
+        try:
+            index = ModuleIndex(rel, sources[path],
+                                external_traced_names=traced_names)
+        except SyntaxError as e:
+            result.errors.append(Finding(
+                rule="GL000", path=rel, line=e.lineno or 0, col=0,
+                message=f"syntax error: {e.msg}"))
+            continue
+        ctx = Context(index, rel)
+        for rule in active:
+            rule.check(ctx)
+        result.findings.extend(ctx.findings)
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+# --------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}")
+    return [Finding.from_dict(d) for d in data.get("findings", [])]
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": BASELINE_VERSION,
+        "comment": ("graftlint accepted-violations baseline; regenerate "
+                    "with `python tools/graftlint.py <paths> "
+                    "--write-baseline` (see docs/static-analysis.md)"),
+        "findings": [f.to_dict() for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def diff_against_baseline(findings: Sequence[Finding],
+                          baseline: Sequence[Finding]) -> list[Finding]:
+    """Findings not covered by the baseline. Matched on
+    (rule, path, line text) with multiplicity: two identical violations
+    against a baseline holding one leaves one NEW."""
+    budget = Counter(f.key for f in baseline)
+    new: list[Finding] = []
+    for f in findings:
+        if budget[f.key] > 0:
+            budget[f.key] -= 1
+        else:
+            new.append(f)
+    return new
+
+
+def apply_baseline(result: LintResult, baseline_path: Optional[str]) -> None:
+    """Populate ``result.new`` (all findings are new when no baseline)."""
+    if baseline_path and os.path.exists(baseline_path):
+        base = load_baseline(baseline_path)
+        result.new = diff_against_baseline(result.findings, base)
+    else:
+        result.new = list(result.findings)
+
+
+# --------------------------------------------------------------------
+# formatting
+# --------------------------------------------------------------------
+
+
+def format_text(result: LintResult, *, baseline_used: bool) -> str:
+    out: list[str] = []
+    for f in result.errors:
+        out.append(f"{f.path}:{f.line}: {f.rule} {f.message}")
+    marked = {id(f) for f in result.new}
+    for f in result.findings:
+        tag = "" if id(f) in marked else " [baseline]"
+        out.append(f"{f.path}:{f.line}:{f.col}: {f.rule}{tag} {f.message}")
+        if f.text:
+            out.append(f"    {f.text}")
+    n_base = len(result.findings) - len(result.new)
+    summary = (f"graftlint: {result.files} files, "
+               f"{len(result.findings)} finding(s)")
+    if baseline_used:
+        summary += f" ({n_base} baselined, {len(result.new)} new)"
+    if result.errors:
+        summary += f", {len(result.errors)} file error(s)"
+    out.append(summary)
+    return "\n".join(out)

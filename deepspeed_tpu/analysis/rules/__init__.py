@@ -1,0 +1,14 @@
+"""graftlint rule registry: one module per rule family, each exporting
+``RULES``; the catalog below is the linter's (and the docs') single
+source of truth. IDs are stable — retired rules are never reused."""
+
+from __future__ import annotations
+
+from . import donation, dtype_rules, host_sync, recompile, telemetry_rules
+
+ALL_RULES = (host_sync.RULES + recompile.RULES + donation.RULES
+             + dtype_rules.RULES + telemetry_rules.RULES)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+assert len(RULES_BY_ID) == len(ALL_RULES), "duplicate rule id"

@@ -1,0 +1,111 @@
+"""Buffer-donation rules (GL020-GL021).
+
+An un-donated state buffer doubles the step's live memory (old + new
+state coexist across the dispatch) and forces XLA to emit copies where
+an in-place update was legal. The training engine's state-carrying jits
+donate; these rules keep it that way as the jit population grows.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, attr_chain
+
+# parameter names that mark a function as state-carrying: the value is
+# threaded call-to-call and the previous buffer dies with the dispatch
+STATE_PARAM_NAMES = {"state", "pools", "opt_state", "carry", "acc",
+                     "accum", "buffers"}
+
+
+def _jit_calls(ctx: Context):
+    for node in ast.walk(ctx.index.tree):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] == "jit" and node.args:
+                yield node
+
+
+def _has_donation(call: ast.Call) -> bool:
+    return any(k.arg in ("donate_argnums", "donate_argnames")
+               for k in call.keywords)
+
+
+def _resolve_target(ctx: Context, target: ast.AST):
+    """jit's first argument -> (function node, display name) when the
+    function is defined in this module; (None, None) for attributes and
+    imported callables (cross-module resolution isn't worth the false
+    positives)."""
+    if isinstance(target, ast.Lambda):
+        return target, "<lambda>"
+    if isinstance(target, ast.Name):
+        for info in ctx.index.functions.values():
+            if info.name == target.id and not isinstance(info.node,
+                                                         ast.Lambda):
+                return info.node, target.id
+        return None, None
+    if isinstance(target, ast.Call):
+        chain = attr_chain(target.func)
+        if chain and chain[-1] == "partial" and target.args:
+            return _resolve_target(ctx, target.args[0])
+    return None, None
+
+
+class StateJitWithoutDonation(Rule):
+    id = "GL020"
+    name = "state-jit-without-donation"
+    summary = ("jax.jit of a state-carrying step function (a parameter "
+               "named state/pools/opt_state/carry/acc/...) without "
+               "donate_argnums — the old state buffer stays live across "
+               "the dispatch, doubling step memory")
+
+    def check(self, ctx: Context) -> None:
+        for call in _jit_calls(ctx):
+            if _has_donation(call):
+                continue
+            fn, name = _resolve_target(ctx, call.args[0])
+            if fn is None:
+                continue
+            args = getattr(fn, "args", None)
+            if args is None:
+                continue
+            pos = [a.arg for a in args.posonlyargs + args.args]
+            stateful = [p for p in pos if p in STATE_PARAM_NAMES]
+            if stateful:
+                ctx.report(
+                    self.id, call,
+                    f"jax.jit({name}) carries state parameter(s) "
+                    f"{stateful} but donates nothing; add donate_argnums "
+                    "(or suppress with a comment explaining why the "
+                    "input must outlive the call)")
+
+
+class ReshardWithoutDonation(Rule):
+    id = "GL021"
+    name = "reshard-without-donation"
+    summary = ("jax.jit(lambda t: t, out_shardings=...) without donation "
+               "— an identity reshard that keeps source AND destination "
+               "buffers live; donating the input halves its memory "
+               "high-water")
+
+    def check(self, ctx: Context) -> None:
+        for call in _jit_calls(ctx):
+            if _has_donation(call):
+                continue
+            if not any(k.arg == "out_shardings" for k in call.keywords):
+                continue
+            target = call.args[0]
+            if not isinstance(target, ast.Lambda):
+                continue
+            args = target.args
+            pos = args.posonlyargs + args.args
+            if len(pos) == 1 and not args.kwonlyargs \
+                    and isinstance(target.body, ast.Name) \
+                    and target.body.id == pos[0].arg:
+                ctx.report(
+                    self.id, call,
+                    "identity-reshard jit without donate_argnums: the "
+                    "input layout is dead after the copy — donate it")
+
+
+RULES = [StateJitWithoutDonation(), ReshardWithoutDonation()]

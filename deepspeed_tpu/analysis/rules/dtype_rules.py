@@ -1,0 +1,70 @@
+"""Dtype-promotion rules (GL030).
+
+JAX's weak-type rules keep bare Python floats from widening bf16
+arithmetic — but a constant wrapped in ``np.float32(...)`` /
+``jnp.array(0.5)`` is a committed 32-bit array, and one of them in a
+bf16 compute path silently promotes every downstream op to f32 (2x HBM
+traffic on the promoted tensors; the MXU path may change too).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, attr_chain
+
+_WIDENING_CASTS = {("np", "float32"), ("np", "float64"),
+                   ("numpy", "float32"), ("numpy", "float64"),
+                   ("jnp", "float32"), ("jnp", "float64")}
+_ARRAY_CTORS = {("np", "array"), ("np", "asarray"),
+                ("jnp", "array"), ("jnp", "asarray")}
+
+
+def _is_float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.USub, ast.UAdd)):
+        return _is_float_literal(node.operand)
+    return False
+
+
+class NonWeakFloatConstant(Rule):
+    id = "GL030"
+    name = "non-weak-float-constant"
+    summary = ("committed 32/64-bit float constant (np.float32(c), "
+               "jnp.array(c)) used in arithmetic inside jit-reachable "
+               "code — upcasts bf16 operands where a weak Python float "
+               "would not")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            for node in ast.walk(info.node):
+                if ctx.index.enclosing_function(node) is not info.node:
+                    continue
+                if not isinstance(node, ast.BinOp):
+                    continue
+                for side in (node.left, node.right):
+                    if self._widening_const(side):
+                        ctx.report(
+                            self.id, side,
+                            "committed float constant in arithmetic "
+                            "under jit: use a bare Python float (weak "
+                            "type follows the array operand) or cast "
+                            "with .astype(x.dtype)")
+                        break
+
+    @staticmethod
+    def _widening_const(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call) or not node.args:
+            return False
+        chain = tuple(attr_chain(node.func))
+        if chain in _WIDENING_CASTS:
+            return _is_float_literal(node.args[0])
+        if chain in _ARRAY_CTORS and not any(
+                k.arg == "dtype" for k in node.keywords):
+            return _is_float_literal(node.args[0])
+        return False
+
+
+RULES = [NonWeakFloatConstant()]

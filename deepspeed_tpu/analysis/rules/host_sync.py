@@ -1,0 +1,174 @@
+"""Host-synchronization rules (GL001-GL005).
+
+The class of bug that cost PR 1 a 125 ms host-dispatch RTT against
+17 ms of TPU work: device values pulled to the host (or host round
+trips hidden in traced code) on paths that should stay async.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, attr_chain, contains_device_call
+
+_SCALAR_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _reachable_nodes(ctx: Context):
+    """(info, traced-union, node) triples, each node yielded exactly
+    once — owned by its innermost enclosing function."""
+    for info in ctx.index.reachable_functions():
+        traced = ctx.index.traced_union(info)
+        for node in ast.walk(info.node):
+            if node is info.node:
+                continue
+            enc = ctx.index.enclosing_function(node)
+            if enc is not info.node:
+                continue
+            yield info, traced, node
+
+
+def _mentions_traced(index, expr: ast.AST, traced: set[str]) -> bool:
+    return index.mentions_device_value(expr, traced)
+
+
+class HostSyncInJit(Rule):
+    id = "GL001"
+    name = "host-sync-in-jit"
+    summary = (".item()/float()/int()/bool() on a device value inside "
+               "jit-reachable code — a host sync baked into the traced "
+               "program (raises at trace time or, worse, silently "
+               "retraces per call)")
+
+    def check(self, ctx: Context) -> None:
+        for info, traced, node in _reachable_nodes(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.item()
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                ctx.report(self.id, node,
+                           ".item() inside jit-reachable code is a "
+                           "device->host sync; keep the value on "
+                           "device or move the read to a flush "
+                           "boundary")
+                continue
+            # float(x)/int(x)/bool(x) on a traced value
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _SCALAR_CASTS \
+                    and len(node.args) == 1 \
+                    and _mentions_traced(ctx.index, node.args[0], traced):
+                ctx.report(
+                    self.id, node,
+                    f"{node.func.id}() of a traced value inside "
+                    "jit-reachable code forces a host sync; use jnp "
+                    "ops (astype/where) to stay on device")
+
+
+class TracedTruthiness(Rule):
+    id = "GL002"
+    name = "traced-truthiness"
+    summary = ("Python if/while/assert on a device value inside "
+               "jit-reachable code — implicit bool() is a host sync (and "
+               "a per-value retrace when it survives tracing)")
+
+    def check(self, ctx: Context) -> None:
+        for info, traced, node in _reachable_nodes(ctx):
+            if isinstance(node, (ast.If, ast.While, ast.Assert, ast.IfExp)):
+                test = node.test
+            else:
+                continue
+            # `x is None` / `x is not None` arg-presence checks are
+            # host-static by construction
+            if isinstance(test, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+                continue
+            if _mentions_traced(ctx.index, test, traced):
+                ctx.report(
+                    self.id, node,
+                    "branching on a device value inside jit-reachable "
+                    "code; use jnp.where / lax.cond to keep control "
+                    "flow in-graph")
+
+
+class BlockUntilReadyInLoop(Rule):
+    id = "GL003"
+    name = "sync-in-loop"
+    summary = ("block_until_ready inside a Python loop — serializes "
+               "dispatch against device completion every iteration, "
+               "killing dispatch-ahead")
+
+    def check(self, ctx: Context) -> None:
+        for node in ast.walk(ctx.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            is_method = (isinstance(node.func, ast.Attribute)
+                         and node.func.attr == "block_until_ready")
+            chain = attr_chain(node.func)
+            is_fn = bool(chain) and chain[-1] == "block_until_ready"
+            if (is_method or is_fn) and ctx.index.in_loop(node):
+                ctx.report(
+                    self.id, node,
+                    "block_until_ready in a loop syncs every iteration; "
+                    "hoist the sync past the loop (or batch the work "
+                    "into one dispatch)")
+
+
+class ScalarPullInHostLoop(Rule):
+    id = "GL004"
+    name = "scalar-pull-in-host-loop"
+    summary = ("float()/int()/bool() wrapped around a jnp/jax computation "
+               "inside a host loop — one blocking device round trip per "
+               "iteration (the per-leaf sync pattern); fuse the reduction "
+               "into one jit and pull one scalar")
+
+    def check(self, ctx: Context) -> None:
+        for node in ast.walk(ctx.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in _SCALAR_CASTS
+                    and len(node.args) == 1):
+                continue
+            info = ctx.index.enclosing_info(node)
+            if info is not None and info.reachable:
+                continue       # GL001's territory
+            if not contains_device_call(node.args[0]):
+                continue
+            if ctx.index.in_loop(node):
+                ctx.report(
+                    self.id, node,
+                    f"{node.func.id}(<device computation>) inside a host "
+                    "loop blocks once per iteration; compute the "
+                    "reduction for all items in one jitted call and "
+                    "transfer a single scalar")
+
+
+class AsarrayOfTraced(Rule):
+    id = "GL005"
+    name = "asarray-of-traced"
+    summary = ("np.asarray/np.array of a traced value inside "
+               "jit-reachable code — materializes the array on host "
+               "mid-trace (ConcretizationError at best)")
+
+    def check(self, ctx: Context) -> None:
+        for info, traced, node in _reachable_nodes(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if len(chain) != 2 or chain[0] not in ("np", "numpy") \
+                    or chain[1] not in ("asarray", "array"):
+                continue
+            if node.args and _mentions_traced(
+                    ctx.index, node.args[0], traced):
+                ctx.report(
+                    self.id, node,
+                    f"np.{chain[1]}() of a traced value inside "
+                    "jit-reachable code; use jnp.asarray (stays on "
+                    "device) or move the conversion outside the "
+                    "traced function")
+
+
+RULES = [HostSyncInJit(), TracedTruthiness(), BlockUntilReadyInLoop(),
+         ScalarPullInHostLoop(), AsarrayOfTraced()]

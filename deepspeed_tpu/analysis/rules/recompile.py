@@ -1,0 +1,237 @@
+"""Recompile-hazard rules (GL010-GL012).
+
+T3's (arXiv:2401.16677) observation for collectives holds for the whole
+dispatch path: throughput dies on trace/compile gaps, not kernels. These
+rules flag patterns that bake call-varying host values into the traced
+program — every distinct value is a silent recompile.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule, attr_chain
+
+# parameter names that are near-certainly arrays at a jit boundary
+ARRAYISH_PARAM_NAMES = {
+    "params", "state", "batch", "tokens", "grads", "grad", "pools",
+    "x", "xs", "arr", "tree", "leaf", "logits", "kv", "cache", "master",
+    "opt_state", "acc", "carry", "inputs", "labels",
+}
+
+
+def _bare_param_names(node: ast.AST) -> set[str]:
+    """Positional, default-less parameter names of a function def —
+    the ones bound per call at a jit boundary (params with literal
+    defaults are config-like and usually partial-bound static)."""
+    args = getattr(node, "args", None)
+    if args is None:
+        return set()
+    pos = args.posonlyargs + args.args
+    n_default = len(args.defaults)
+    no_default = pos[:len(pos) - n_default] if n_default else pos
+    return {a.arg for a in no_default}
+
+
+class ControlFlowOnCallVaryingValue(Rule):
+    id = "GL010"
+    name = "trace-varying-control-flow"
+    summary = ("Python if/while/for over a bare per-call parameter of a "
+               "jit-root function — the branch is resolved at trace time, "
+               "so every distinct value compiles a new executable")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            if not info.is_root:
+                continue
+            params = _bare_param_names(info.node)
+            if not params:
+                continue
+            for node in ast.walk(info.node):
+                if ctx.index.enclosing_function(node) is not info.node:
+                    continue        # nested defs have their own params
+                if isinstance(node, (ast.If, ast.While)):
+                    expr = node.test
+                elif isinstance(node, ast.For):
+                    expr = node.iter
+                else:
+                    continue
+                hit = self._bare_param_ref(expr, params)
+                if hit:
+                    ctx.report(
+                        self.id, node,
+                        f"control flow over per-call parameter "
+                        f"'{hit}' inside a jit root: each distinct value "
+                        "traces a new program — make it static "
+                        "(closure/partial) or move the branch in-graph "
+                        "(lax.cond / jnp.where)")
+
+    @classmethod
+    def _bare_param_ref(cls, expr: ast.AST, params: set[str]):
+        """A param used as a bare VALUE operand of the test itself.
+        Descends only through boolean/arithmetic/comparison structure:
+        a param inside a call (``len(x)``, ``is_quantized(x)``), behind
+        an attribute (``cfg.flag``, ``x.shape``) or subscript is
+        trace-time host plumbing, and identity/membership tests
+        (``x is None``, ``name in cache``) are the static-idiom escape
+        hatches — none of those are per-value retrace hazards we can
+        call with confidence."""
+        if isinstance(expr, ast.Name):
+            return expr.id if expr.id in params else None
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                hit = cls._bare_param_ref(v, params)
+                if hit:
+                    return hit
+            return None
+        if isinstance(expr, ast.UnaryOp):
+            return cls._bare_param_ref(expr.operand, params)
+        if isinstance(expr, ast.BinOp):
+            return (cls._bare_param_ref(expr.left, params)
+                    or cls._bare_param_ref(expr.right, params))
+        if isinstance(expr, ast.Compare):
+            if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in expr.ops):
+                return None
+            for v in (expr.left, *expr.comparators):
+                hit = cls._bare_param_ref(v, params)
+                if hit:
+                    return hit
+            return None
+        if isinstance(expr, ast.Call):
+            # only range(param) — the canonical trace-varying loop bound
+            if isinstance(expr.func, ast.Name) and expr.func.id == "range":
+                for a in expr.args:
+                    hit = cls._bare_param_ref(a, params)
+                    if hit:
+                        return hit
+            return None
+        return None
+
+
+class StaticArgnumsOnArray(Rule):
+    id = "GL011"
+    name = "static-argnums-on-array"
+    summary = ("static_argnums/static_argnames covering a likely-array "
+               "parameter — arrays hashed as static recompile per value "
+               "(or fail to hash at all)")
+
+    def check(self, ctx: Context) -> None:
+        for node in ast.walk(ctx.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "jit":
+                continue
+            static_kw = {k.arg: k.value for k in node.keywords
+                         if k.arg in ("static_argnums", "static_argnames")}
+            if not static_kw or not node.args:
+                continue
+            target = node.args[0]
+            fn = self._resolve(ctx, target)
+            if fn is None:
+                continue
+            args = getattr(fn, "args", None)
+            if args is None:
+                continue
+            pos = [a.arg for a in args.posonlyargs + args.args]
+            bad: list[str] = []
+            nums = static_kw.get("static_argnums")
+            if nums is not None:
+                for idx in self._int_elts(nums):
+                    if 0 <= idx < len(pos) \
+                            and pos[idx] in ARRAYISH_PARAM_NAMES:
+                        bad.append(pos[idx])
+            names = static_kw.get("static_argnames")
+            if names is not None:
+                for s in self._str_elts(names):
+                    if s in ARRAYISH_PARAM_NAMES:
+                        bad.append(s)
+            if bad:
+                ctx.report(
+                    self.id, node,
+                    f"static_argnums/argnames covers parameter(s) "
+                    f"{bad} that look like arrays; arrays must be "
+                    "traced operands, not static hash keys")
+
+    @staticmethod
+    def _resolve(ctx: Context, target: ast.AST):
+        if isinstance(target, ast.Lambda):
+            return target
+        if isinstance(target, ast.Name):
+            for info in ctx.index.functions.values():
+                if info.name == target.id:
+                    return info.node
+        return None
+
+    @staticmethod
+    def _int_elts(node: ast.AST) -> list[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)]
+        return []
+
+    @staticmethod
+    def _str_elts(node: ast.AST) -> list[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        return []
+
+
+_CLOCK_CHAINS = {("time", "time"), ("time", "perf_counter"),
+                 ("time", "monotonic"), ("time", "process_time")}
+
+
+class HostEffectUnderJit(Rule):
+    id = "GL012"
+    name = "host-effect-under-jit"
+    summary = ("print()/time.time()/f-string-on-traced-value inside "
+               "jit-reachable code — runs once at trace time, then never "
+               "again (stale logs, zero timings), or forces a retrace")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            traced = ctx.index.traced_union(info)
+            for node in ast.walk(info.node):
+                if ctx.index.enclosing_function(node) is not info.node:
+                    continue
+                if isinstance(node, ast.Call):
+                    chain = tuple(attr_chain(node.func))
+                    if chain == ("print",):
+                        ctx.report(
+                            self.id, node,
+                            "print() under jit executes at trace time "
+                            "only; use jax.debug.print for runtime "
+                            "values")
+                    elif chain in _CLOCK_CHAINS:
+                        ctx.report(
+                            self.id, node,
+                            f"{'.'.join(chain)}() under jit is evaluated "
+                            "once at trace time — it cannot measure the "
+                            "compiled program; time at the dispatch "
+                            "boundary instead")
+                elif isinstance(node, ast.JoinedStr):
+                    for v in node.values:
+                        if isinstance(v, ast.FormattedValue) and any(
+                                isinstance(n, ast.Name)
+                                and n.id in traced
+                                and n.id not in ("self", "cls")
+                                for n in ast.walk(v.value)):
+                            ctx.report(
+                                self.id, node,
+                                "f-string formatting a traced value "
+                                "under jit embeds the tracer repr at "
+                                "trace time (or retraces); format at "
+                                "the host boundary")
+                            break
+
+
+RULES = [ControlFlowOnCallVaryingValue(), StaticArgnumsOnArray(),
+         HostEffectUnderJit()]

@@ -1,0 +1,57 @@
+"""Telemetry-boundary rule (GL040, satellite of ISSUE 3).
+
+The ISSUE 2 overhead contract: a telemetry-disabled run must never
+import ``deepspeed_tpu.telemetry`` — instrumented call sites go through
+``utils/telemetry_probe.py`` (a ``sys.modules`` probe) so the disabled
+path allocates nothing. A direct import anywhere else silently breaks
+the contract for the whole process; this rule makes the probe the
+single enforced gateway.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Context, Rule
+
+# modules allowed to name the package: the probe itself (its activate()
+# helper is THE sanctioned import point) and the package's own files
+def _allowed(relpath: str) -> bool:
+    p = relpath.replace("\\", "/")
+    return (p.endswith("utils/telemetry_probe.py")
+            or "telemetry" in p.split("/")[:-1])
+
+
+class DirectTelemetryImport(Rule):
+    id = "GL040"
+    name = "direct-telemetry-import"
+    summary = ("deepspeed_tpu.telemetry imported outside "
+               "utils/telemetry_probe.py — breaks the zero-import "
+               "disabled-mode contract; go through the probe "
+               "(active_telemetry()/tel_span()/activate())")
+
+    def check(self, ctx: Context) -> None:
+        if _allowed(ctx.relpath):
+            return
+        for node in ast.walk(ctx.index.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if "telemetry" in alias.name.split("."):
+                        self._flag(ctx, node)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.split(".")[-1] == "telemetry" \
+                        or ".telemetry." in f".{mod}.":
+                    self._flag(ctx, node)
+                elif any(a.name == "telemetry" for a in node.names):
+                    self._flag(ctx, node)
+
+    def _flag(self, ctx: Context, node: ast.AST) -> None:
+        ctx.report(
+            self.id, node,
+            "import of deepspeed_tpu.telemetry outside the probe; use "
+            "utils.telemetry_probe (active_telemetry/tel_span, or "
+            "activate() to turn telemetry on)")
+
+
+RULES = [DirectTelemetryImport()]

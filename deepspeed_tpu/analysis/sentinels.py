@@ -1,0 +1,121 @@
+"""Runtime sentinels (ISSUE 3 tentpole part 2): enforcement the linter
+cannot do statically, on the two hot paths that matter.
+
+- :class:`RecompileSentinel` — asserts a warmed-up step never retraces.
+  Piggybacks on the telemetry bridges' ``jax.monitoring`` compile
+  listener (ISSUE 2): ``backend_compile`` fires once per executable
+  built and never on an executable-cache hit, so "zero events inside
+  the watch window" == "no recompile". The caller declares *expected*
+  compiles (warmup, a new bucket shape, a curriculum seqlen change)
+  via :meth:`expect`; an unexpected one raises :class:`RecompileError`
+  (or warns, per ``mode``) naming the label — catching shape/dtype
+  drift that would otherwise silently recompile every step.
+
+- :func:`hot_path_guard` — ``jax.transfer_guard("disallow")`` scoped to
+  a dispatch/drain region: implicit host<->device transfers (a Python
+  scalar riding into an op, a hidden __array__ pull) raise immediately,
+  while explicit ones (``jax.device_put``, the fused-decode token drain
+  via ``np.asarray``/``jax.device_get``) stay legal. This is precisely
+  the contract of the fused decode loop: K ticks per dispatch with the
+  token ring buffer as the only host read.
+
+Wired into ``engine.train_batch`` (the compiled-step dispatch) and the
+v2 fused-decode dispatch/drain behind opt-in config
+(``sentinels.enabled`` / ``RaggedInferenceEngineConfig.sentinels``) —
+zero overhead when off. This module imports jax; the linter half of the
+analysis package deliberately does not.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from ..utils.logging import logger
+
+
+class RecompileError(RuntimeError):
+    """A warmed-up hot path compiled a new executable."""
+
+
+def _compile_count() -> int:
+    # the bridges listener keeps plain process-wide tallies even while
+    # the telemetry registry is inactive — install once, read forever
+    from ..telemetry import bridges  # graftlint: disable=GL040
+    # (sentinels are opt-in runtime enforcement: enabling them is an
+    # explicit request for the listener, unlike passive hot-path
+    # instrumentation which must stay zero-import)
+    bridges.install_jax_compile_listener()
+    return bridges.compile_event_count("backend_compile")
+
+
+def install() -> None:
+    """Install the shared compile listener now (idempotent). Calling it
+    before warmup keeps the first watch window honest."""
+    _compile_count()
+
+
+class RecompileSentinel:
+    """Watches a labelled hot path for unexpected executable builds.
+
+    Usage::
+
+        s = RecompileSentinel("train_batch", mode="raise", warmup_calls=1)
+        with s.watch():            # call 1: warmup, compiles allowed
+            step(state, batch)
+        with s.watch():            # steady state: a compile here raises
+            step(state, batch)
+        s.expect("curriculum seqlen changed")
+        with s.watch():            # declared: allowed once
+            step(state, batch)
+    """
+
+    def __init__(self, label: str, mode: str = "raise",
+                 warmup_calls: int = 1):
+        if mode not in ("raise", "warn"):
+            raise ValueError(f"sentinel mode must be raise|warn, got {mode!r}")
+        self.label = label
+        self.mode = mode
+        self.warmup_calls = int(warmup_calls)
+        self.calls = 0
+        self.violations = 0
+        self.compiles_seen = 0
+        self._expected: Optional[str] = None
+        install()
+
+    def expect(self, reason: str = "expected") -> None:
+        """Declare that the next watched window may compile (new bucket
+        shape, rebuilt jit, fallback path). Consumed by one window."""
+        self._expected = reason
+
+    @contextlib.contextmanager
+    def watch(self):
+        before = _compile_count()
+        try:
+            yield
+        finally:
+            delta = _compile_count() - before
+            self.calls += 1
+            self.compiles_seen += delta
+            expected, self._expected = self._expected, None
+            if delta and expected is None \
+                    and self.calls > self.warmup_calls:
+                self.violations += 1
+                msg = (f"recompile sentinel [{self.label}]: "
+                       f"{delta} executable build(s) on call "
+                       f"{self.calls} after warmup "
+                       f"({self.warmup_calls}) — shape/dtype drift is "
+                       "recompiling a warmed-up hot path")
+                if self.mode == "raise":
+                    raise RecompileError(msg)
+                logger.warning(msg)
+
+
+def hot_path_guard(enabled: bool = True):
+    """``jax.transfer_guard("disallow")`` as a reusable scope: implicit
+    transfers raise, explicit ones pass. No-op when ``enabled`` is
+    false so call sites don't branch."""
+    if not enabled:
+        return contextlib.nullcontext()
+    import jax
+    return jax.transfer_guard("disallow")

@@ -122,6 +122,14 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     sampling_top_p: float = 0.0
     # sequences terminate in-graph when they sample this token
     eos_token_id: Optional[int] = None
+    # runtime sentinels (ISSUE 3, analysis/sentinels.py): every fused
+    # decode dispatch runs under a recompile watch (a previously-seen
+    # (jit key, operand shapes) signature must hit the executable
+    # cache) and jax.transfer_guard("disallow") (implicit host<->device
+    # transfers raise; the explicit token drain stays legal). Off by
+    # default — zero overhead, nothing imported.
+    sentinels: bool = False
+    sentinel_mode: str = "raise"          # or "warn"
 
 
 class InferenceEngineV2:
@@ -190,6 +198,22 @@ class InferenceEngineV2:
         # fused-decode executables: one per (num_steps, sampling, eos)
         # combination; XLA adds a per-bucket-shape cache underneath
         self._fused_cache: dict = {}
+        # sentinels (opt-in): lazily imported so a sentinel-off serving
+        # process never pulls analysis/ or the telemetry package
+        self._decode_sentinel = None
+        self._hot_guard = None
+        self._fused_sigs: set = set()
+        # base PRNG key per seed, built once: PRNGKey(int) is an
+        # implicit host->device upload, which must not ride every
+        # fused dispatch (it would trip the transfer guard — and is
+        # per-dispatch host work for a value that never changes)
+        self._seed_keys: dict[int, jnp.ndarray] = {}
+        if config.sentinels:
+            from ...analysis.sentinels import (RecompileSentinel,
+                                               hot_path_guard)
+            self._decode_sentinel = RecompileSentinel(
+                "fused_decode", mode=config.sentinel_mode, warmup_calls=0)
+            self._hot_guard = hot_path_guard
         # serving counters behind serving_metrics(): host dispatches vs
         # decoded tokens measures how host-free the decode loop is
         self.serving_stats = dict(
@@ -371,6 +395,18 @@ class InferenceEngineV2:
     # fused multi-step decode: K ticks per host dispatch, sampling and
     # termination in-graph (the FastGen kernel-resident decode loop)
 
+    def _base_key(self, seed: int) -> jnp.ndarray:
+        key = self._seed_keys.get(seed)
+        if key is None:
+            # bound the cache: seed is a caller-supplied kwarg, and a
+            # server feeding a fresh seed per request must not grow
+            # this dict forever (keys are cheap to rebuild)
+            if len(self._seed_keys) >= 64:
+                self._seed_keys.clear()
+            key = self._seed_keys.setdefault(seed,
+                                             jax.random.PRNGKey(seed))
+        return key
+
     def _sampling_args(self, temperature, top_k, top_p, eos_id):
         """Per-call overrides over the config's sampling defaults."""
         c = self._config
@@ -431,13 +467,43 @@ class InferenceEngineV2:
         # per-row PRNG keys: uid folded into the base key (pad rows get
         # sentinel ids); each loop step folds in the token position, so
         # sampling is invariant to the dispatch grouping
-        base = jax.random.PRNGKey(seed)
-        ids = jnp.asarray(list(uids)
-                          + [(1 << 30) + i for i in range(bb - len(uids))],
-                          jnp.uint32)
+        base = self._base_key(seed)
+        # via numpy: jnp.asarray of a LIST is an implicit
+        # convert_element_type upload (trips the transfer guard); a
+        # numpy array takes the explicit device_put path
+        ids = jnp.asarray(np.asarray(
+            list(uids) + [(1 << 30) + i for i in range(bb - len(uids))],
+            np.uint32))
         row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(ids)
         return (jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(tables),
                 jnp.asarray(act), jnp.asarray(rem), row_keys)
+
+    def _fused_dispatch_scope(self, fn_key: tuple, ops: tuple,
+                              variant: str = "host"):
+        """Sentinel scope for ONE fused dispatch: a new (jit key,
+        operand shape/dtype, variant) signature may compile; a seen one
+        must hit the executable cache — and under the transfer guard no
+        implicit host transfer may ride the dispatch (operands are
+        already device arrays; the loop carry never leaves the device).
+
+        ``variant`` separates host-built operands from device-carry
+        operands: their avals match but their shardings don't (fresh
+        ``jnp.asarray`` uploads vs committed jit outputs), so XLA keeps
+        one executable per variant — a fact this sentinel itself
+        surfaced when first wired in."""
+        s = self._decode_sentinel
+        if s is None:
+            return _NULLCM
+        sig = (fn_key, variant,
+               tuple((tuple(a.shape), str(a.dtype)) for a in ops))
+        if sig not in self._fused_sigs:
+            self._fused_sigs.add(sig)
+            s.expect("new fused bucket/sampling signature")
+        import contextlib
+        stack = contextlib.ExitStack()
+        stack.enter_context(s.watch())
+        stack.enter_context(self._hot_guard())
+        return stack
 
     def decode_fused(self, batch_uids: Sequence[int],
                      k_steps: Optional[int] = None, *,
@@ -477,8 +543,10 @@ class InferenceEngineV2:
             fn = self._fused_fn(k, temperature, top_k, top_p, eos)
             st["host_dispatches"] += 1
             st["fused_dispatches"] += 1
-            out, steps, _, _, _, _, self.pools = fn(
-                self.params, self.pools, *ops)
+            with self._fused_dispatch_scope(
+                    (k, temperature, top_k, top_p, eos), ops):
+                out, steps, _, _, _, _, self.pools = fn(
+                    self.params, self.pools, *ops)
             toks = np.asarray(out)[:len(uids)]
             mgr = self.state_manager
             res: dict[int, list[int]] = {}
@@ -504,8 +572,11 @@ class InferenceEngineV2:
             return
         reg.histogram(
             "ds_serving_fused_dispatch_seconds",
-            "wall time of one fused decode dispatch (K in-graph steps, "
-            "incl. device sync)").observe(dt)
+            "host-blocking time of one fused decode dispatch: full "
+            "dispatch (operands+enqueue+drain) on the decode_fused "
+            "path, ring-buffer drain only on the double-buffered "
+            "generate_fused path (its enqueue overlaps device "
+            "work)").observe(dt)
         tel.bridges.collect_serving(reg, self.serving_metrics())
         reg.gauge("ds_serving_free_kv_blocks",
                   "free blocks in the paged KV pool").set(
@@ -607,7 +678,10 @@ class InferenceEngineV2:
                     # not ours (scheduled by another caller): re-stash
                     self._finished_stash[u] = finished[u]
                     continue
-                live[u].append(int(jnp.argmax(finished[u])))
+                # per-token host argmax IS the per-tick driver's cost
+                # model (one RTT per token, documented above);
+                # generate_fused() is the production path
+                live[u].append(int(jnp.argmax(finished[u])))  # graftlint: disable=GL004
                 self.serving_stats["decoded_tokens"] += 1
                 if lat is not None:
                     lat.tokens(u, 1, first=len(live[u]) == 1)
@@ -723,14 +797,26 @@ class InferenceEngineV2:
                         if not mgr.seqs[u].pending:
                             firsts[u] = logits[i]
                             filling.remove(u)
-            for u, lg in firsts.items():
-                key = sampling.position_keys(
-                    jax.random.fold_in(jax.random.PRNGKey(seed),
-                                       jnp.uint32(u))[None],
-                    jnp.asarray([mgr.seqs[u].seen]))
-                tok = int(sampling.sample_tokens_batched(
-                    jnp.asarray(lg)[None].astype(jnp.float32), key,
-                    temperature=temperature, top_k=top_k, top_p=top_p)[0])
+            if not firsts:
+                return
+            # first tokens for the WHOLE admission batch sampled in one
+            # device call and drained with one transfer (was a
+            # per-sequence int() sync — graftlint GL004). Keys are the
+            # same per-(uid, position) stream the in-graph loop uses,
+            # so fused/per-tick parity is unchanged.
+            uids_f = list(firsts)
+            base = self._base_key(seed)
+            row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(
+                jnp.asarray(np.asarray(uids_f, np.uint32)))
+            keys = sampling.position_keys(
+                row_keys,
+                jnp.asarray(np.asarray([mgr.seqs[u].seen
+                                        for u in uids_f])))
+            toks_dev = sampling.sample_tokens_batched(
+                jnp.stack([firsts[u] for u in uids_f]).astype(jnp.float32),
+                keys, temperature=temperature, top_k=top_k, top_p=top_p)
+            for u, tok in zip(uids_f, jax.device_get(toks_dev)):
+                tok = int(tok)
                 live[u].append(tok)
                 stats["decoded_tokens"] += 1
                 if lat is not None:
@@ -790,9 +876,14 @@ class InferenceEngineV2:
                                dispatch_id=stats["fused_dispatches"] + 1,
                                rows=len(rowset), k=k)
                       if tel is not None else _NULLCM):
-                    out, steps, t2, p2, a2, r2, self.pools = fn(
-                        self.params, self.pools, tok_a, pos_a, tables,
-                        act_a, rem_a, row_keys)
+                    with self._fused_dispatch_scope(
+                            (k, temperature, top_k, top_p, eos),
+                            (tok_a, pos_a, tables, act_a, rem_a,
+                             row_keys),
+                            variant="carry" if n_enq > 0 else "host"):
+                        out, steps, t2, p2, a2, r2, self.pools = fn(
+                            self.params, self.pools, tok_a, pos_a, tables,
+                            act_a, rem_a, row_keys)
                 carry = (t2, p2, a2, r2)
                 n_enq += 1
                 infl.append((list(rowset), out, steps))
@@ -808,8 +899,15 @@ class InferenceEngineV2:
             t_drain = time.perf_counter() if tel is not None else 0.0
             with (tel.span("v2/fused_drain", rows=len(rows))
                   if tel is not None else _NULLCM):
-                toks = np.asarray(out)
-                n_exec = int(steps)
+                # the drain is the ONE sanctioned host read of the
+                # decode loop; under the sentinel it runs inside
+                # transfer_guard("disallow"), which still admits these
+                # EXPLICIT device->host pulls — anything implicit
+                # sneaking in here raises
+                with (self._hot_guard() if self._hot_guard is not None
+                      else _NULLCM):
+                    toks = np.asarray(out)
+                    n_exec = int(steps)
             stats["fused_steps"] += n_exec
             stats["fused_slots"] += n_exec * len(rows)
             membership_changed = False

@@ -369,6 +369,16 @@ def fused_decode_loop(model, params: PyTree, pools: PyTree,
     fused dispatch while the per-token block/offset arithmetic happens
     in-graph. The loop exits early once every row is inactive.
 
+    Host-free contract (enforced, not just documented): a dispatch of
+    this loop performs NO host<->device transfer — operands arrive as
+    committed device arrays, the carry never leaves the device, and
+    the ring buffer is drained by one explicit pull. The engine's
+    sentinel mode (``RaggedInferenceEngineConfig.sentinels``) runs
+    every dispatch under ``jax.transfer_guard("disallow")`` plus a
+    recompile watch, so a future edit that sneaks a host value into
+    the loop (or drifts a shape) fails loudly instead of silently
+    serializing decode. See docs/static-analysis.md.
+
     Returns ``(out_tokens [B, num_steps] (-1 beyond each row's emits),
     steps_run [], tokens, pos, active, remaining, pools)`` — the carry
     comes back so the host (or a chained dispatch) can continue without

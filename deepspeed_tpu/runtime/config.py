@@ -195,6 +195,28 @@ class TelemetryConfig(DeepSpeedConfigModel):
     flush_interval_steps: int = 0
 
 
+class SentinelsConfig(DeepSpeedConfigModel):
+    """Runtime dispatch-discipline enforcement (ISSUE 3,
+    ``deepspeed_tpu/analysis/sentinels.py``): a recompile sentinel that
+    asserts the warmed-up compiled step never retraces (catching silent
+    shape/dtype drift that would recompile every step), and a
+    ``jax.transfer_guard("disallow")`` scope around the hot dispatch so
+    implicit host<->device transfers raise instead of silently
+    serializing the pipeline. Complements the static ``graftlint``
+    checks (``tools/graftlint.py``) at runtime. Disabled by default —
+    nothing is imported and the dispatch path is untouched. See
+    docs/static-analysis.md."""
+    enabled: bool = False
+    # "raise" fails fast (tests/bench); "warn" logs and keeps going
+    mode: Literal["raise", "warn"] = "raise"
+    # arm the no-recompile assertion on train_batch
+    recompile: bool = True
+    # wrap the compiled-step dispatch in transfer_guard("disallow")
+    transfer_guard: bool = True
+    # dispatches allowed to compile before the assertion arms
+    warmup_steps: int = 1
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -318,6 +340,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         default_factory=SequenceParallelConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    sentinels: SentinelsConfig = Field(default_factory=SentinelsConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

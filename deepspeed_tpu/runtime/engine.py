@@ -19,6 +19,7 @@ prefetch coordinator + IPG buckets play in the reference
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable, Iterable, Optional
 
@@ -264,6 +265,7 @@ class DeepSpeedEngine:
         self._micro_grads_jit = None
         self._accum_add_jit = None
         self._apply_grads_jit = None
+        self._grad_stats_jit = None
         self._accum_grads = None
         self._micro_count = 0
         # deferred dp-reduction state for the eager triple (no_sync)
@@ -302,8 +304,23 @@ class DeepSpeedEngine:
         # wall_clock_breakdown — the fwd/bwd/step breakdown events are
         # sourced from span data, so the tracer must be live for them
         if self.config.telemetry.enabled or self.config.wall_clock_breakdown:
-            from .. import telemetry
-            telemetry.configure(self.config.telemetry)
+            from ..utils.telemetry_probe import activate
+            activate(self.config.telemetry)
+        # runtime sentinels (ISSUE 3): recompile + transfer-guard
+        # enforcement on the compiled-step dispatch, opt-in via config
+        self._recompile_sentinel = None
+        self._hot_guard = None
+        self._last_batch_struct = None
+        sent_cfg = self.config.sentinels
+        if sent_cfg.enabled:
+            from ..analysis.sentinels import (RecompileSentinel,
+                                              hot_path_guard)
+            if sent_cfg.recompile:
+                self._recompile_sentinel = RecompileSentinel(
+                    "train_batch", mode=sent_cfg.mode,
+                    warmup_calls=sent_cfg.warmup_steps)
+            if sent_cfg.transfer_guard:
+                self._hot_guard = hot_path_guard
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} "
             f"dtype={self.compute_dtype.__name__} mesh={self.topology} "
@@ -449,6 +466,10 @@ class DeepSpeedEngine:
             "host-memory offload placement unsupported on backend "
             f"{jax.default_backend()!r} ({str(err).splitlines()[0][:120]}); "
             "keeping optimizer state in device memory")
+        if getattr(self, "_recompile_sentinel", None) is not None:
+            # the rebuilt step legitimately compiles on the retry
+            self._recompile_sentinel.expect(
+                "pinned_host fallback rebuilt the compiled step")
         self.state_shardings = jax.tree.map(
             lambda s: (NamedSharding(s.mesh, s.spec)
                        if getattr(s, "memory_kind", None) == "pinned_host"
@@ -672,6 +693,10 @@ class DeepSpeedEngine:
                        "loss_scale": ls.scale, "overflow": ~finite}
             return grads, ls, metrics
 
+        # state is deliberately NOT donated: params/loss_scale must
+        # outlive the call (the host-side NVMe optimizer reads them
+        # after grads come back)
+        # graftlint: disable=GL020
         return jax.jit(grads_step,
                        out_shardings=(named_shardings(mesh, grad_specs),
                                       None, None))
@@ -747,17 +772,19 @@ class DeepSpeedEngine:
                 # durations track true per-step wall time
                 with (tel.span("compiled_step")
                       if tel is not None else _NULLCM):
-                    try:
-                        self.state, metrics = self._train_step(
-                            self.state, batch)
-                    except jax.errors.JaxRuntimeError as e:
-                        if not (self._uses_host_memory
-                                and ("annotate_device_placement" in str(e)
-                                     or "Side-effect" in str(e))):
-                            raise
-                        self._disable_host_memory(e)
-                        self.state, metrics = self._train_step(
-                            self.state, batch)
+                    with self._dispatch_scope(batch):
+                        try:
+                            self.state, metrics = self._train_step(
+                                self.state, batch)
+                        except jax.errors.JaxRuntimeError as e:
+                            if not (self._uses_host_memory
+                                    and ("annotate_device_placement"
+                                         in str(e)
+                                         or "Side-effect" in str(e))):
+                                raise
+                            self._disable_host_memory(e)
+                            self.state, metrics = self._train_step(
+                                self.state, batch)
             self.global_steps += 1
             self.global_samples += self.train_batch_size_
             self._last_metrics = metrics
@@ -790,6 +817,31 @@ class DeepSpeedEngine:
                                self.global_samples))
             self.monitor.write_events(events)
         return metrics["loss"]
+
+    def _dispatch_scope(self, batch):
+        """Sentinel scope around the compiled-step dispatch (ISSUE 3):
+        after warmup the step must hit the executable cache — a compile
+        means shape/dtype drift is silently retracing every step — and
+        under the transfer guard no implicit host<->device transfer may
+        ride the dispatch (state and batch are committed device arrays;
+        metrics are read later, at sync boundaries). Batch-structure
+        changes the engine KNOWS about (curriculum seqlen) are declared
+        to the sentinel, not raised."""
+        s = self._recompile_sentinel
+        if s is None and self._hot_guard is None:
+            return _NULLCM
+        stack = contextlib.ExitStack()
+        if s is not None:
+            struct = tuple((tuple(x.shape), str(x.dtype))
+                           for x in jax.tree.leaves(batch))
+            if struct != self._last_batch_struct:
+                if self._last_batch_struct is not None:
+                    s.expect("batch abstract shapes/dtypes changed")
+                self._last_batch_struct = struct
+            stack.enter_context(s.watch())
+        if self._hot_guard is not None:
+            stack.enter_context(self._hot_guard())
+        return stack
 
     def _applied_steps(self) -> int:
         """Number of optimizer steps actually applied (the optax count) —
@@ -1035,9 +1087,22 @@ class DeepSpeedEngine:
             import math
             scale = float(self.state["loss_scale"].scale)
             inv = 1.0 / (scale * self.gradient_accumulation_steps_)
-            leaves = jax.tree.leaves(self._accum_grads)
-            finite = all(bool(jnp.isfinite(g).all()) for g in leaves) \
-                if self.fp16_enabled else True
+            # one fused device reduction + one host pull for overflow
+            # check AND grad norm (was a per-leaf bool()/float() sync
+            # loop — graftlint GL004: each leaf cost a blocking round
+            # trip before the host optimizer could even start)
+            if self._grad_stats_jit is None:
+                def _grad_stats(grads):
+                    leaves = jax.tree.leaves(grads)
+                    finite = functools.reduce(
+                        jnp.logical_and,
+                        [jnp.isfinite(g).all() for g in leaves])
+                    sq = sum(jnp.sum(jnp.square(g)) for g in leaves)
+                    return finite, sq
+                self._grad_stats_jit = jax.jit(_grad_stats)
+            finite_dev, sq_dev = self._grad_stats_jit(self._accum_grads)
+            finite_np, sq_np = jax.device_get((finite_dev, sq_dev))
+            finite = bool(finite_np) if self.fp16_enabled else True
             if self.fp16_enabled:
                 fp16_cfg = self.config.fp16
                 self.state["loss_scale"] = update_loss_scale(
@@ -1047,8 +1112,7 @@ class DeepSpeedEngine:
                     min_scale=fp16_cfg.min_loss_scale,
                     hysteresis=fp16_cfg.hysteresis)
             if finite:
-                sq = sum(float(jnp.sum(jnp.square(g))) for g in leaves)
-                norm = math.sqrt(sq) * inv
+                norm = math.sqrt(float(sq_np)) * inv
                 clip = self.config.gradient_clipping
                 coef = min(1.0, clip / (norm + 1e-6)) if clip > 0 else 1.0
                 step_before = int(self.state["step"])

@@ -190,8 +190,8 @@ class StreamedZeroEngine:
         self.skipped_steps = 0
         self._last_metrics = None
         if config.telemetry.enabled or config.wall_clock_breakdown:
-            from .. import telemetry
-            telemetry.configure(config.telemetry)
+            from ..utils.telemetry_probe import activate
+            activate(config.telemetry)
         n = self.model_config.num_params()
         cdt_size = jnp.dtype(self.compute_dtype).itemsize
         if self._nvme:
@@ -328,7 +328,10 @@ class StreamedZeroEngine:
                     return flat[_n]
                 leaf = jax.jit(
                     pick, out_shardings=self._host_sh)(rng)
-                leaf.block_until_ready()
+                # deliberate per-leaf sync: exactly ONE fp32 leaf may be
+                # in flight — overlapping inits would stack their full
+                # fp32 buffers and defeat the bounded-RAM init
+                leaf.block_until_ready()   # graftlint: disable=GL003
                 if self._nvme:
                     # one leaf at a time: fp32 never accumulates in RAM
                     arr = np.asarray(leaf)

@@ -131,9 +131,13 @@ class NVMeOffloadOptimizer:
                                                  engine.plan.grad_specs)
         self._param_shardings = engine.state_shardings["params"]
         # compiled reshard (grad layout -> param layout): emits the
-        # all-gather that re-replicates updated params where needed
+        # all-gather that re-replicates updated params where needed.
+        # Donated (graftlint GL021): the grad-layout tree is rebuilt
+        # from host shards every step, so keeping it alive across the
+        # reshard would double the params' device footprint
         self._reshard_jit = jax.jit(
-            lambda t: t, out_shardings=self._param_shardings)
+            lambda t: t, donate_argnums=(0,),
+            out_shardings=self._param_shardings)
         self._build_shards(jax.device_put(engine.state["params"],
                                           self._update_shardings))
         n_bytes = sum(r.master.nbytes for r in self._shards)

@@ -29,24 +29,43 @@ from .registry import MetricsRegistry
 
 _JAX_LISTENER_INSTALLED = False
 
+# plain process-wide compile-event tallies, independent of the registry
+# lifecycle: the analysis/sentinels.py recompile sentinel reads these, so
+# it works with telemetry configured OR shut down (the registry mirror
+# below additionally feeds ds_jax_compile_total when active)
+_COMPILE_EVENTS: dict[str, int] = {}
+
+
+def compile_event_count(phase: str = "backend_compile") -> int:
+    """Monotonic count of jax compile-path events seen by this process's
+    listener. ``backend_compile`` fires exactly once per executable
+    built (trace/lowering phases can fire more) — the signal the
+    recompile sentinel watches. Returns 0 until the listener is
+    installed."""
+    return _COMPILE_EVENTS.get(phase, 0)
+
 
 def install_jax_compile_listener() -> None:
     """Capture jit compile count/time via ``jax.monitoring``. Installed
-    once per process; the listener reads the live registry on each
-    event, so it becomes a no-op after ``telemetry.shutdown()`` (jax
-    offers no per-listener removal)."""
+    once per process; the registry half reads the live registry on each
+    event, so it no-ops after ``telemetry.shutdown()`` (jax offers no
+    per-listener removal) while the plain tallies keep counting for the
+    sentinels."""
     global _JAX_LISTENER_INSTALLED
     if _JAX_LISTENER_INSTALLED:
         return
     import jax
 
     def _on_duration(name: str, dur_s: float, **kw) -> None:
-        reg = _registry_mod.get_registry()
-        if reg is None or "/compile/" not in name:
+        if "/compile/" not in name:
             return
         phase = name.rsplit("/", 1)[-1]
         if phase.endswith("_duration"):
             phase = phase[: -len("_duration")]
+        _COMPILE_EVENTS[phase] = _COMPILE_EVENTS.get(phase, 0) + 1
+        reg = _registry_mod.get_registry()
+        if reg is None:
+            return
         reg.counter("ds_jax_compile_total",
                     "jax compile-path events by phase").inc(phase=phase)
         reg.counter("ds_jax_compile_seconds_total",

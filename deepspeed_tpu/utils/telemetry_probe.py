@@ -31,3 +31,14 @@ def tel_span(name: str, **tags):
     """A telemetry span when active, else the shared no-op context."""
     mod = active_telemetry()
     return mod.span(name, **tags) if mod is not None else NULL_CM
+
+
+def activate(config=None) -> None:
+    """THE sanctioned import point for ``deepspeed_tpu.telemetry``:
+    engines that decide telemetry should be on (config block, CLI flag)
+    call this instead of importing the package themselves, so graftlint
+    rule GL040 can hold every other module to the zero-import contract.
+    ``config`` is the engine's TelemetryConfig block (or None for
+    defaults); idempotent like ``telemetry.configure``."""
+    from .. import telemetry
+    telemetry.configure(config)

@@ -129,6 +129,13 @@ _SLOW = {
     # fresh-interpreter subprocess (two small compiles); the in-process
     # disabled-mode test covers the same hot paths in the default tier
     ("test_telemetry.py", "test_disabled_guard_no_import_no_state"),
+    # sentinel variants with tier-1 siblings: the compile-once + guard
+    # acceptance tests stay tier-1; these cover declared-shape-change /
+    # stochastic-parity wrinkles on extra engine builds
+    ("test_graftlint.py",
+     "test_train_batch_sentinel_accepts_declared_shape_change"),
+    ("test_graftlint.py",
+     "test_generate_fused_runs_with_sentinels_and_matches"),
 }
 
 

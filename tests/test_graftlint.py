@@ -1,0 +1,596 @@
+"""graftlint (ISSUE 3): per-rule fixtures, suppression/baseline
+semantics, the package-wide gate, and the runtime sentinels
+(recompile + transfer-guard regression tests for train_batch and the
+fused decode loop)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis import (ALL_RULES, RULES_BY_ID,
+                                    diff_against_baseline, lint_paths,
+                                    load_baseline, save_baseline)
+from deepspeed_tpu.analysis.core import Finding
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "deepspeed_tpu")
+BASELINE = os.path.join(REPO, ".graftlint-baseline.json")
+
+
+def _lint_src(tmp_path, src, name="fix.py", **kw):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([str(p)], root=str(tmp_path), **kw)
+
+
+# ---------------------------------------------------------------------
+# rule fixtures: (positive source, negative source) per rule id. The
+# positive test doubles as the acceptance check that the GATE depends
+# on the rule: disabling the rule must drop the finding.
+# ---------------------------------------------------------------------
+
+FIXTURES = {
+    "GL001": (
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            y = jnp.sum(x)
+            return float(y)
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            return jnp.sum(x)
+        def host(arr):
+            return float(np_total(arr))
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL002": (
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            m = jnp.max(x)
+            if m > 0:
+                return x
+            return -x
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def step(x, flag=None):
+            if flag is not None:
+                return x * 2
+            m = jnp.max(x)
+            return jnp.where(m > 0, x, -x)
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL003": (
+        """
+        def drive(fn, xs):
+            outs = []
+            for x in xs:
+                out = fn(x)
+                out.block_until_ready()
+                outs.append(out)
+            return outs
+        """,
+        """
+        import jax
+        def drive(fn, xs):
+            outs = [fn(x) for x in xs]
+            jax.block_until_ready(outs)
+            return outs
+        """,
+    ),
+    "GL004": (
+        """
+        import jax.numpy as jnp
+        def grad_norm_sq(leaves):
+            return sum(float(jnp.sum(jnp.square(g))) for g in leaves)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def grad_norm_sq(leaves):
+            sq = jax.jit(lambda ls: sum(jnp.sum(jnp.square(g))
+                                        for g in ls))(leaves)
+            return float(sq)
+        """,
+    ),
+    "GL005": (
+        """
+        import jax, jax.numpy as jnp
+        import numpy as np
+        def step(x):
+            y = jnp.exp(x)
+            host = np.asarray(y)
+            return host
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        import numpy as np
+        def step(x):
+            return jnp.exp(x)
+        def drain(out):
+            return np.asarray(out)
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL010": (
+        """
+        import jax
+        def unroll(x, n):
+            for _ in range(n):
+                x = x + 1
+            return x
+        unroll_j = jax.jit(unroll)
+        """,
+        """
+        import jax, functools
+        def unroll(x, n=4):
+            for _ in range(n):
+                x = x + 1
+            return x
+        unroll_j = jax.jit(functools.partial(unroll, n=8))
+        """,
+    ),
+    "GL011": (
+        """
+        import jax
+        def apply(params, scale):
+            return params
+        apply_j = jax.jit(apply, static_argnums=(0,))
+        """,
+        """
+        import jax
+        def apply(params, group_size):
+            return params
+        apply_j = jax.jit(apply, static_argnums=(1,))
+        """,
+    ),
+    "GL012": (
+        """
+        import jax, time
+        def step(x):
+            t0 = time.time()
+            print("stepping")
+            return x * 2
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, time
+        def step(x):
+            return x * 2
+        def timed(fn, x):
+            t0 = time.time()
+            out = fn(x)
+            print("took", time.time() - t0)
+            return out
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL020": (
+        """
+        import jax
+        def train_step(state, batch):
+            return state
+        f = jax.jit(train_step)
+        """,
+        """
+        import jax
+        def train_step(state, batch):
+            return state
+        f = jax.jit(train_step, donate_argnums=(0,))
+        """,
+    ),
+    "GL021": (
+        """
+        import jax
+        def build(sh):
+            return jax.jit(lambda t: t, out_shardings=sh)
+        """,
+        """
+        import jax
+        def build(sh):
+            return jax.jit(lambda t: t, donate_argnums=(0,),
+                           out_shardings=sh)
+        """,
+    ),
+    "GL030": (
+        """
+        import jax
+        import numpy as np
+        def step(x):
+            return x * np.float32(0.5)
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax
+        def step(x):
+            return x * 0.5
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL040": (
+        """
+        from deepspeed_tpu import telemetry
+        def report():
+            return telemetry.get_registry()
+        """,
+        """
+        from deepspeed_tpu.utils.telemetry_probe import active_telemetry
+        def report():
+            tel = active_telemetry()
+            return tel.get_registry() if tel is not None else None
+        """,
+    ),
+}
+
+
+def test_every_rule_has_a_fixture():
+    assert set(FIXTURES) == set(RULES_BY_ID), (
+        "rule catalog and fixture table drifted apart")
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_fires_on_positive_fixture(tmp_path, rule_id):
+    pos, _ = FIXTURES[rule_id]
+    res = _lint_src(tmp_path, pos)
+    hits = [f for f in res.findings if f.rule == rule_id]
+    assert hits, (f"{rule_id} missed its positive fixture; got "
+                  f"{[(f.rule, f.line) for f in res.findings]}")
+    # acceptance: the gate depends on the rule — disabling it must
+    # drop the finding
+    res_off = _lint_src(tmp_path, pos, disable=[rule_id])
+    assert not [f for f in res_off.findings if f.rule == rule_id]
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_quiet_on_negative_fixture(tmp_path, rule_id):
+    _, neg = FIXTURES[rule_id]
+    name = ("utils/telemetry_probe.py" if rule_id == "GL040" else "fix.py")
+    res = _lint_src(tmp_path, neg, name=name)
+    hits = [f for f in res.findings if f.rule == rule_id]
+    assert not hits, f"{rule_id} false-positive: {hits}"
+
+
+def test_gl040_probe_and_package_are_exempt(tmp_path):
+    src = FIXTURES["GL040"][0]
+    assert _lint_src(tmp_path, src,
+                     name="utils/telemetry_probe.py").findings == []
+    assert _lint_src(tmp_path, src,
+                     name="telemetry/bridges.py").findings == []
+
+
+def test_cross_module_jit_marks_defs(tmp_path):
+    """engine_v2-style cross-module jit: the module DEFINING the
+    function has no jit call, the module USING it does."""
+    (tmp_path / "kernels.py").write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        def fused_loop(x):
+            m = jnp.max(x)
+            return float(m)
+    """))
+    (tmp_path / "engine.py").write_text(textwrap.dedent("""
+        import jax, functools
+        from kernels import fused_loop
+        f = jax.jit(functools.partial(fused_loop))
+    """))
+    res = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert any(f.rule == "GL001" and f.path == "kernels.py"
+               for f in res.findings)
+
+
+def test_local_jit_name_does_not_poison_other_modules(tmp_path):
+    """A locally-defined jitted closure named `generate` must not make
+    an unrelated module's host method `generate` jit-reachable."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        import jax
+        def build():
+            def generate(x):
+                return x * 2
+            return jax.jit(generate)
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        import time
+        class Engine:
+            def generate(self, prompts):
+                t0 = time.time()
+                return [p for p in prompts], time.time() - t0
+    """))
+    res = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert not [f for f in res.findings if f.path == "b.py"], res.findings
+
+
+# ---------------------------------------------------------------------
+# suppression + baseline semantics
+# ---------------------------------------------------------------------
+
+def test_suppression_same_line_and_line_above(tmp_path):
+    base = """
+    import jax, jax.numpy as jnp
+    def step(x):
+        y = jnp.sum(x)
+        return float(y){suffix}
+    step_j = jax.jit(step)
+    """
+    assert _lint_src(tmp_path, base.format(
+        suffix="  # graftlint: disable=GL001")).findings == []
+    above = """
+    import jax, jax.numpy as jnp
+    def step(x):
+        y = jnp.sum(x)
+        # graftlint: disable=GL001
+        return float(y)
+    step_j = jax.jit(step)
+    """
+    assert _lint_src(tmp_path, above).findings == []
+    # a different rule id does NOT suppress
+    wrong = base.format(suffix="  # graftlint: disable=GL002")
+    assert [f.rule for f in _lint_src(tmp_path, wrong).findings] == ["GL001"]
+    # bare disable suppresses everything on the line
+    bare = base.format(suffix="  # graftlint: disable")
+    assert _lint_src(tmp_path, bare).findings == []
+
+
+def test_suppression_only_in_real_comments(tmp_path):
+    """'graftlint: disable' inside a string/docstring must not
+    suppress, and a late disable-file is ignored outright (never
+    downgraded to a suppress-all line suppression)."""
+    src = '''
+    import jax, jax.numpy as jnp
+    def step(x):
+        y = jnp.sum(x)
+        msg = "# graftlint: disable"
+        return float(y)
+    step_j = jax.jit(step)
+    '''
+    assert [f.rule for f in _lint_src(tmp_path, src).findings] == ["GL001"]
+    late = "\n" * 14 + textwrap.dedent('''
+    import jax, jax.numpy as jnp
+    # graftlint: disable-file=GL001
+    def step(x):
+        y = jnp.sum(x)
+        return float(y)
+    step_j = jax.jit(step)
+    ''')
+    p = tmp_path / "late.py"
+    p.write_text(late)
+    res = lint_paths([str(p)], root=str(tmp_path))
+    assert [f.rule for f in res.findings] == ["GL001"]
+
+
+def test_file_level_suppression(tmp_path):
+    src = """
+    # graftlint: disable-file=GL001
+    import jax, jax.numpy as jnp
+    def step(x):
+        y = jnp.sum(x)
+        return float(y)
+    step_j = jax.jit(step)
+    """
+    assert _lint_src(tmp_path, src).findings == []
+
+
+def test_baseline_diff_is_line_drift_immune(tmp_path):
+    f1 = Finding(rule="GL001", path="a.py", line=10, col=0,
+                 message="m", text="return float(y)")
+    # same violation moved to another line: covered
+    moved = Finding(rule="GL001", path="a.py", line=99, col=4,
+                    message="m", text="return float(y)")
+    assert diff_against_baseline([moved], [f1]) == []
+    # a DUPLICATED violation against a single-entry baseline is new
+    assert diff_against_baseline([moved, moved], [f1]) == [moved]
+    # different text is new
+    other = Finding(rule="GL001", path="a.py", line=10, col=0,
+                    message="m", text="return float(z)")
+    assert diff_against_baseline([other], [f1]) == [other]
+
+
+def test_baseline_roundtrip(tmp_path):
+    res = _lint_src(tmp_path, FIXTURES["GL020"][0])
+    assert res.findings
+    bpath = str(tmp_path / "base.json")
+    save_baseline(bpath, res.findings)
+    loaded = load_baseline(bpath)
+    assert diff_against_baseline(res.findings, loaded) == []
+
+
+# ---------------------------------------------------------------------
+# the package-wide gate (acceptance: exits clean vs committed baseline)
+# ---------------------------------------------------------------------
+
+def test_package_gate_no_new_violations():
+    res = lint_paths([PACKAGE], root=REPO)
+    assert not res.errors, res.errors
+    baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else []
+    new = diff_against_baseline(res.findings, baseline)
+    assert not new, (
+        "graftlint: NEW violations vs .graftlint-baseline.json "
+        "(fix them, suppress with a justified `# graftlint: disable=`"
+        " comment, or — for accepted debt — regenerate the baseline "
+        "via `python tools/graftlint.py deepspeed_tpu "
+        "--write-baseline`):\n"
+        + "\n".join(f"{f.path}:{f.line}: {f.rule} {f.message}"
+                    for f in new))
+
+
+def test_cli_json_and_exit_code(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         PACKAGE, "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["version"] == 1 and data["new"] == []
+    lr = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         "--list-rules"], capture_output=True, text=True, timeout=120)
+    for rule in ALL_RULES:
+        assert rule.id in lr.stdout
+
+
+def test_cli_fails_on_new_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(FIXTURES["GL001"][0]))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         str(bad), "--baseline", "none"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "GL001" in out.stdout
+
+
+# ---------------------------------------------------------------------
+# runtime sentinels
+# ---------------------------------------------------------------------
+
+def test_recompile_sentinel_semantics():
+    from deepspeed_tpu.analysis.sentinels import (RecompileError,
+                                                  RecompileSentinel)
+    s = RecompileSentinel("unit", mode="raise", warmup_calls=1)
+    f = jax.jit(lambda x: x * 2)
+    with s.watch():
+        f(jnp.arange(4))            # warmup: compile allowed
+    with s.watch():
+        f(jnp.arange(4))            # cache hit: fine
+    assert s.violations == 0 and s.compiles_seen >= 1
+    with pytest.raises(RecompileError):
+        with s.watch():
+            f(jnp.arange(5))        # undeclared shape change
+    s.expect("declared shape change")
+    with s.watch():
+        f(jnp.arange(6))            # declared: fine
+    assert s.violations == 1
+
+
+def test_recompile_sentinel_warn_mode():
+    from deepspeed_tpu.analysis.sentinels import RecompileSentinel
+    s = RecompileSentinel("unit-warn", mode="warn", warmup_calls=0)
+    f = jax.jit(lambda x: x + 1)
+    with s.watch():
+        f(jnp.arange(7))            # compiles; warns instead of raising
+    assert s.violations == 1
+
+
+def _train_engine(**over):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    cfg = {"train_batch_size": 8, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000, "mesh": {"fsdp": -1},
+           "sentinels": {"enabled": True, "mode": "raise"}}
+    cfg.update(over)
+    engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config=cfg)
+    return engine
+
+
+def _batch(seed=0, b=8, s=16):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (b, s + 1),
+                                0, 512)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_train_batch_compiles_once_sentinel_enforced(devices8):
+    """Acceptance: steady-state train_batch compiles exactly once after
+    warmup — enforced by the sentinel (raise mode) AND measured by the
+    telemetry compile counter staying flat."""
+    from deepspeed_tpu import telemetry
+    telemetry.shutdown()
+    engine = _train_engine(telemetry={"enabled": True})
+    try:
+        batch = _batch()
+        engine.train_batch(batch)            # warmup: traces + compiles
+        reg = telemetry.get_registry()
+        after_warm = reg.counter("ds_jax_compile_total").value(
+            phase="backend_compile")
+        for _ in range(3):                   # sentinel raises on drift
+            engine.train_batch(batch)
+        steady = reg.counter("ds_jax_compile_total").value(
+            phase="backend_compile")
+        assert steady == after_warm, (
+            f"steady-state train_batch recompiled: {after_warm} -> "
+            f"{steady} backend_compile events")
+        assert engine._recompile_sentinel.violations == 0
+    finally:
+        telemetry.shutdown()
+
+
+def test_train_batch_sentinel_accepts_declared_shape_change(devices8):
+    engine = _train_engine()
+    engine.train_batch(_batch(s=16))
+    engine.train_batch(_batch(s=16))
+    # new seq length recompiles — the engine declares it (batch struct
+    # tracking), so the sentinel must NOT raise
+    engine.train_batch(_batch(s=12))
+    assert engine._recompile_sentinel.violations == 0
+
+
+def _v2_engine(**over):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    kw = dict(dtype="float32", kv_block_size=8, num_kv_blocks=128,
+              max_chunk_size=16, fused_decode_steps=4)
+    kw.update(over)
+    return InferenceEngineV2(Llama(size="tiny"),
+                             RaggedInferenceEngineConfig(**kw))
+
+
+def test_fused_decode_compiles_once_after_warmup(devices8):
+    """Acceptance: a warmed-up fused decode run adds ZERO compiles —
+    the second identical generate_fused hits the executable cache for
+    every dispatch, under the sentinel's raise mode."""
+    e = _v2_engine(sentinels=True)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, 9).tolist() for _ in range(3)]
+    out1 = e.generate_fused(prompts, max_new_tokens=6)
+    s = e._decode_sentinel
+    warm_compiles = s.compiles_seen
+    out2 = e.generate_fused(prompts, max_new_tokens=6)
+    assert s.compiles_seen == warm_compiles, (
+        "warmed-up fused decode recompiled")
+    assert s.violations == 0
+    assert out1 == out2
+
+
+def test_fused_decode_transfer_guard_k_ticks(devices8):
+    """Acceptance satellite: under jax.transfer_guard('disallow'), K
+    fused decode ticks perform no host transfers other than the
+    explicit token drain (np.asarray of the ring buffer)."""
+    e = _v2_engine()
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 512, 9).tolist()
+    logits = e.put([0], [prompt])
+    e.state_manager.extend(0, [int(jnp.argmax(logits[0]))])
+    e.decode_fused([0], k_steps=4, budgets={0: 12})      # warmup
+    with jax.transfer_guard("disallow"):
+        res = e.decode_fused([0], k_steps=4, budgets={0: 4})
+    assert len(res[0]) == 4
+
+
+def test_generate_fused_runs_with_sentinels_and_matches(devices8):
+    """Sentinels are pure enforcement: outputs are bit-identical with
+    them on or off (greedy AND stochastic), and the per-tick driver
+    still agrees with the fused path."""
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 512, 7).tolist() for _ in range(4)]
+    e_on = _v2_engine(sentinels=True)
+    out_on = e_on.generate_fused(prompts, max_new_tokens=5,
+                                 temperature=0.7, top_k=20, seed=3)
+    e_off = _v2_engine()
+    out_off = e_off.generate_fused(prompts, max_new_tokens=5,
+                                   temperature=0.7, top_k=20, seed=3)
+    assert out_on == out_off
+    assert e_on._decode_sentinel.violations == 0

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""graftlint CLI — JAX-aware static analysis for deepspeed_tpu.
+
+    python tools/graftlint.py deepspeed_tpu                # text report
+    python tools/graftlint.py deepspeed_tpu --json         # machine-readable
+    python tools/graftlint.py deepspeed_tpu --write-baseline
+    python tools/graftlint.py path/to/file.py --rules GL001,GL020
+
+Exit codes: 0 = no new violations (relative to the baseline, which is
+auto-discovered at ``.graftlint-baseline.json`` in the repo root);
+1 = new violations or unparseable files; 2 = usage error.
+
+Rule catalog + suppression/baseline workflow: docs/static-analysis.md.
+
+The linter is stdlib-only; this wrapper stubs the ``deepspeed_tpu``
+parent package so linting never pays (or requires) a jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _import_linter():
+    """Import deepspeed_tpu.analysis.linter without executing
+    deepspeed_tpu/__init__.py (which imports jax)."""
+    if "deepspeed_tpu" not in sys.modules:
+        stub = types.ModuleType("deepspeed_tpu")
+        stub.__path__ = [os.path.join(_REPO, "deepspeed_tpu")]
+        sys.modules["deepspeed_tpu"] = stub
+    sys.path.insert(0, _REPO)
+    from deepspeed_tpu.analysis import linter
+    return linter
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or package roots (default: deepspeed_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a JSON report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: .graftlint-baseline.json "
+                         "in the repo root when present; 'none' disables)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline "
+                         "and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--disable", default="",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    linter = _import_linter()
+    from deepspeed_tpu.analysis.rules import ALL_RULES
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.name}\n    {r.summary}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "deepspeed_tpu")]
+    for i, p in enumerate(paths):
+        if not os.path.exists(p):
+            # `python tools/graftlint.py deepspeed_tpu` should work from
+            # any cwd: fall back to repo-root-relative resolution
+            in_repo = os.path.join(_REPO, p)
+            if os.path.exists(in_repo):
+                paths[i] = in_repo
+                continue
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    disable = [r.strip() for r in args.disable.split(",") if r.strip()]
+    try:
+        result = linter.lint_paths(paths, rules=rules, disable=disable,
+                                   root=_REPO)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.baseline == "none":
+        baseline_path = None
+    elif args.baseline:
+        baseline_path = args.baseline
+    else:
+        cand = os.path.join(_REPO, linter.BASELINE_DEFAULT)
+        baseline_path = cand if os.path.exists(cand) \
+            or args.write_baseline else None
+
+    if args.write_baseline:
+        path = baseline_path or os.path.join(_REPO, linter.BASELINE_DEFAULT)
+        linter.save_baseline(path, result.findings)
+        print(f"graftlint: wrote {len(result.findings)} finding(s) to {path}")
+        return 0
+
+    linter.apply_baseline(result, baseline_path)
+
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=1, sort_keys=True))
+    else:
+        print(linter.format_text(result,
+                                 baseline_used=baseline_path is not None))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
