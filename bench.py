@@ -13,12 +13,15 @@ flash attention, block-sparse attention) so interpret-mode-only test
 coverage can't hide TPU-specific lowering bugs.
 
 Stage control (BENCH_r05 ended rc=124 with no parseable output): every
-stage runs under a SIGALRM budget (``--budget-s``, per-stage), stages
-can be selected with ``--stage a,b`` (``--list-stages`` prints them),
-and the stdout JSON line is emitted no matter what — after the headline
-stage, on any stage timeout, or from the SIGTERM handler when the
-harness's ``timeout`` fires mid-stage — so the driver always parses a
-result instead of null.
+stage runs under a SIGALRM budget (``--budget-s``, per-stage), a GLOBAL
+deadline (``--total-budget-s``, env ``DS_BENCH_TOTAL_BUDGET_S``,
+default 3300 s) skips whatever stages remain once it passes — so the
+full matrix can never outlive the harness wall clock — stages can be
+selected with ``--stage a,b`` (``--list-stages`` prints them), and the
+stdout JSON line is emitted no matter what — after the headline stage,
+on any stage timeout, at the global deadline, or from the SIGTERM
+handler when the harness's ``timeout`` fires mid-stage — so the driver
+always parses a result instead of null.
 """
 
 import argparse
@@ -569,6 +572,90 @@ def serving_bench(ds, on_tpu: bool):
                 B * 1e3 / max(decode_step_ms, slo_ms), 1)}
 
 
+def prefix_bench(ds, on_tpu: bool):
+    """Automatic prefix caching (ISSUE 4): shared-system-prompt serving.
+
+    N requests share a long system prefix and differ only in a short
+    unique tail. Served sequentially against (a) a cache-disabled
+    engine and (b) a prefix-cache engine whose first request warms the
+    chain, the cached path must cut prefill tokens >=50% and TTFT with
+    it. TTFT here is the put() wall time — prefill through first-token
+    logits — the exact cost prefix reuse removes. The warm engine's
+    ``max_cached_blocks`` is sized so unique tail blocks churn through
+    the LRU, exercising (and reporting) eviction."""
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    if on_tpu:
+        model = Llama(hidden_size=1024, num_layers=12, num_heads=8,
+                      num_kv_heads=8, intermediate_size=2816,
+                      vocab_size=32000, max_seq_len=2048)
+        bs, nb, chunk = 64, 256, 256
+        shared_len, uniq_len, n_req = 1024, 64, 8
+    else:
+        model = Llama(size="tiny", max_seq_len=256)
+        bs, nb, chunk = 8, 128, 16
+        shared_len, uniq_len, n_req = 64, 8, 6
+    shared_blocks = shared_len // bs
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    shared = rng.integers(0, vocab, shared_len).tolist()
+    prompts = [shared + rng.integers(0, vocab, uniq_len).tolist()
+               for _ in range(n_req)]
+
+    def serve(enabled):
+        e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            dtype="bfloat16" if on_tpu else "float32",
+            kv_block_size=bs, num_kv_blocks=nb, max_chunk_size=chunk,
+            prefix_cache={"enabled": enabled, "min_match_blocks": 1,
+                          "max_cached_blocks": shared_blocks + 4}))
+        # warming request: compiles the prefill buckets on both engines
+        # and (cache on) seeds the shared chain — excluded from timing
+        e.put([10 ** 6], [prompts[0]])
+        e.flush(10 ** 6)
+        e.reset_serving_metrics()
+        ttfts = []
+        for i, p in enumerate(prompts):
+            t0 = time.perf_counter()
+            lg = e.put([i], [p])
+            float(jnp.max(lg))           # force the device->host sync
+            ttfts.append((time.perf_counter() - t0) * 1e3)
+            e.flush(i)
+        ttfts.sort()
+        p50 = ttfts[len(ttfts) // 2]
+        p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+        return p50, p99, e.serving_metrics()
+
+    cold_p50, cold_p99, cold_m = serve(False)
+    warm_p50, warm_p99, warm_m = serve(True)
+    # mirror the cache counters into the telemetry registry (the put()
+    # prefill path has no fused dispatch to flush them) so the stage's
+    # --telemetry artifacts carry ds_serving_prefix_* series
+    from deepspeed_tpu.utils.telemetry_probe import active_telemetry
+    tel = active_telemetry()
+    reg = tel.get_registry() if tel is not None else None
+    if reg is not None:
+        tel.bridges.collect_serving(reg, warm_m)
+    total_prompt_tokens = sum(len(p) for p in prompts)
+    reduction = warm_m["prefill_tokens_saved"] / total_prompt_tokens
+    return {"metric": "prefix_cache_warm_ttft_p50_ms",
+            "value": round(warm_p50, 2), "unit": "ms",
+            "ttft_cold_p50_ms": round(cold_p50, 2),
+            "ttft_cold_p99_ms": round(cold_p99, 2),
+            "ttft_warm_p99_ms": round(warm_p99, 2),
+            "ttft_speedup_p50": round(cold_p50 / max(warm_p50, 1e-9), 2),
+            "prefill_token_reduction": round(reduction, 3),
+            "prefill_tokens_saved": warm_m["prefill_tokens_saved"],
+            "prompt_tokens_total": total_prompt_tokens,
+            "prefix_hits": warm_m["prefix_hits"],
+            "prefix_misses": warm_m["prefix_misses"],
+            "prefix_hit_rate": round(warm_m["prefix_hit_rate"], 3),
+            "prefix_evictions": warm_m["prefix_evictions"],
+            "prefix_cached_blocks": warm_m["prefix_cached_blocks"],
+            "shared_prefix_tokens": shared_len, "requests": n_req}
+
+
 def moe_serving_bench(ds, on_tpu: bool):
     """MoE serving (reference: inference/v2 cutlass_ops moe_gemm +
     mixed_gemm). Decode MoE is EXPERT-WEIGHT-READ bound: every live
@@ -1088,6 +1175,30 @@ def _emit_final() -> None:
             signal.pthread_sigmask(signal.SIG_SETMASK, old)
 
 
+_BENCH_DONE = threading.Event()
+
+
+def _arm_total_watchdog(total_s: float) -> None:
+    """Hard global deadline (BENCH_r05 rc=124): if the stage matrix is
+    still running ``total_s`` seconds in — e.g. a stage wedged inside a
+    C++ XLA compile where SIGALRM never fires — emit the stdout JSON
+    and exit 0 from a daemon thread, so the driver parses a result
+    instead of a timeout kill."""
+    def run():
+        if not _BENCH_DONE.wait(total_s):
+            _FINAL.setdefault(
+                "interrupted",
+                f"total budget {total_s:.0f}s exhausted mid-stage")
+            print(f"# total budget {total_s:.0f}s exhausted; exiting "
+                  "with the stages completed so far", file=sys.stderr)
+            _emit_final()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
+    threading.Thread(target=run, daemon=True,
+                     name="bench-total-watchdog").start()
+
+
 def _arm_watchdog(deadline_s: float) -> None:
     """Emit the stdout JSON from a daemon thread if the headline stage
     hasn't produced it by ``deadline_s``. SIGALRM/SIGTERM handlers only
@@ -1132,6 +1243,7 @@ def _install_signal_handlers() -> None:
 STAGES = [("headline", headline_bench),
           ("llama", llama_bench), ("longctx", longctx_bench),
           ("moe", moe_bench), ("serving", serving_bench),
+          ("prefix", prefix_bench),
           ("moe_serving", moe_serving_bench),
           ("offload", offload_smoke),
           ("domino", domino_bench),
@@ -1152,6 +1264,13 @@ def main(argv=None):
                     help="per-stage wall-clock budget in seconds, "
                          "enforced with SIGALRM (0 = platform default: "
                          "600 on TPU, 240 on CPU)")
+    ap.add_argument("--total-budget-s", type=int, default=-1,
+                    help="global wall-clock deadline for the whole "
+                         "stage matrix: remaining stages are skipped "
+                         "(recorded on stderr) once it is reached and "
+                         "the final JSON line is always emitted. "
+                         "-1 = $DS_BENCH_TOTAL_BUDGET_S or 3300; "
+                         "0 disables")
     ap.add_argument("--telemetry", metavar="DIR", default="",
                     help="activate the telemetry subsystem (ISSUE 2) and "
                          "write per-stage artifacts into DIR: "
@@ -1174,6 +1293,12 @@ def main(argv=None):
 
     on_tpu = jax.devices()[0].platform != "cpu"
     budget = args.budget_s or (600 if on_tpu else 240)
+    total_budget = args.total_budget_s
+    if total_budget < 0:
+        total_budget = int(os.environ.get("DS_BENCH_TOTAL_BUDGET_S",
+                                          "3300"))
+    deadline = (time.monotonic() + total_budget) if total_budget > 0 \
+        else None
     selected = {s.strip() for s in args.stage.split(",") if s.strip()}
     unknown = selected - {name for name, _ in STAGES}
     if unknown:
@@ -1184,6 +1309,10 @@ def main(argv=None):
     # the JSON hasn't landed one grace period past the stage budget the
     # signal path is wedged — let the watchdog thread put it out
     _arm_watchdog(budget * 1.25 + 60)
+    if deadline is not None:
+        # backstop for a stage unresponsive even to SIGALRM: emit the
+        # JSON and exit 0 shortly after the deadline passes
+        _arm_total_watchdog(total_budget + 30)
     try:
         for name, fn in STAGES:
             if selected and name not in selected:
@@ -1193,7 +1322,18 @@ def main(argv=None):
                                    "skipped": "not in --stage"})
                     _emit_final()
                 continue
-            signal.alarm(budget)
+            remaining = (deadline - time.monotonic()
+                         if deadline is not None else budget)
+            if remaining <= 5:
+                info = {"skipped": f"total budget {total_budget}s "
+                                   "exhausted"}
+                if name == "headline":
+                    _FINAL.update({"metric": "bench_headline",
+                                   "value": None, **info})
+                    _emit_final()
+                print(f"# {name} " + json.dumps(info), file=sys.stderr)
+                continue
+            signal.alarm(max(1, min(budget, int(remaining))))
             t0 = time.perf_counter()
             try:
                 res = fn(ds, on_tpu)
@@ -1245,6 +1385,7 @@ def main(argv=None):
             gc.collect()
     finally:
         _emit_final()
+        _BENCH_DONE.set()
 
 
 if __name__ == "__main__":

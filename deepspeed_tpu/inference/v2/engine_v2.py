@@ -23,7 +23,9 @@ from typing import Any, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from pydantic import Field
 
+from ...runtime.config_utils import DeepSpeedConfigModel
 from ...utils.logging import log_dist
 # telemetry guard: sys.modules probe, NOT an import — a disabled
 # serving loop allocates nothing and pays one dict lookup per
@@ -32,9 +34,18 @@ from ...utils.telemetry_probe import (NULL_CM as _NULLCM,
                                       active_telemetry as _telemetry)
 from ..config import DeepSpeedInferenceConfig
 from .paged import fused_decode_loop, paged_forward
-from .ragged import DSStateManager, SequenceDescriptor
+from .ragged import (PrefixCache, DSStateManager, SequenceDescriptor)
 
 PyTree = Any
+
+# serving_metrics() schema: raw counters kept in serving_stats (reset
+# zeroes exactly these); the prefix-cache counters ride alongside via
+# ragged.PREFIX_STAT_KEYS, and derived ratio/occupancy gauges are
+# appended at read time. telemetry.bridges and bench.py consume the
+# same names.
+SERVING_COUNTER_KEYS = (
+    "host_dispatches", "fused_dispatches", "fused_steps", "fused_slots",
+    "fused_slot_tokens", "decoded_tokens")
 
 
 class _LatencyProbe:
@@ -102,6 +113,22 @@ def _batch_bucket(n: int) -> int:
     return _bucket(n) if n <= 8 else -(-n // 8) * 8
 
 
+class PrefixCacheConfig(DeepSpeedConfigModel):
+    """Automatic prefix caching (ISSUE 4): full KV blocks are indexed by
+    a hash chain over their token content and SHARED across requests —
+    a new prompt whose leading blocks match a cached chain skips their
+    prefill entirely (refcount bump instead of compute). Off by
+    default; the disabled path is byte-identical to an engine without
+    the feature."""
+    enabled: bool = False
+    # a match shorter than this many full blocks is ignored (tiny
+    # matches save little prefill but fragment the pool's LRU)
+    min_match_blocks: int = 1
+    # cap on indexed blocks; 0 = bounded only by the pool. Exceeding it
+    # evicts the least-recently-used unreferenced cached block.
+    max_cached_blocks: int = 0
+
+
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
     (state_manager block/pool sizing knobs + the fused-decode loop)."""
@@ -130,6 +157,10 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     # default — zero overhead, nothing imported.
     sentinels: bool = False
     sentinel_mode: str = "raise"          # or "warn"
+    # automatic prefix caching: ref-counted KV block sharing with
+    # hash-chained reuse across requests (see docs/serving.md)
+    prefix_cache: PrefixCacheConfig = Field(
+        default_factory=PrefixCacheConfig)
 
 
 class InferenceEngineV2:
@@ -148,9 +179,14 @@ class InferenceEngineV2:
 
         bs = config.kv_block_size
         max_blocks_per_seq = -(-c.max_seq_len // bs)
+        pc = config.prefix_cache
         self.state_manager = DSStateManager(
             block_size=bs, num_blocks=config.num_kv_blocks,
-            max_blocks_per_seq=max_blocks_per_seq)
+            max_blocks_per_seq=max_blocks_per_seq,
+            prefix_cache=(PrefixCache(
+                block_size=bs, min_match_blocks=pc.min_match_blocks,
+                max_cached_blocks=pc.max_cached_blocks)
+                if pc.enabled else None))
         # logits of sequences finished as a side effect of another
         # caller's drain loop, held for their owner's next tick()
         self._finished_stash: dict[int, jnp.ndarray] = {}
@@ -215,10 +251,10 @@ class InferenceEngineV2:
                 "fused_decode", mode=config.sentinel_mode, warmup_calls=0)
             self._hot_guard = hot_path_guard
         # serving counters behind serving_metrics(): host dispatches vs
-        # decoded tokens measures how host-free the decode loop is
-        self.serving_stats = dict(
-            host_dispatches=0, fused_dispatches=0, fused_steps=0,
-            fused_slots=0, fused_slot_tokens=0, decoded_tokens=0)
+        # decoded tokens measures how host-free the decode loop is.
+        # Schema-driven (SERVING_COUNTER_KEYS) so reset/emission can
+        # never drift from the key set consumers see.
+        self.serving_stats = dict.fromkeys(SERVING_COUNTER_KEYS, 0)
         # SplitFuse budget, floored to a power of two (bucket shapes must
         # never exceed the configured compute budget)
         self._chunk = 1 << (max(1, config.max_chunk_size).bit_length() - 1)
@@ -276,6 +312,9 @@ class InferenceEngineV2:
                 jnp.asarray(true_len))
         for i, seq in enumerate(seqs):
             seq.seen += int(true_len[i])
+            # prefix cache: blocks this chunk completed are now fully in
+            # the pool — index them for reuse (no-op when disabled)
+            mgr.publish_full_blocks(seq)
         return logits[:len(seqs)]
 
     # ------------------------------------------------------------------
@@ -293,35 +332,59 @@ class InferenceEngineV2:
                 raise ValueError(
                     f"sequence {u}: schedule()/put() needs at least one "
                     f"token (an empty list would never finish a tick)")
-        if do_checks:
-            # cumulative admission over the whole batch, so a failure
-            # raises before any state mutation
-            need = 0
+        # prefix-cache pre-pinning: matched blocks are ref-bumped BEFORE
+        # any check or allocation, so (a) the admission math credits
+        # exactly the blocks reuse will skip and (b) an earlier
+        # sequence's allocation in this batch cannot evict a later
+        # sequence's hit out from under it.
+        pins: dict[int, list] = {}
+        if mgr.cache is not None:
             for u, toks in zip(uids, batch_tokens):
                 seq = mgr.seqs.get(u)
-                seq_blocks = len(seq.blocks) if seq else 0
-                seq_need = mgr.blocks_needed(
-                    seq or SequenceDescriptor(uid=u, tokens=[]), len(toks))
-                if seq_blocks + seq_need > mgr.max_blocks_per_seq:
+                if u not in pins and (seq is None
+                                      or (not seq.tokens
+                                          and not seq.blocks)):
+                    m = mgr.prefix_match(toks)
+                    if m:
+                        mgr.pin_prefix(m)
+                        pins[u] = m
+        try:
+            if do_checks:
+                # cumulative admission over the whole batch, so a failure
+                # raises before any state mutation
+                need = 0
+                for u, toks in zip(uids, batch_tokens):
+                    seq = mgr.seqs.get(u)
+                    seq_blocks = len(seq.blocks) if seq else 0
+                    seq_need = mgr.blocks_needed(
+                        seq or SequenceDescriptor(uid=u, tokens=[]),
+                        len(toks))
+                    if seq_blocks + seq_need > mgr.max_blocks_per_seq:
+                        raise RuntimeError(
+                            f"sequence {u} would exceed the max length "
+                            f"({mgr.max_blocks_per_seq * mgr.block_size} "
+                            f"tokens)")
+                    need += seq_need - len(pins.get(u, ()))
+                if need > mgr.available_blocks:
                     raise RuntimeError(
-                        f"sequence {u} would exceed the max length "
-                        f"({mgr.max_blocks_per_seq * mgr.block_size} tokens)")
-                need += seq_need
-            if need > mgr.allocator.free_blocks:
-                raise RuntimeError(
-                    f"cannot schedule batch: needs {need} KV blocks, "
-                    f"{mgr.allocator.free_blocks} free — the pool is "
-                    "exhausted (flush finished sequences)")
-        for u, toks in zip(uids, batch_tokens):
-            mgr.extend(u, list(map(int, toks)))
-            # re-admission invalidates any logits stashed when this uid
-            # finished during another caller's drain: the stashed value
-            # is from the old position and tick() must not surface it
-            # while the uid has pending tokens again (mirrors flush()).
-            # Popped only after extend() succeeds — a failed admission
-            # (do_checks=False + exhausted pool) must leave the stash
-            # intact for the original caller.
-            self._finished_stash.pop(u, None)
+                        f"cannot schedule batch: needs {need} KV blocks, "
+                        f"{mgr.available_blocks} allocatable — the pool "
+                        "is exhausted (flush finished sequences)")
+            for u, toks in zip(uids, batch_tokens):
+                mgr.extend(u, list(map(int, toks)),
+                           pinned=pins.pop(u, None))
+                # re-admission invalidates any logits stashed when this
+                # uid finished during another caller's drain: the stashed
+                # value is from the old position and tick() must not
+                # surface it while the uid has pending tokens again
+                # (mirrors flush()). Popped only after extend() succeeds
+                # — a failed admission (do_checks=False + exhausted pool)
+                # must leave the stash intact for the original caller.
+                self._finished_stash.pop(u, None)
+        except BaseException:
+            for m in pins.values():
+                mgr.unpin_prefix(m)
+            raise
 
     def tick(self) -> dict[int, jnp.ndarray]:
         """ONE scheduler tick (the compute half of the reference's
@@ -591,8 +654,17 @@ class InferenceEngineV2:
         row decoded every step; rows going EOS/budget-inactive mid-loop
         lower it). Pad rows added by the batch bucketing are not
         counted — this measures scheduling efficiency over real
-        sequences, not device utilization of the padded bucket."""
+        sequences, not device utilization of the padded bucket.
+
+        With prefix caching the dict additionally carries the cache
+        counters (``prefix_hits``/``prefix_misses`` at full-block
+        granularity, ``prefix_evictions``, ``prefill_tokens_saved``)
+        and occupancy gauges (``prefix_hit_rate``,
+        ``prefix_cached_blocks``, ``prefix_evictable_blocks``) — zeros
+        when the cache is disabled, so consumers always see one stable
+        schema."""
         st = dict(self.serving_stats)
+        st.update(self.state_manager.prefix_cache_metrics())
         st["dispatches_per_token"] = (
             st["host_dispatches"] / max(st["decoded_tokens"], 1))
         st["fused_occupancy"] = (
@@ -602,6 +674,7 @@ class InferenceEngineV2:
     def reset_serving_metrics(self) -> None:
         for k in self.serving_stats:
             self.serving_stats[k] = 0
+        self.state_manager.reset_prefix_stats()
 
     # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -632,10 +705,13 @@ class InferenceEngineV2:
         def admit():
             """Admit as many pending prompts as fit, reserving each one's
             worst-case block budget so live sequences can never exhaust
-            the pool mid-decode."""
+            the pool mid-decode. Prefix-cache hits shrink a prompt's
+            admission cost to its UNCACHED blocks (plus pinning parked
+            LRU blocks out of the evictable headroom), so a shared
+            system prompt stops counting against capacity."""
             batch: list[tuple[int, list[int]]] = []
             allocated = sum(len(mgr.seqs[u].blocks) for u in live)
-            headroom = (mgr.allocator.free_blocks
+            headroom = (mgr.available_blocks
                         - (sum(reserved.values()) - allocated))
             while pending and len(live) + len(batch) < max_live:
                 uid, prompt = pending[0]
@@ -646,10 +722,11 @@ class InferenceEngineV2:
                         f"prompt {uid}: {len(prompt)} tokens + "
                         f"{max_new_tokens} new can never fit the KV pool "
                         f"(needs {need} blocks)")
-                if need > headroom:
+                cost = mgr.admission_cost(prompt, need)
+                if cost > headroom:
                     break
                 pending.pop(0)
-                headroom -= need
+                headroom -= cost
                 reserved[uid] = need
                 batch.append((uid, prompt))
             if batch:
@@ -660,43 +737,52 @@ class InferenceEngineV2:
             if lat is not None:
                 lat.admitted([u for u, _ in batch], waiting=len(pending))
 
-        admit()
-        while live or pending:
-            if not live:
-                admit()
-                if not live:   # reservation math guarantees progress
-                    raise RuntimeError(
-                        "continuous-batching deadlock: pending prompts "
-                        "but nothing admissible")
-                continue
-            # one tick advances every pending sequence one chunk; a
-            # sequence whose pending drained yields logits -> sample
-            finished = self.tick()
-            decode_uids: list[int] = []
-            for u in sorted(finished):
-                if u not in live:
-                    # not ours (scheduled by another caller): re-stash
-                    self._finished_stash[u] = finished[u]
-                    continue
-                # per-token host argmax IS the per-tick driver's cost
-                # model (one RTT per token, documented above);
-                # generate_fused() is the production path
-                live[u].append(int(jnp.argmax(finished[u])))  # graftlint: disable=GL004
-                self.serving_stats["decoded_tokens"] += 1
-                if lat is not None:
-                    lat.tokens(u, 1, first=len(live[u]) == 1)
-                if (len(live[u]) >= max_new_tokens
-                        or (eos_id is not None and live[u][-1] == eos_id)):
-                    results[u] = live.pop(u)[:max_new_tokens]
-                    reserved.pop(u)
-                    self.flush(u)
-                else:
-                    decode_uids.append(u)
-            if decode_uids:
-                self.schedule(decode_uids,
-                              [[live[u][-1]] for u in decode_uids],
-                              do_checks=False)  # blocks pre-reserved
+        try:
             admit()
+            while live or pending:
+                if not live:
+                    admit()
+                    if not live:  # reservation math guarantees progress
+                        raise RuntimeError(
+                            "continuous-batching deadlock: pending "
+                            "prompts but nothing admissible")
+                    continue
+                # one tick advances every pending sequence one chunk; a
+                # sequence whose pending drained yields logits -> sample
+                finished = self.tick()
+                decode_uids: list[int] = []
+                for u in sorted(finished):
+                    if u not in live:
+                        # not ours (scheduled by another caller): re-stash
+                        self._finished_stash[u] = finished[u]
+                        continue
+                    # per-token host argmax IS the per-tick driver's cost
+                    # model (one RTT per token, documented above);
+                    # generate_fused() is the production path
+                    live[u].append(int(jnp.argmax(finished[u])))  # graftlint: disable=GL004
+                    self.serving_stats["decoded_tokens"] += 1
+                    if lat is not None:
+                        lat.tokens(u, 1, first=len(live[u]) == 1)
+                    if (len(live[u]) >= max_new_tokens
+                            or (eos_id is not None
+                                and live[u][-1] == eos_id)):
+                        results[u] = live.pop(u)[:max_new_tokens]
+                        reserved.pop(u)
+                        self.flush(u)
+                    else:
+                        decode_uids.append(u)
+                if decode_uids:
+                    self.schedule(decode_uids,
+                                  [[live[u][-1]] for u in decode_uids],
+                                  do_checks=False)  # blocks pre-reserved
+                admit()
+        except BaseException:
+            # an error mid-drive (e.g. a later prompt's oversized
+            # ValueError raised from admit()) must not strand the
+            # already-scheduled sequences' KV blocks on a shared engine
+            for u in list(live):
+                self.flush(u)
+            raise
         return [results[i] for i in range(len(prompts))]
 
     # ------------------------------------------------------------------
@@ -751,7 +837,7 @@ class InferenceEngineV2:
             first dispatch (the per-tick driver only *reserves* this
             budget arithmetically)."""
             batch: list[tuple[int, list[int]]] = []
-            free = mgr.allocator.free_blocks
+            free = mgr.available_blocks
             while pending and len(live) + len(batch) < max_live:
                 uid, prompt = pending[0]
                 need = -(-(len(prompt) + max_new_tokens) // bs)
@@ -761,19 +847,27 @@ class InferenceEngineV2:
                         f"prompt {uid}: {len(prompt)} tokens + "
                         f"{max_new_tokens} new can never fit the KV pool "
                         f"(needs {need} blocks)")
-                if need > free:
+                # prefix-cache hits shrink the admission cost to the
+                # uncached blocks (+ parked hits leaving the evictable
+                # pool); schedule() re-pins the same match exactly
+                cost = mgr.admission_cost(prompt, need)
+                if cost > free:
                     break
                 pending.pop(0)
-                free -= need
+                free -= cost
                 batch.append((uid, prompt))
             if lat is not None:
                 lat.admitted([u for u, _ in batch], waiting=len(pending))
             if not batch:
                 return []
             self.schedule([u for u, _ in batch], [p for _, p in batch])
+            # the whole batch joins `live` BEFORE reserving: a reserve
+            # failure mid-batch must leave every scheduled uid visible
+            # to the driver's block-leak guard
+            for uid, _ in batch:
+                live[uid] = []
             for uid, _ in batch:
                 mgr.reserve(uid, max_new_tokens)
-                live[uid] = []
             return [u for u, _ in batch]
 
         def finish(uid: int) -> None:
@@ -830,6 +924,34 @@ class InferenceEngineV2:
 
         fn = self._fused_fn(k, temperature, top_k, top_p, eos)
         infl: deque = deque()   # in-flight dispatches (double buffer)
+
+        try:
+            self._drive_fused(
+                live, pending, infl, to_flush, admit, prefill, finish,
+                fn, k, temperature, top_k, top_p, eos, seed,
+                max_new_tokens, tel, lat, stats)
+        except BaseException:
+            # block-leak guard: drain what's in flight (its commits are
+            # lost, but the device must stop referencing the tables
+            # before the blocks are recycled), then release every
+            # scheduled-but-unfinished sequence's KV blocks
+            try:
+                jax.block_until_ready([out for _, out, _ in infl])
+            except Exception:   # noqa: BLE001 — best-effort drain
+                pass
+            for u in set(live) | set(to_flush):
+                self.flush(u)
+            raise
+        for u in to_flush:
+            self.flush(u)
+        return [results[i] for i in range(len(prompts))]
+
+    def _drive_fused(self, live, pending, infl, to_flush, admit, prefill,
+                     finish, fn, k, temperature, top_k, top_p, eos, seed,
+                     max_new_tokens, tel, lat, stats):
+        """generate_fused()'s admission/enqueue/drain loop (split out so
+        the driver can wrap it with block-leak cleanup)."""
+        mgr = self.state_manager
         carry = None            # device-side loop carry for `rowset`
         rowset: list[int] = []
         budgets: dict[int, int] = {}
@@ -944,7 +1066,3 @@ class InferenceEngineV2:
                 if ids:
                     carry = None
                     prefill(ids)
-
-        for u in to_flush:
-            self.flush(u)
-        return [results[i] for i in range(len(prompts))]

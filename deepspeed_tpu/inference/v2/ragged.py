@@ -10,14 +10,38 @@ TPU translation: the pool is one device array per k/v with layout
 descriptors are host-side numpy (they change every step — keeping them off
 the compiled path avoids recompiles); attention reads the pool through the
 page table (paged.py). Shapes entering XLA are bucketed, not ragged.
+
+Automatic prefix caching (ISSUE 4): the allocator is REF-COUNTED and a
+hash-chained :class:`PrefixCache` indexes every *full* block by
+``(parent_chain_hash, block_tokens)``. A new sequence whose leading
+tokens match a cached chain shares those blocks (refcount bump) and
+skips their prefill entirely; ``flush()`` dec-refs, parking cached
+blocks whose refcount hits zero in an LRU pool that is evicted only
+when an allocation would otherwise fail. Tail/partial blocks are always
+privately allocated — decode only ever writes positions >= ``seen``,
+which by construction live in a sequence's own private blocks, so
+sharing needs no copy-on-write. Everything here is host-side
+python/numpy; block *sharing* is free at the kernel level because paged
+attention already reads KV strictly through per-sequence block tables.
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
+
+# cumulative prefix-cache counters, exposed 1:1 through
+# InferenceEngineV2.serving_metrics() and telemetry.bridges — one
+# schema, three consumers (engine reset, bridges, bench), no drift.
+PREFIX_STAT_KEYS = ("prefix_hits", "prefix_misses", "prefix_evictions",
+                    "prefill_tokens_saved")
+
+# chain seed for the root of every block-hash chain (arbitrary odd
+# constant; only equality matters)
+_CHAIN_ROOT = 0x9E3779B97F4A7C15
 
 
 @dataclass
@@ -28,6 +52,10 @@ class SequenceDescriptor:
     seen: int = 0                        # tokens already in the KV cache
     blocks: list[int] = field(default_factory=list)
     done: bool = False
+    # prefix-cache chain state: hash of the chain after `published` full
+    # blocks (blocks matched at admission arrive already published)
+    cached_key: int = _CHAIN_ROOT
+    published: int = 0
 
     @property
     def pending(self) -> int:
@@ -35,25 +63,157 @@ class SequenceDescriptor:
 
 
 class BlockedAllocator:
-    """Fixed-pool block allocator (reference:
-    ragged/blocked_allocator.py — free-list over num_blocks)."""
+    """Fixed-pool REF-COUNTED block allocator (reference:
+    ragged/blocked_allocator.py — free-list over num_blocks, grown here
+    with per-block refcounts so prefix-cached blocks can be shared
+    across sequences). ``evict_source`` (set by :class:`DSStateManager`
+    when prefix caching is on) is asked to surrender one cached-but-
+    unreferenced block at a time when the free list runs short — cached
+    blocks are evicted only when an allocation would otherwise fail."""
 
     def __init__(self, num_blocks: int):
         self.num_blocks = num_blocks
         self._free = list(range(num_blocks - 1, -1, -1))
+        self._ref = [0] * num_blocks
+        self.evict_source = None        # () -> Optional[int]
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
     def allocate(self, n: int) -> list[int]:
+        while n > len(self._free) and self.evict_source is not None:
+            b = self.evict_source()
+            if b is None:
+                break
+            self._free.append(b)
         if n > len(self._free):
             raise RuntimeError(
                 f"KV pool exhausted: want {n} blocks, {len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        return out
+
+    def incref(self, blocks) -> None:
+        for b in blocks:
+            self._ref[b] += 1
+
+    def decref(self, blocks) -> list[int]:
+        """Drop one reference per block; returns the blocks that reached
+        refcount zero (NOT freed — the caller routes them to the free
+        list or the prefix cache's LRU pool)."""
+        zeros = []
+        for b in blocks:
+            self._ref[b] -= 1
+            if self._ref[b] <= 0:
+                self._ref[b] = 0
+                zeros.append(b)
+        return zeros
 
     def free(self, blocks: list[int]) -> None:
+        """Raw return to the free list (refcounts cleared)."""
+        for b in blocks:
+            self._ref[b] = 0
         self._free.extend(blocks)
+
+
+class PrefixCache:
+    """Hash-chained index of FULL KV blocks for automatic prefix reuse
+    (the vLLM/FastGen automatic-prefix-caching scheme, host-side only).
+
+    Every full block is keyed by ``(parent_hash, tuple(block_tokens))``
+    where ``parent_hash`` summarizes the whole ancestor chain
+    (``hash`` of the parent's key) — two prefixes that share a block's
+    tokens but differ anywhere earlier in the chain get distinct keys,
+    and the dict compares the current block's tokens by equality, so a
+    match is collision-safe up to a hash collision over the *full*
+    parent chain. Blocks with refcount zero stay indexed and parked in
+    an LRU; they count as allocatable headroom and are evicted
+    oldest-first only when an allocation needs them (or when
+    ``max_cached_blocks`` caps the index)."""
+
+    def __init__(self, block_size: int, min_match_blocks: int = 1,
+                 max_cached_blocks: int = 0):
+        self.block_size = block_size
+        self.min_match_blocks = max(1, int(min_match_blocks))
+        self.max_cached_blocks = int(max_cached_blocks)   # 0 = pool-bounded
+        self.index: dict[tuple, int] = {}     # (parent, tokens) -> block
+        self.block_key: dict[int, tuple] = {}
+        self.lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0 blocks
+        self.stats = dict.fromkeys(PREFIX_STAT_KEYS, 0)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self.index)
+
+    @property
+    def evictable_blocks(self) -> int:
+        return len(self.lru)
+
+    def reset_stats(self) -> None:
+        for k in self.stats:
+            self.stats[k] = 0
+
+    def match(self, tokens: list[int], limit_blocks: int) -> list[tuple]:
+        """Longest cached chain over the first ``limit_blocks`` full
+        blocks of ``tokens``; returns ``[(key, block), ...]`` (empty
+        when shorter than ``min_match_blocks``). Pure query — no
+        refcount or stats mutation."""
+        bs = self.block_size
+        parent = _CHAIN_ROOT
+        out: list[tuple] = []
+        for i in range(limit_blocks):
+            key = (parent, tuple(tokens[i * bs:(i + 1) * bs]))
+            blk = self.index.get(key)
+            if blk is None:
+                break
+            out.append((key, blk))
+            parent = hash(key)
+        if len(out) < self.min_match_blocks:
+            return []
+        return out
+
+    def publish(self, parent: int, block_tokens: tuple,
+                block: int) -> int:
+        """Index one freshly-computed full block under its chain key;
+        returns the child chain hash. First publisher wins (a concurrent
+        duplicate keeps its block private); at ``max_cached_blocks`` an
+        unreferenced LRU block is evicted to make room, and if nothing
+        is evictable the publication is skipped (the chain hash still
+        advances, so later blocks stay publishable)."""
+        key = (parent, block_tokens)
+        if key not in self.index:
+            if (self.max_cached_blocks > 0
+                    and len(self.index) >= self.max_cached_blocks
+                    and self.evict_one() is None):
+                return hash(key)
+            self.index[key] = block
+            self.block_key[block] = key
+        return hash(key)
+
+    def release(self, block: int) -> bool:
+        """A block's refcount hit zero: park it (most-recently-used) if
+        it is indexed; returns False when the block is uncached and the
+        caller should return it to the free list."""
+        if block not in self.block_key:
+            return False
+        self.lru[block] = None
+        self.lru.move_to_end(block)
+        return True
+
+    def evict_one(self) -> Optional[int]:
+        """Drop the least-recently-used unreferenced cached block from
+        the index; returns its id (now plain free) or None."""
+        if not self.lru:
+            return None
+        block, _ = self.lru.popitem(last=False)
+        del self.index[self.block_key.pop(block)]
+        self.stats["prefix_evictions"] += 1
+        return block
 
 
 class DSStateManager:
@@ -61,11 +221,25 @@ class DSStateManager:
     ragged/ragged_manager.py:19)."""
 
     def __init__(self, block_size: int, num_blocks: int,
-                 max_blocks_per_seq: int):
+                 max_blocks_per_seq: int,
+                 prefix_cache: Optional[PrefixCache] = None):
         self.block_size = block_size
         self.allocator = BlockedAllocator(num_blocks)
         self.max_blocks_per_seq = max_blocks_per_seq
         self.seqs: dict[int, SequenceDescriptor] = {}
+        self.cache = prefix_cache
+        if prefix_cache is not None:
+            self.allocator.evict_source = prefix_cache.evict_one
+
+    @property
+    def available_blocks(self) -> int:
+        """Allocatable headroom: truly free blocks plus cached blocks
+        with refcount zero (the allocator evicts those on demand) — the
+        admission-math notion of "free" once prefix caching is on."""
+        free = self.allocator.free_blocks
+        if self.cache is not None:
+            free += self.cache.evictable_blocks
+        return free
 
     def get_or_create(self, uid: int) -> SequenceDescriptor:
         if uid not in self.seqs:
@@ -77,23 +251,149 @@ class DSStateManager:
         need = -(-total // self.block_size)  # ceil
         return max(0, need - len(seq.blocks))
 
+    # ------------------------------------------------------------------
+    # prefix cache plumbing
+    def _match_limit(self, n_tokens: int) -> int:
+        """Full blocks a fresh admission of ``n_tokens`` may reuse: at
+        least one token must stay pending (the forward that yields the
+        next-token logits), so a fully-cached prompt still matches only
+        up to its last block boundary before token n-1."""
+        return min(max(n_tokens - 1, 0) // self.block_size,
+                   self.max_blocks_per_seq)
+
+    def prefix_match(self, tokens) -> list[tuple]:
+        """Longest cached chain a FRESH sequence with these tokens would
+        reuse (``[(key, block), ...]``); pure query — admission uses
+        :meth:`pin_prefix` + :meth:`extend` to act on it."""
+        if self.cache is None:
+            return []
+        return self.cache.match([int(t) for t in tokens],
+                                self._match_limit(len(tokens)))
+
+    def admission_cost(self, tokens, full_need: int) -> int:
+        """Blocks a fresh admission of ``tokens`` with a worst-case
+        budget of ``full_need`` consumes from :attr:`available_blocks`:
+        blocks to allocate (cache hits subtracted) plus parked hits the
+        match pins out of the evictable pool — already-referenced hits
+        are free. Used by the drivers' admission headroom math."""
+        hits = self.prefix_match(tokens)
+        return (full_need - len(hits)
+                + sum(1 for _, b in hits
+                      if self.allocator.refcount(b) == 0))
+
+    def pin_prefix(self, matches: list[tuple]) -> None:
+        """Take a reference on each matched block (pulling parked ones
+        out of the LRU) so a concurrent allocation cannot evict them
+        between the admission check and :meth:`extend`."""
+        for _, b in matches:
+            if self.allocator.refcount(b) == 0:
+                self.cache.lru.pop(b, None)
+            self.allocator.incref((b,))
+
+    def unpin_prefix(self, matches: list[tuple]) -> None:
+        self._release_blocks([b for _, b in matches])
+
+    def _release_blocks(self, blocks: list[int]) -> None:
+        zeros = self.allocator.decref(blocks)
+        if self.cache is not None:
+            zeros = [b for b in zeros if not self.cache.release(b)]
+        if zeros:
+            self.allocator.free(zeros)
+
+    def publish_full_blocks(self, seq: SequenceDescriptor) -> None:
+        """Index every newly-completed full block of ``seq`` (called
+        wherever ``seen`` advances — the block's KV is then entirely in
+        the pool, and the sequence never writes at positions < seen, so
+        sharing it is hazard-free). No-op with caching off."""
+        if self.cache is None:
+            return
+        full = min(seq.seen // self.block_size, len(seq.blocks))
+        while seq.published < full:
+            i = seq.published
+            toks = tuple(seq.tokens[i * self.block_size:
+                                    (i + 1) * self.block_size])
+            seq.cached_key = self.cache.publish(seq.cached_key, toks,
+                                                seq.blocks[i])
+            seq.published += 1
+
+    def prefix_cache_metrics(self) -> dict:
+        """Counters + occupancy gauges for serving_metrics() — zeros
+        with caching off so consumers see one stable schema."""
+        if self.cache is None:
+            m = dict.fromkeys(PREFIX_STAT_KEYS, 0)
+            m.update(prefix_hit_rate=0.0, prefix_cached_blocks=0,
+                     prefix_evictable_blocks=0)
+            return m
+        m = dict(self.cache.stats)
+        looked = m["prefix_hits"] + m["prefix_misses"]
+        m["prefix_hit_rate"] = m["prefix_hits"] / max(looked, 1)
+        m["prefix_cached_blocks"] = self.cache.cached_blocks
+        m["prefix_evictable_blocks"] = self.cache.evictable_blocks
+        return m
+
+    def reset_prefix_stats(self) -> None:
+        if self.cache is not None:
+            self.cache.reset_stats()
+
+    # ------------------------------------------------------------------
     def can_schedule(self, uid: int, new_tokens: int) -> bool:
-        """reference: engine_v2.can_schedule:184"""
+        """reference: engine_v2.can_schedule:184 (cached-but-unreferenced
+        blocks count as allocatable headroom)."""
         seq = self.seqs.get(uid) or SequenceDescriptor(uid=uid, tokens=[])
         need = self.blocks_needed(seq, new_tokens)
         total_blocks = len(seq.blocks) + need
-        return (need <= self.allocator.free_blocks
+        return (need <= self.available_blocks
                 and total_blocks <= self.max_blocks_per_seq)
 
-    def extend(self, uid: int, tokens: list[int]) -> SequenceDescriptor:
-        """Append tokens to a sequence, allocating blocks to cover them."""
+    def extend(self, uid: int, tokens: list[int],
+               pinned: Optional[list[tuple]] = None) -> SequenceDescriptor:
+        """Append tokens to a sequence, allocating blocks to cover them.
+
+        A FRESH sequence first walks the prefix cache: the longest
+        cached chain of full blocks is shared (refcount bump via
+        ``pinned``, or matched+pinned here) and those tokens marked
+        ``seen`` — chunked prefill and the fused-dispatch position math
+        skip them entirely. The remainder (always including the tail /
+        partial block) is privately allocated."""
         seq = self.get_or_create(uid)
-        need = self.blocks_needed(seq, len(tokens))
-        if len(seq.blocks) + need > self.max_blocks_per_seq:
+        fresh = not seq.tokens and not seq.blocks and seq.seen == 0
+        matches: list[tuple] = []
+        own_pin = False
+        if self.cache is not None and fresh:
+            if pinned is not None:
+                matches = pinned
+            else:
+                matches = self.prefix_match(tokens)
+                own_pin = bool(matches)
+        total_blocks = -(-(len(seq.tokens) + len(tokens))
+                         // self.block_size)
+        if total_blocks > self.max_blocks_per_seq:
+            if pinned:
+                self.unpin_prefix(pinned)
             raise RuntimeError(
                 f"sequence {uid} exceeds max length "
                 f"({self.max_blocks_per_seq * self.block_size} tokens)")
-        seq.blocks.extend(self.allocator.allocate(need))
+        if own_pin:
+            self.pin_prefix(matches)
+        try:
+            fresh_blocks = self.allocator.allocate(
+                max(0, total_blocks - len(seq.blocks) - len(matches)))
+        except RuntimeError:
+            if matches:
+                self.unpin_prefix(matches)
+            raise
+        if matches:
+            seq.blocks.extend(b for _, b in matches)
+            seq.seen = len(matches) * self.block_size
+            seq.published = len(matches)
+            seq.cached_key = hash(matches[-1][0])
+            self.cache.stats["prefill_tokens_saved"] += seq.seen
+        if self.cache is not None and fresh:
+            limit = self._match_limit(len(tokens))
+            if limit > 0:
+                self.cache.stats["prefix_hits"] += len(matches)
+                self.cache.stats["prefix_misses"] += limit - len(matches)
+        seq.blocks.extend(fresh_blocks)
         seq.tokens.extend(int(t) for t in tokens)
         return seq
 
@@ -140,12 +440,15 @@ class DSStateManager:
                 "was not called before the fused dispatch")
         seq.tokens.extend(int(t) for t in tokens)
         seq.seen += len(tokens)
+        self.publish_full_blocks(seq)
 
     def flush(self, uid: int) -> None:
-        """Release a finished sequence (reference: engine_v2.flush:242)."""
+        """Release a finished sequence (reference: engine_v2.flush:242):
+        dec-ref its blocks; cached blocks reaching refcount zero are
+        parked in the LRU pool instead of freed."""
         seq = self.seqs.pop(uid, None)
         if seq is not None:
-            self.allocator.free(seq.blocks)
+            self._release_blocks(seq.blocks)
 
     def block_table(self, seq: SequenceDescriptor) -> np.ndarray:
         """Padded [max_blocks_per_seq] table; unused entries point past the

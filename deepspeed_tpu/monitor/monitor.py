@@ -128,10 +128,14 @@ class CometMonitor(Monitor):
 # say how host-free the decode loop is (ISSUE 1 — dispatches_per_token
 # ~1/K with the fused loop, 1.0 per-tick; fused_occupancy = live
 # (row, step) slot fraction inside fused dispatches), the raw counters
-# give the denominators
+# give the denominators; the prefix_* set (ISSUE 4) charts cache
+# hit rate, prefill tokens saved, and eviction/occupancy pressure
 SERVING_METRIC_KEYS = ("dispatches_per_token", "fused_occupancy",
                        "decoded_tokens", "host_dispatches",
-                       "fused_dispatches", "fused_steps")
+                       "fused_dispatches", "fused_steps",
+                       "prefix_hit_rate", "prefix_hits", "prefix_misses",
+                       "prefix_evictions", "prefill_tokens_saved",
+                       "prefix_cached_blocks", "prefix_evictable_blocks")
 
 
 def serving_events(metrics: dict, step: int,

@@ -119,20 +119,38 @@ def collect_comms(reg: MetricsRegistry, comms_logger=None) -> None:
         byts.set_total(b, op=op)
 
 
-# serving counters mirrored 1:1 from InferenceEngineV2.serving_stats
-_SERVING_COUNTERS = ("decoded_tokens", "host_dispatches",
-                     "fused_dispatches", "fused_steps")
+# serving counters mirrored 1:1 from InferenceEngineV2.serving_stats,
+# plus the prefix-cache counters (schema shared with ragged.py's
+# PREFIX_STAT_KEYS so the key set cannot drift from what
+# serving_metrics() emits). Resolved lazily: importing the inference
+# package here would pull jax + the model zoo into every telemetry
+# process, serving or not.
+_SERVING_COUNTERS_BASE = ("decoded_tokens", "host_dispatches",
+                          "fused_dispatches", "fused_steps")
+_SERVING_GAUGES = ("dispatches_per_token", "fused_occupancy",
+                   "prefix_hit_rate", "prefix_cached_blocks",
+                   "prefix_evictable_blocks")
+
+
+def _serving_counter_keys() -> tuple:
+    import sys
+    ragged = sys.modules.get("deepspeed_tpu.inference.v2.ragged")
+    if ragged is None:
+        # no engine loaded -> nothing beyond the base counters can be
+        # present in the metrics dict anyway
+        return _SERVING_COUNTERS_BASE
+    return _SERVING_COUNTERS_BASE + ragged.PREFIX_STAT_KEYS
 
 
 def collect_serving(reg: MetricsRegistry, serving_metrics: dict,
                     engine_label: str = "v2") -> None:
     """``InferenceEngineV2.serving_metrics()`` -> registry."""
-    for key in _SERVING_COUNTERS:
+    for key in _serving_counter_keys():
         if key in serving_metrics:
             reg.counter(f"ds_serving_{key}_total",
                         f"serving counter {key}").set_total(
                 serving_metrics[key], engine=engine_label)
-    for key in ("dispatches_per_token", "fused_occupancy"):
+    for key in _SERVING_GAUGES:
         if key in serving_metrics:
             reg.gauge(f"ds_serving_{key}",
                       f"decode-loop efficiency ratio {key}").set(

@@ -136,6 +136,16 @@ _SLOW = {
      "test_train_batch_sentinel_accepts_declared_shape_change"),
     ("test_graftlint.py",
      "test_generate_fused_runs_with_sentinels_and_matches"),
+    # prefix cache (ISSUE 4): the host-side unit tests, the fused
+    # parity + zero-recompile acceptance test and the per-tick leak
+    # regression stay tier-1; these engine-heavy variants have cheaper
+    # siblings there (the fused parity test covers the same cache
+    # admission path as the per-tick one)
+    ("test_prefix_cache.py",
+     "test_schedule_admission_counts_only_uncached_blocks"),
+    ("test_prefix_cache.py", "test_serving_metrics_schema_and_reset"),
+    ("test_prefix_cache.py", "test_generate_fused_error_flushes_blocks"),
+    ("test_prefix_cache.py", "test_prefix_cache_greedy_parity_per_tick"),
 }
 
 

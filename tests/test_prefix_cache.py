@@ -1,0 +1,305 @@
+"""Automatic prefix caching (ISSUE 4): ref-counted KV block sharing
+with hash-chained reuse across requests — allocator/LRU/eviction
+semantics, chain-hash collision safety, cached-vs-cold greedy parity on
+both serving drivers, zero-recompile cache hits, and the block-leak
+guard on driver errors."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (DSStateManager, InferenceEngineV2,
+                                        PrefixCache,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.models import Llama
+
+BS = 4  # block size for the host-side unit tests
+
+
+def _mgr(num_blocks=16, max_per_seq=8, **cache_kw):
+    return DSStateManager(
+        block_size=BS, num_blocks=num_blocks,
+        max_blocks_per_seq=max_per_seq,
+        prefix_cache=PrefixCache(block_size=BS, **cache_kw))
+
+
+def _prefill(m, uid, tokens):
+    """extend + simulate a full prefill (seen advances, blocks publish)."""
+    seq = m.extend(uid, tokens)
+    seq.seen = len(seq.tokens)
+    m.publish_full_blocks(seq)
+    return seq
+
+
+def test_refcount_share_flush_and_lru():
+    m = _mgr()
+    toks = list(range(10))                  # 2 full blocks + tail
+    s0 = _prefill(m, 0, toks)
+    assert m.cache.cached_blocks == 2       # tail block never indexed
+    # second identical request shares the 2 full blocks
+    s1 = m.extend(1, list(toks))
+    assert s1.blocks[:2] == s0.blocks[:2]
+    assert s1.seen == 8 and s1.pending == 2
+    assert all(m.allocator.refcount(b) == 2 for b in s1.blocks[:2])
+    st = m.cache.stats
+    assert st["prefix_hits"] == 2 and st["prefill_tokens_saved"] == 8
+    # flush one owner: shared blocks stay referenced, nothing parked
+    m.flush(0)
+    assert m.cache.evictable_blocks == 0
+    assert all(m.allocator.refcount(b) == 1 for b in s1.blocks[:2])
+    # flush the last owner: cached blocks PARK in the LRU (not freed),
+    # and count as allocatable headroom
+    m.flush(1)
+    assert m.cache.evictable_blocks == 2
+    assert m.allocator.free_blocks == 14 and m.available_blocks == 16
+    # a full-pool allocation evicts the parked blocks on demand
+    got = m.allocator.allocate(16)
+    assert len(got) == 16 and m.cache.stats["prefix_evictions"] == 2
+    assert m.cache.cached_blocks == 0
+
+
+def test_partial_tail_and_last_token_stay_private():
+    m = _mgr()
+    _prefill(m, 0, list(range(8)))          # exactly 2 blocks
+    m.flush(0)
+    # only 1 block may match: the last token must stay pending (its
+    # forward produces the logits), so block 2 of an 8-token prompt is
+    # recomputed even though it is cached
+    s = m.extend(1, list(range(8)))
+    assert s.seen == 4 and s.pending == 4
+    assert m.allocator.refcount(s.blocks[0]) == 1
+    assert m.allocator.refcount(s.blocks[1]) == 1   # privately allocated
+
+
+def test_chain_hash_collision_safety():
+    """Identical block tokens under DIFFERENT parents must not cross-
+    match: keys carry the full parent chain."""
+    m = _mgr()
+    common = list(range(BS))                # second block of both chains
+    _prefill(m, 0, [1] * BS + common + [9])
+    _prefill(m, 1, [2] * BS + common + [9])
+    assert m.cache.cached_blocks == 4       # no key collision/sharing
+    m.flush(0), m.flush(1)
+    # a request continuing chain A matches chain A's blocks only
+    s = m.extend(2, [1] * BS + common + [7, 7])
+    a_blocks = s.blocks[:2]
+    assert s.seen == 2 * BS
+    s2 = m.extend(3, [2] * BS + common + [7, 7])
+    assert s2.seen == 2 * BS
+    assert s2.blocks[0] != a_blocks[0] and s2.blocks[1] != a_blocks[1]
+
+
+def test_min_match_blocks_gate():
+    m = _mgr(min_match_blocks=2)
+    _prefill(m, 0, list(range(6)))          # 1 full block cached
+    m.flush(0)
+    s = m.extend(1, list(range(6)))
+    assert s.seen == 0                      # 1-block match < gate
+    assert m.cache.stats["prefill_tokens_saved"] == 0
+
+
+def test_max_cached_blocks_cap_evicts_lru():
+    m = _mgr(max_cached_blocks=2)
+    _prefill(m, 0, list(range(12)))         # 3 full blocks, cap at 2
+    # block 3 cannot be indexed: the cap is reached and blocks 1-2 are
+    # still REFERENCED (never evictable) — publication is skipped
+    assert m.cache.cached_blocks == 2
+    assert m.cache.stats["prefix_evictions"] == 0
+    m.flush(0)                              # now 2 parked, evictable
+    # an unrelated chain's publication at the cap evicts the LRU oldest
+    # (chain 0's root), breaking that chain's matchability from block 1
+    _prefill(m, 1, list(range(20, 26)))
+    assert m.cache.stats["prefix_evictions"] == 1
+    assert m.cache.cached_blocks == 2
+    m.flush(1)
+    s = m.extend(2, list(range(12)))
+    assert s.seen == 0                      # chain 0 root gone
+
+
+def test_lru_eviction_order_and_touch():
+    m = _mgr(num_blocks=8, max_per_seq=4)
+    _prefill(m, 0, list(range(0, 4)) + [90])    # chain A: 1 full block
+    _prefill(m, 1, list(range(10, 14)) + [91])  # chain B
+    m.flush(0), m.flush(1)
+    # only the full blocks park; the private tails went back to free
+    assert m.allocator.free_blocks == 6 and m.cache.evictable_blocks == 2
+    # touch chain A (pin + release): A becomes most-recently-used
+    s = m.extend(2, list(range(0, 4)) + [92])
+    assert s.seen == 4
+    m.flush(2)
+    # exhausting the pool evicts OLDEST first: chain B goes, A stays
+    m.allocator.allocate(7)
+    assert m.cache.stats["prefix_evictions"] == 1
+    assert m.prefix_match(list(range(10, 14)) + [94]) == []
+    assert len(m.prefix_match(list(range(0, 4)) + [94])) == 1
+
+
+def test_schedule_admission_counts_only_uncached_blocks(devices8):
+    """A pool with room for ~1 prompt admits a BATCH of same-prefix
+    prompts once the prefix is cached: headroom math charges only the
+    uncached tail blocks."""
+    model = Llama(size="tiny")
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=8,
+        max_chunk_size=16, prefix_cache={"enabled": True}))
+    shared = list(range(1, 41))             # 5 of the 8 blocks
+    e.put([0], [shared + [50]])
+    e.flush(0)
+    assert e.state_manager.available_blocks == 8
+    # three same-prefix prompts need 3 private tail blocks + 5 shared:
+    # 8 blocks raw x3 would never fit an 8-block pool
+    assert e.can_schedule(1, 42)
+    e.schedule([1, 2, 3], [shared + [51], shared + [52], shared + [53]])
+    assert e.state_manager.allocator.free_blocks == 0
+    for u in (1, 2, 3):
+        assert e.query(u) == (40, 6)
+    e.flush([1, 2, 3])
+    # a REJECTED batch must roll its pre-pinned matches back: the
+    # parked shared blocks stay evictable after the raise
+    assert e.state_manager.cache.evictable_blocks == 5
+    with pytest.raises(RuntimeError, match="exhaust"):
+        e.schedule([4, 5, 6, 7],
+                   [shared + [60 + i, 61, 62, 63, 64, 65, 66, 67, 68]
+                    for i in range(4)])
+    assert e.state_manager.cache.evictable_blocks == 5
+    assert e.state_manager.available_blocks == 8
+    assert not e.state_manager.seqs
+
+
+def test_prefix_cache_greedy_parity_per_tick(devices8):
+    """Acceptance: greedy outputs with prefix_cache enabled are
+    bit-identical to the disabled path — cold AND cache-hit."""
+    model = Llama(size="tiny")
+    rng = np.random.default_rng(3)
+    shared = rng.integers(0, 512, 32).tolist()
+    prompts = [shared + rng.integers(0, 512, n).tolist() for n in (5, 7)]
+    ref = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=128,
+        max_chunk_size=16)).generate(prompts, max_new_tokens=6)
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=128,
+        max_chunk_size=16, prefix_cache={"enabled": True}))
+    assert e.generate(prompts, max_new_tokens=6) == ref     # cold
+    warm = e.generate(prompts, max_new_tokens=6)            # all hits
+    assert warm == ref
+    m = e.serving_metrics()
+    assert m["prefix_hits"] > 0 and m["prefill_tokens_saved"] >= 64
+    # everything flushed: the pool is fully recoverable
+    assert e.state_manager.available_blocks == 128
+
+
+def test_prefix_cache_fused_parity_and_zero_recompile(devices8):
+    """Fused-driver parity + the recompile sentinel: a warmed cache-hit
+    generation adds ZERO backend_compile events (block tables are
+    host-side — hits must not change traced shapes)."""
+    from deepspeed_tpu.telemetry.bridges import (
+        compile_event_count, install_jax_compile_listener)
+    install_jax_compile_listener()
+    model = Llama(size="tiny")
+    rng = np.random.default_rng(4)
+    shared = rng.integers(0, 512, 32).tolist()
+    prompts = [shared + rng.integers(0, 512, n).tolist() for n in (7, 3)]
+    kw = dict(max_new_tokens=8, k_steps=3)
+    ref = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=128,
+        max_chunk_size=16)).generate_fused(prompts, **kw)
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=128,
+        max_chunk_size=16, prefix_cache={"enabled": True}))
+    assert e.generate_fused(prompts, **kw) == ref           # cold
+    before = compile_event_count()
+    assert e.generate_fused(prompts, **kw) == ref           # warm: hits
+    assert compile_event_count() == before
+    m = e.serving_metrics()
+    assert m["prefix_hits"] > 0 and m["prefill_tokens_saved"] >= 64
+
+
+def test_serving_metrics_schema_and_reset(devices8):
+    """Cache counters ride serving_metrics() with a stable schema
+    (zeros when disabled) and reset_serving_metrics() clears them."""
+    from deepspeed_tpu.inference.v2.ragged import PREFIX_STAT_KEYS
+    model = Llama(size="tiny")
+    off = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=32,
+        max_chunk_size=16))
+    for k in PREFIX_STAT_KEYS + ("prefix_hit_rate",
+                                 "prefix_cached_blocks",
+                                 "prefix_evictable_blocks"):
+        assert off.serving_metrics()[k] == 0
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=32,
+        max_chunk_size=16, prefix_cache={"enabled": True}))
+    p = list(range(1, 20))
+    e.put([0], [p])
+    e.flush(0)
+    e.put([1], [p])
+    e.flush(1)
+    m = e.serving_metrics()
+    assert m["prefix_hits"] > 0 and m["prefill_tokens_saved"] > 0
+    assert m["prefix_evictable_blocks"] > 0
+    e.reset_serving_metrics()
+    m = e.serving_metrics()
+    for k in PREFIX_STAT_KEYS:
+        assert m[k] == 0
+    # occupancy gauges survive reset (they describe live state)
+    assert m["prefix_evictable_blocks"] > 0
+
+
+def test_generate_error_flushes_blocks(devices8):
+    """Block-leak guard: an exception mid-drive releases every
+    scheduled-but-unfinished sequence's KV blocks."""
+    model = Llama(size="tiny")
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=32,
+        max_chunk_size=16))
+    orig, calls = e.tick, []
+
+    def boom():
+        if calls:
+            raise RuntimeError("injected mid-drive failure")
+        calls.append(1)
+        return orig()
+
+    e.tick = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        e.generate([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=8)
+    assert e.free_blocks == 32 and not e.state_manager.seqs
+    e.tick = orig
+    # the engine still serves after the failed drive
+    assert len(e.generate([[1, 2, 3]], max_new_tokens=4)[0]) == 4
+
+
+def test_generate_fused_error_flushes_blocks(devices8):
+    model = Llama(size="tiny")
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="float32", kv_block_size=8, num_kv_blocks=32,
+        max_chunk_size=16))
+    orig = e._fused_operands
+
+    def boom(*a, **kw):
+        # first fused dispatch build: both prompts are already admitted
+        # and prefilled (KV blocks live) — the leak scenario
+        raise RuntimeError("injected mid-drive failure")
+
+    e._fused_operands = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        e.generate_fused([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=12,
+                         k_steps=2)
+    assert e.free_blocks == 32 and not e.state_manager.seqs
+    e._fused_operands = orig
+    assert len(e.generate_fused([[1, 2, 3]], max_new_tokens=4)[0]) == 4
+
+    # a reserve() failure mid-admission-batch must also release the
+    # whole batch (every scheduled uid joins `live` before reserving)
+    mgr = e.state_manager
+    orig_res = mgr.reserve
+
+    def boom_res(uid, n):
+        if uid == 1:
+            raise RuntimeError("injected reserve failure")
+        return orig_res(uid, n)
+
+    mgr.reserve = boom_res
+    with pytest.raises(RuntimeError, match="injected reserve"):
+        e.generate_fused([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=8)
+    assert e.free_blocks == 32 and not mgr.seqs
+    mgr.reserve = orig_res

@@ -8,7 +8,9 @@ Reads the Chrome-trace JSON written by
 ``telemetry.export_artifacts()`` (or any Chrome-trace file with ``X``
 events) and prints a per-span-name table — count, total/mean/max ms,
 share of top-level wall time — plus, when a metrics file is given, the
-scalar metric values (Prometheus text or the registry's JSON snapshot).
+scalar metric values (Prometheus text or the registry's JSON snapshot)
+and a serving summary rolling up the ``ds_serving_*`` series,
+prefix-cache hit/miss/eviction counters included.
 
 ``--json`` emits one machine-readable JSON object instead of tables
 (the smoke path CI exercises).
@@ -81,6 +83,28 @@ def parse_metrics_json(path: str) -> dict[str, float]:
     return out
 
 
+def serving_summary(metrics: dict) -> dict:
+    """Serving-focused rollup of the flat metrics: every
+    ``ds_serving_*`` series (fused-decode efficiency, latency histogram
+    aggregates, and the prefix-cache hit/miss/eviction counters +
+    occupancy gauges), plus a derived block-level
+    ``prefix_hit_rate_derived`` when the hit/miss counters are
+    present."""
+    out = {k: v for k, v in sorted(metrics.items())
+           if "ds_serving_" in k}
+
+    def total(stem: str):
+        vals = [v for k, v in metrics.items() if stem in k
+                and not k.endswith(("_mean",))]
+        return sum(vals) if vals else None
+
+    hits = total("ds_serving_prefix_hits_total")
+    misses = total("ds_serving_prefix_misses_total")
+    if hits is not None and misses is not None and hits + misses > 0:
+        out["prefix_hit_rate_derived"] = round(hits / (hits + misses), 4)
+    return out
+
+
 def build_report(trace_path: str, metrics_path: str | None) -> dict:
     events = load_trace(trace_path)
     rows = span_table(events)
@@ -95,6 +119,7 @@ def build_report(trace_path: str, metrics_path: str | None) -> dict:
             report["metrics"] = parse_metrics_json(metrics_path)
         else:
             report["metrics"] = parse_prometheus(metrics_path)
+        report["serving"] = serving_summary(report["metrics"])
     return report
 
 
@@ -113,6 +138,15 @@ def print_report(report: dict) -> None:
         print(f"{'metric':<64}{'value':>14}")
         for series in sorted(metrics):
             v = metrics[series]
+            sval = f"{v:.6g}" if isinstance(v, float) else str(v)
+            print(f"{series[:63]:<64}{sval:>14}")
+    serving = report.get("serving")
+    if serving:
+        print()
+        print("serving summary (ds_serving_* incl. prefix cache):")
+        print(f"{'series':<64}{'value':>14}")
+        for series in sorted(serving):
+            v = serving[series]
             sval = f"{v:.6g}" if isinstance(v, float) else str(v)
             print(f"{series[:63]:<64}{sval:>14}")
 
