@@ -1178,14 +1178,15 @@ def _emit_final() -> None:
 _BENCH_DONE = threading.Event()
 
 
-def _arm_total_watchdog(total_s: float) -> None:
+def _arm_total_watchdog(total_s: float, grace_s: float = 30.0) -> None:
     """Hard global deadline (BENCH_r05 rc=124): if the stage matrix is
-    still running ``total_s`` seconds in — e.g. a stage wedged inside a
-    C++ XLA compile where SIGALRM never fires — emit the stdout JSON
-    and exit 0 from a daemon thread, so the driver parses a result
-    instead of a timeout kill."""
+    still running ``grace_s`` seconds past the ``total_s`` budget —
+    e.g. a stage wedged inside a C++ XLA compile where SIGALRM never
+    fires — emit the stdout JSON and exit 0 from a daemon thread, so
+    the driver parses a result instead of a timeout kill. Messages
+    report the configured budget, not the budget+grace wait."""
     def run():
-        if not _BENCH_DONE.wait(total_s):
+        if not _BENCH_DONE.wait(total_s + grace_s):
             _FINAL.setdefault(
                 "interrupted",
                 f"total budget {total_s:.0f}s exhausted mid-stage")
@@ -1312,7 +1313,7 @@ def main(argv=None):
     if deadline is not None:
         # backstop for a stage unresponsive even to SIGALRM: emit the
         # JSON and exit 0 shortly after the deadline passes
-        _arm_total_watchdog(total_budget + 30)
+        _arm_total_watchdog(total_budget)
     try:
         for name, fn in STAGES:
             if selected and name not in selected:

@@ -443,7 +443,10 @@ class InferenceEngineV2:
 
     @property
     def free_blocks(self) -> int:
-        return self.state_manager.allocator.free_blocks
+        """Schedulable KV-block headroom. Matches the admission math:
+        cached blocks with refcount zero count as free (the allocator
+        evicts them on demand)."""
+        return self.state_manager.available_blocks
 
     def flush(self, uids) -> None:
         """Release finished sequences' KV blocks; accepts one uid or an
@@ -642,7 +645,8 @@ class InferenceEngineV2:
             "work)").observe(dt)
         tel.bridges.collect_serving(reg, self.serving_metrics())
         reg.gauge("ds_serving_free_kv_blocks",
-                  "free blocks in the paged KV pool").set(
+                  "schedulable blocks in the paged KV pool (truly free "
+                  "plus evictable prefix-cached)").set(
             self.free_blocks, engine="v2")
 
     def serving_metrics(self) -> dict:

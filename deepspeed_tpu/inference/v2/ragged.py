@@ -145,6 +145,10 @@ class PrefixCache:
         self.block_key: dict[int, tuple] = {}
         self.lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0 blocks
         self.stats = dict.fromkeys(PREFIX_STAT_KEYS, 0)
+        # where cap-evicted blocks go (set by DSStateManager to the
+        # allocator's free list) — an evicted block is on neither the
+        # free list nor the index, so dropping it would leak it
+        self.free_sink = None               # (block: int) -> None
 
     @property
     def cached_blocks(self) -> int:
@@ -182,15 +186,21 @@ class PrefixCache:
         """Index one freshly-computed full block under its chain key;
         returns the child chain hash. First publisher wins (a concurrent
         duplicate keeps its block private); at ``max_cached_blocks`` an
-        unreferenced LRU block is evicted to make room, and if nothing
-        is evictable the publication is skipped (the chain hash still
-        advances, so later blocks stay publishable)."""
+        unreferenced LRU block is evicted to make room and returned to
+        the allocator via ``free_sink``, and if nothing is evictable the
+        publication is skipped (the chain hash still advances, so later
+        blocks stay publishable)."""
         key = (parent, block_tokens)
         if key not in self.index:
             if (self.max_cached_blocks > 0
-                    and len(self.index) >= self.max_cached_blocks
-                    and self.evict_one() is None):
-                return hash(key)
+                    and len(self.index) >= self.max_cached_blocks):
+                evicted = self.evict_one()
+                if evicted is None:
+                    return hash(key)
+                # the evicted block is refcount-0 and was parked OFF the
+                # allocator's free list — hand it back or it leaks
+                if self.free_sink is not None:
+                    self.free_sink(evicted)
             self.index[key] = block
             self.block_key[block] = key
         return hash(key)
@@ -230,6 +240,7 @@ class DSStateManager:
         self.cache = prefix_cache
         if prefix_cache is not None:
             self.allocator.evict_source = prefix_cache.evict_one
+            prefix_cache.free_sink = lambda b: self.allocator.free([b])
 
     @property
     def available_blocks(self) -> int:

@@ -113,6 +113,12 @@ def test_max_cached_blocks_cap_evicts_lru():
     m.flush(1)
     s = m.extend(2, list(range(12)))
     assert s.seen == 0                      # chain 0 root gone
+    m.flush(2)
+    # no block leaked by the cap-path eviction: with every sequence
+    # flushed the whole pool is accounted for (truly free + parked)
+    assert m.available_blocks == 16
+    assert (m.allocator.free_blocks + m.cache.evictable_blocks) == 16
+    assert len(m.allocator.allocate(16)) == 16
 
 
 def test_lru_eviction_order_and_touch():
