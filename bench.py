@@ -77,11 +77,37 @@ def _mean_ci95(xs):
 def _mfu_fields(tps: float, cfg, seq: int) -> dict:
     """Primary MFU is causal-physical accounting; the conventional
     full-attention figure rides along as mfu_noncausal for
-    cross-framework comparison (VERDICT r2 weak #1)."""
+    cross-framework comparison (VERDICT r2 weak #1). With --telemetry,
+    the device-truth fields from the executable ledger (ISSUE 5) ride
+    along: compiler-measured MFU and peak HBM of the compiled step."""
     peak = peak_flops(jax.devices()[0])
     return {"mfu": round(tps * cfg.flops_per_token(seq) / peak, 4),
             "mfu_noncausal": round(
-                tps * cfg.flops_per_token(seq, causal=False) / peak, 4)}
+                tps * cfg.flops_per_token(seq, causal=False) / peak, 4),
+            **_ledger_truth_fields(peak)}
+
+
+def _ledger_truth_fields(peak: float) -> dict:
+    """{mfu_hlo, hbm_peak_bytes} from the telemetry executable ledger
+    when it is live (bench --telemetry): MFU from the compiled step's
+    own cost_analysis() FLOPs over the measured span window, and the
+    largest registered executable's compiler-reported peak HBM. Empty
+    when telemetry/ledger are off."""
+    from deepspeed_tpu.utils.telemetry_probe import active_telemetry
+    mod = active_telemetry()
+    led = mod.get_ledger() if mod is not None else None
+    if led is None or not len(led):
+        return {}
+    out: dict = {}
+    peaks = led.peak_hbm_by_name()
+    if peaks:
+        out["hbm_peak_bytes"] = max(peaks.values())
+    tracer = mod.get_tracer()
+    if tracer is not None:
+        mfu = led.mfu_by_name(tracer.totals_trimmed(), peak)
+        if "compiled_step" in mfu:
+            out["mfu_hlo"] = round(mfu["compiled_step"], 4)
+    return out
 
 
 def _train_tput(ds, model, config_extra: dict, batch: int, seq: int,
@@ -1190,6 +1216,22 @@ def _arm_total_watchdog(total_s: float, grace_s: float = 30.0) -> None:
             _FINAL.setdefault(
                 "interrupted",
                 f"total budget {total_s:.0f}s exhausted mid-stage")
+            # forensics BEFORE the exit (ISSUE 5): when telemetry's
+            # flight recorder is live, leave a hang dump (recent
+            # dispatches, open spans, ledger, thread stacks) so an
+            # rc=124-class wedge is diagnosable post-mortem
+            try:
+                from deepspeed_tpu.utils.telemetry_probe import \
+                    active_telemetry
+                mod = active_telemetry()
+                if mod is not None:
+                    path = mod.dump_flight_record(
+                        f"bench total budget {total_s:.0f}s exhausted")
+                    if path:
+                        print(f"# flight-recorder dump: {path}",
+                              file=sys.stderr)
+            except Exception:   # noqa: BLE001 - never mask the exit
+                pass
             print(f"# total budget {total_s:.0f}s exhausted; exiting "
                   "with the stages completed so far", file=sys.stderr)
             _emit_final()
@@ -1290,7 +1332,12 @@ def main(argv=None):
 
     if args.telemetry:
         from deepspeed_tpu import telemetry
-        telemetry.configure()
+        # full device-truth stack (ISSUE 5): executable ledger for
+        # mfu_hlo/hbm_peak_bytes stage fields, flight recorder so the
+        # total-budget watchdog can leave forensics behind
+        telemetry.configure(executable_ledger=True,
+                            flight_recorder=True,
+                            watchdog_artifact_dir=args.telemetry)
 
     on_tpu = jax.devices()[0].platform != "cpu"
     budget = args.budget_s or (600 if on_tpu else 240)

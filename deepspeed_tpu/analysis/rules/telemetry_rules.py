@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import ast
 
-from ..core import Context, Rule
+from ..core import Context, Rule, attr_chain
 
 # modules allowed to name the package: the probe itself (its activate()
 # helper is THE sanctioned import point) and the package's own files
@@ -54,4 +54,61 @@ class DirectTelemetryImport(Rule):
             "activate() to turn telemetry on)")
 
 
-RULES = [DirectTelemetryImport()]
+_HOST_ONLY_GETTERS = {"get_flight_recorder", "get_ledger",
+                      "get_watchdog", "dump_flight_record"}
+_RECORD_METHODS = {"record", "progress", "observe", "fire"}
+# receiver-name stems identifying a flight-recorder/ledger handle
+_RECEIVER_STEMS = ("ledger", "flight", "recorder", "flightrec")
+_RECEIVER_EXACT = {"fr", "led"}
+
+
+def _device_truth_receiver(chain: list[str]) -> bool:
+    for part in chain[:-1]:
+        low = part.lower()
+        if low in _RECEIVER_EXACT or any(s in low
+                                         for s in _RECEIVER_STEMS):
+            return True
+    return False
+
+
+class DeviceTruthRecordInJit(Rule):
+    id = "GL041"
+    name = "flightrec-in-jit"
+    summary = ("flight-recorder/executable-ledger API "
+               "(record/progress/observe, or the get_* handles) called "
+               "inside jit-reachable code — host-only telemetry must "
+               "never ride a traced program (it would bake host state "
+               "mutation into the executable, or silently freeze at "
+               "trace-time values)")
+
+    def check(self, ctx: Context) -> None:
+        if _allowed(ctx.relpath):
+            return
+        for info in ctx.index.reachable_functions():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call) \
+                        or ctx.index.enclosing_function(node) \
+                        is not info.node:
+                    continue
+                if not isinstance(node.func, ast.Attribute):
+                    continue
+                chain = attr_chain(node.func)
+                attr = node.func.attr
+                if attr in _HOST_ONLY_GETTERS:
+                    ctx.report(
+                        self.id, node,
+                        f"{attr}() inside jit-reachable code; the "
+                        "flight-recorder/ledger handles are host-only "
+                        "— hoist to the dispatch call site")
+                elif attr in _RECORD_METHODS and chain \
+                        and _device_truth_receiver(chain):
+                    ctx.report(
+                        self.id, node,
+                        f".{attr}() on a flight-recorder/ledger "
+                        "handle inside jit-reachable code; record at "
+                        "the host dispatch boundary instead (the "
+                        "traced body runs at trace time, not per "
+                        "step)")
+
+
+RULES = [DirectTelemetryImport(), DeviceTruthRecordInJit()]

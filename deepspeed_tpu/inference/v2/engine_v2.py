@@ -300,6 +300,14 @@ class InferenceEngineV2:
         # token (logits_gather fused into the compiled step)
         self.serving_stats["host_dispatches"] += 1
         tel = _telemetry()
+        dev_ops = (jnp.asarray(tokens), jnp.asarray(pos0),
+                   jnp.asarray(tables), jnp.asarray(true_len))
+        if tel is not None:
+            # ISSUE 5 hooks BEFORE the dispatch: pools are donated
+            # through the step, and first-sight ledger registration
+            # must stay outside any sentinel watch
+            self._device_truth_observe(tel, "v2/dispatch", self._step,
+                                       dev_ops)
         # span measures the host-side dispatch (enqueue; the device work
         # itself lands in the XPlane via the TraceAnnotation mirror)
         with (tel.span("v2/dispatch",
@@ -307,9 +315,7 @@ class InferenceEngineV2:
                        rows=len(seqs), chunk=s_bucket)
               if tel is not None else _NULLCM):
             logits, self.pools = self._step(
-                self.params, self.pools, jnp.asarray(tokens),
-                jnp.asarray(pos0), jnp.asarray(tables),
-                jnp.asarray(true_len))
+                self.params, self.pools, *dev_ops)
         for i, seq in enumerate(seqs):
             seq.seen += int(true_len[i])
             # prefix cache: blocks this chunk completed are now fully in
@@ -607,6 +613,9 @@ class InferenceEngineV2:
               if tel is not None else _NULLCM):
             ops = self._fused_operands(uids, k, b, seed)
             fn = self._fused_fn(k, temperature, top_k, top_p, eos)
+            if tel is not None:
+                self._device_truth_observe(tel, "v2/fused_dispatch",
+                                           fn, ops)
             st["host_dispatches"] += 1
             st["fused_dispatches"] += 1
             with self._fused_dispatch_scope(
@@ -630,9 +639,29 @@ class InferenceEngineV2:
                 tel, time.perf_counter() - t0)
         return res
 
+    def _device_truth_observe(self, tel, name: str, fn,
+                              dev_ops: tuple) -> None:
+        """Flight-recorder heartbeat + executable-ledger observation
+        for one v2 dispatch (ISSUE 5; no-ops unless the opt-in knobs
+        enabled them). Must run BEFORE the dispatch: the KV pools are
+        donated operands."""
+        fr = tel.get_flight_recorder()
+        if fr is not None:
+            fr.progress("v2_dispatch", span=name)
+        led = tel.get_ledger()
+        if led is not None:
+            led.observe(name, fn,
+                        (self.params, self.pools) + tuple(dev_ops),
+                        mesh=self.mesh)
+
     def _record_dispatch_telemetry(self, tel, dt: float) -> None:
         """Fused-dispatch boundary metrics (per DISPATCH — K tokens'
         worth of work — never per token)."""
+        fr = tel.get_flight_recorder()
+        if fr is not None:
+            # drain completed = the decode loop made real progress
+            # (the hang watchdog's deadline clock resets here)
+            fr.progress("v2_drain")
         reg = tel.get_registry()
         if reg is None:
             return
@@ -998,6 +1027,10 @@ class InferenceEngineV2:
                 if n_enq > 0 and (pending
                                   or max(budgets.values()) <= k * n_enq):
                     break
+                if tel is not None:
+                    self._device_truth_observe(
+                        tel, "v2/fused_dispatch", fn,
+                        (tok_a, pos_a, tables, act_a, rem_a, row_keys))
                 with (tel.span("v2/fused_enqueue",
                                dispatch_id=stats["fused_dispatches"] + 1,
                                rows=len(rowset), k=k)

@@ -54,17 +54,42 @@ def count_params(params: Any) -> int:
                if hasattr(x, "shape"))
 
 
+def lower_compiled(fn, *args, **kwargs):
+    """``jit(fn).lower(...).compile()`` — the shared AOT entry the
+    profiler AND the telemetry executable ledger register through.
+    jax caches the result per abstract signature, so repeated calls
+    for the same shapes cost one dict lookup, not a recompile."""
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return jitted.lower(*args, **kwargs).compile()
+
+
+def compiled_cost(compiled) -> dict:
+    """Normalized ``cost_analysis()`` dict of an already-compiled
+    executable; {} when the backend has no cost model."""
+    from ...utils.jax_compat import normalize_cost_analysis
+    try:
+        return normalize_cost_analysis(compiled.cost_analysis())
+    except Exception:
+        return {}
+
+
+def compiled_memory(compiled) -> dict:
+    """Normalized ``memory_analysis()`` byte dict (argument/output/
+    temp/alias/peak); {} when the backend exposes nothing."""
+    from ...utils.jax_compat import normalize_memory_analysis
+    try:
+        return normalize_memory_analysis(compiled.memory_analysis())
+    except Exception:
+        return {}
+
+
 def _hlo_cost(fn, *abstract_args) -> tuple[float, float]:
     """(flops, bytes accessed) of fn compiled at the given abstract
     shapes; (0, 0) when the backend exposes no cost analysis."""
     try:
-        compiled = jax.jit(fn).lower(*abstract_args).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        cost = dict(cost or {})
-        return (float(cost.get("flops", 0.0)),
-                float(cost.get("bytes accessed", 0.0)))
+        cost = compiled_cost(lower_compiled(fn, *abstract_args))
+        return (cost.get("flops", 0.0),
+                cost.get("bytes accessed", 0.0))
     except Exception:
         return (0.0, 0.0)
 
@@ -245,14 +270,8 @@ class FlopsProfiler:
         run reuses the already-compiled executable, so latency excludes
         trace/compile time (the quantity MFU accounting needs)."""
         fn = fn or self._step_fn()
-        compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-        try:
-            cost = compiled.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0] if cost else {}
-            self._cost = dict(cost or {})
-        except Exception:
-            self._cost = {}
+        compiled = lower_compiled(fn, *args, **kwargs)
+        self._cost = compiled_cost(compiled)
         self.flops = float(self._cost.get("flops", 0.0))
         self.macs = self.flops / 2
         self.bytes_accessed = float(self._cost.get("bytes accessed", 0.0))

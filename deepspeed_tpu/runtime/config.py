@@ -193,6 +193,31 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # registry -> MonitorMaster flush cadence in engine steps
     # (0 = follow steps_per_print)
     flush_interval_steps: int = 0
+    # --- device-truth layer (ISSUE 5), opt-in on top of enabled ------
+    # register every observed compiled executable's cost_analysis()/
+    # memory_analysis() (FLOPs, HBM) keyed by jit name + shape
+    # signature; feeds the ds_mfu / ds_ledger_* / HBM-headroom gauges.
+    # Costs ONE extra backend compile per new executable at warmup.
+    executable_ledger: bool = False
+    # walk each registered executable's HLO for collective ops and
+    # attribute payload bytes to mesh axes (requires executable_ledger)
+    hlo_collectives: bool = True
+    # device peak FLOPs for the MFU denominator (0 = accelerator
+    # table; CPU uses an arbitrary 1e12 floor)
+    device_peak_flops: float = 0.0
+    # per-rank ring buffer of recent dispatch/progress events, dumped
+    # on hangs (telemetry/flightrec.py)
+    flight_recorder: bool = False
+    flight_recorder_size: int = 2048
+    # hang watchdog: if instrumented loops (train_batch, fused-decode
+    # drain) report no progress for this many seconds, dump flight
+    # recorder + open spans + ledger + thread stacks to
+    # watchdog_artifact_dir (0 = watchdog off; needs flight_recorder)
+    watchdog_deadline_s: float = 0.0
+    watchdog_artifact_dir: str = "telemetry_hangdump"
+    # SIGABRT the process after a hang dump so a supervisor restarts
+    # it (instead of an external timeout SIGKILLing without forensics)
+    watchdog_abort: bool = False
 
 
 class SentinelsConfig(DeepSpeedConfigModel):

@@ -762,6 +762,13 @@ class DeepSpeedEngine:
             with (tel.span("batch_to_device")
                   if tel is not None else _NULLCM):
                 batch = self._put_batch(batch)
+            if tel is not None:
+                # device-truth hooks (ISSUE 5): BEFORE the dispatch
+                # (state is donated through the step) and OUTSIDE the
+                # sentinel watch scope (first-sight ledger
+                # registration compiles once, which the recompile
+                # sentinel must not see)
+                self._device_truth_observe(tel, batch)
             self.tput_timer.start()
             if self._offload_opt is not None:
                 metrics = self._train_batch_offload(batch)
@@ -858,6 +865,20 @@ class DeepSpeedEngine:
             + (f" loss_scale={float(metrics['loss_scale']):.0f}"
                if self.fp16_enabled else ""))
 
+    def _device_truth_observe(self, tel, batch):
+        """Flight-recorder heartbeat + executable-ledger observation
+        for one train_batch dispatch (no-ops unless the opt-in ISSUE 5
+        knobs enabled them at configure time)."""
+        fr = tel.get_flight_recorder()
+        if fr is not None:
+            fr.progress("train_batch", step=self.global_steps + 1)
+        led = tel.get_ledger()
+        if led is not None:
+            # offload tier reuses the same attribute for its grads
+            # step, so one observation point covers both paths
+            led.observe("compiled_step", self._train_step,
+                        (self.state, batch), mesh=self.mesh)
+
     def _telemetry_boundary(self, tel, metrics):
         """Boundary-cadence telemetry work (never per step): the
         wall_clock_breakdown monitor events at steps_per_print, and the
@@ -877,6 +898,11 @@ class DeepSpeedEngine:
                 # counters/memory/comms without blocking dispatch-ahead
                 tel.bridges.record_train_step(
                     reg, self, metrics if on_print else None)
+                if jax.process_count() > 1:
+                    # per-step straggler skew: two tiny host
+                    # collectives, boundary cadence only (ISSUE 5)
+                    tel.flightrec.record_straggler_skew(
+                        reg, self.global_steps)
                 if self.monitor is not None and self.monitor.enabled:
                     tel.bridges.flush_to_monitor(
                         self.monitor, self.global_samples)

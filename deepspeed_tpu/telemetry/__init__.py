@@ -33,12 +33,19 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from . import bridges, registry as _registry_mod, spans as _spans_mod
+from . import (bridges, collectives, flightrec as _flightrec_mod,  # noqa: F401
+               ledger as _ledger_mod, registry as _registry_mod,
+               spans as _spans_mod)
+from .flightrec import (FlightRecorder, HangWatchdog,  # noqa: F401
+                        get_flight_recorder, get_watchdog)
+flightrec = _flightrec_mod   # public alias for instrumented call sites
+from .ledger import ExecutableLedger, get_ledger  # noqa: F401
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry, get_registry)
 from .spans import NULL_CONTEXT, SpanTracer, get_tracer  # noqa: F401
 
 _ACTIVE = False
+_ARTIFACT_DIR = "telemetry_hangdump"
 
 
 def is_active() -> bool:
@@ -48,12 +55,24 @@ def is_active() -> bool:
 
 def configure(config=None, *, span_buffer_size: Optional[int] = None,
               profiler_annotations: Optional[bool] = None,
-              jax_compile_events: Optional[bool] = None) -> None:
+              jax_compile_events: Optional[bool] = None,
+              executable_ledger: Optional[bool] = None,
+              hlo_collectives: Optional[bool] = None,
+              flight_recorder: Optional[bool] = None,
+              flight_recorder_size: Optional[int] = None,
+              watchdog_deadline_s: Optional[float] = None,
+              watchdog_artifact_dir: Optional[str] = None,
+              watchdog_abort: Optional[bool] = None) -> None:
     """Activate telemetry for this process. ``config`` may be the
     engine's ``TelemetryConfig`` block; keyword overrides win.
     Idempotent: re-configuring while active keeps the existing
     tracer/registry (so engine init cannot wipe a bench harness's
-    already-collected spans)."""
+    already-collected spans).
+
+    The device-truth layer (ISSUE 5) is opt-in on top: the executable
+    ledger + HLO collective accounting (``executable_ledger``), and
+    the flight recorder + hang watchdog (``flight_recorder`` /
+    ``watchdog_deadline_s``)."""
     global _ACTIVE
     if _ACTIVE:
         return
@@ -67,9 +86,30 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
     capacity = pick(span_buffer_size, "span_buffer_size", 8192)
     annotations = pick(profiler_annotations, "profiler_annotations", True)
     compile_events = pick(jax_compile_events, "jax_compile_events", True)
+    ledger_on = pick(executable_ledger, "executable_ledger", False)
+    hlo_coll = pick(hlo_collectives, "hlo_collectives", True)
+    flight_on = pick(flight_recorder, "flight_recorder", False)
+    flight_cap = pick(flight_recorder_size, "flight_recorder_size", 2048)
+    deadline = pick(watchdog_deadline_s, "watchdog_deadline_s", 0.0)
+    artifact_dir = pick(watchdog_artifact_dir, "watchdog_artifact_dir",
+                        "telemetry_hangdump")
+    abort = pick(watchdog_abort, "watchdog_abort", False)
+    global _ARTIFACT_DIR
+    _ARTIFACT_DIR = artifact_dir
     _spans_mod.set_tracer(SpanTracer(
         capacity=capacity, profiler_annotations=annotations))
     _registry_mod.set_registry(MetricsRegistry())
+    if ledger_on:
+        _ledger_mod.set_ledger(ExecutableLedger(
+            hlo_collectives=hlo_coll))
+    if flight_on:
+        rec = FlightRecorder(capacity=flight_cap)
+        _flightrec_mod.set_flight_recorder(rec)
+        if deadline and deadline > 0:
+            dog = HangWatchdog(rec, deadline_s=deadline,
+                               artifact_dir=artifact_dir, abort=abort)
+            _flightrec_mod.set_watchdog(dog)
+            dog.start()
     if compile_events:
         bridges.install_jax_compile_listener()
     _ACTIVE = True
@@ -81,18 +121,28 @@ def shutdown() -> None:
     no-ops once the registry is gone."""
     global _ACTIVE
     _ACTIVE = False
+    _flightrec_mod.set_watchdog(None)
+    _flightrec_mod.set_flight_recorder(None)
+    _ledger_mod.set_ledger(None)
     _spans_mod.set_tracer(None)
     _registry_mod.set_registry(None)
 
 
 def clear() -> None:
-    """Reset spans + metrics in place (e.g. between bench stages)."""
+    """Reset spans + metrics + device-truth state in place (e.g.
+    between bench stages)."""
     t = get_tracer()
     if t is not None:
         t.clear()
     r = get_registry()
     if r is not None:
         r.clear()
+    led = get_ledger()
+    if led is not None:
+        led.clear()
+    fr = get_flight_recorder()
+    if fr is not None:
+        fr.clear()
 
 
 def span(name: str, **tags):
@@ -128,6 +178,7 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
     os.makedirs(out_dir, exist_ok=True)
     bridges.collect_memory(reg)
     bridges.collect_comms(reg)
+    bridges.collect_ledger(reg)
     if serving_metrics is not None:
         bridges.collect_serving(reg, serving_metrics)
     out = {
@@ -138,4 +189,29 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
         "metrics_json": reg.dump_json(
             os.path.join(out_dir, f"{prefix}.metrics.json")),
     }
+    led = get_ledger()
+    if led is not None:
+        import json as _json
+        path = os.path.join(out_dir, f"{prefix}.ledger.json")
+        with open(path, "w") as f:
+            _json.dump(led.snapshot(), f, indent=1, default=str)
+        out["ledger"] = path
     return out
+
+
+def dump_flight_record(reason: str,
+                       out_dir: Optional[str] = None) -> str:
+    """Write a hang-dump artifact NOW (flight-recorder events, open
+    spans, ledger, memory, thread stacks) — the entry external
+    watchdogs (bench's ``--total-budget-s``) route through. Returns
+    the artifact path, or '' when the flight recorder is off."""
+    dog = get_watchdog()
+    if dog is not None:
+        return dog.fire(reason)
+    rec = get_flight_recorder()
+    if rec is None:
+        return ""
+    return _flightrec_mod.dump_state(
+        reason, out_dir or _ARTIFACT_DIR, recorder=rec,
+        tracer=get_tracer(), ledger=get_ledger(),
+        registry=get_registry())

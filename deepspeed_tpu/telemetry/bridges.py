@@ -24,7 +24,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from . import registry as _registry_mod
+from . import ledger as _ledger_mod, registry as _registry_mod
 from .registry import MetricsRegistry
 
 _JAX_LISTENER_INSTALLED = False
@@ -63,6 +63,12 @@ def install_jax_compile_listener() -> None:
         if phase.endswith("_duration"):
             phase = phase[: -len("_duration")]
         _COMPILE_EVENTS[phase] = _COMPILE_EVENTS.get(phase, 0) + 1
+        # the executable ledger tracks process-wide compile time per
+        # phase (every newly compiled executable announces itself
+        # here, whether or not a call site ever observe()s it)
+        led = _ledger_mod.get_ledger()
+        if led is not None:
+            led.on_compile_event(phase, dur_s)
         reg = _registry_mod.get_registry()
         if reg is None:
             return
@@ -157,6 +163,64 @@ def collect_serving(reg: MetricsRegistry, serving_metrics: dict,
                 serving_metrics[key], engine=engine_label)
 
 
+def collect_ledger(reg: MetricsRegistry, peak_flops: float = 0.0) -> None:
+    """Executable-ledger state -> registry (ISSUE 5): per-jit-name MFU
+    from ledger FLOPs x span seconds, peak HBM per executable name,
+    HBM headroom against the device limit, and the per-(mesh axis, op)
+    HLO collective traffic counters. No-op (zero allocations) when the
+    ledger is off."""
+    led = _ledger_mod.get_ledger()
+    if led is None:
+        return
+    from . import spans as _spans_mod
+    reg.gauge("ds_ledger_executables",
+              "compiled executables registered in the cost ledger"
+              ).set(len(led))
+    peak = _ledger_mod.device_peak_flops(peak_flops)
+    tracer = _spans_mod.get_tracer()
+    if tracer is not None:
+        mfu = reg.gauge(
+            "ds_mfu", "model FLOPs utilization per instrumented jit "
+            "name: ledger FLOPs x dispatches / measured span seconds "
+            "/ device peak (steady-state: the warmup span, which "
+            "includes the XLA compile, is trimmed; still a lower "
+            "bound — span time includes host overhead around the "
+            "device work)")
+        for name, value in led.mfu_by_name(tracer.totals_trimmed(),
+                                           peak).items():
+            mfu.set(value, name=name)
+    flops_total = reg.counter(
+        "ds_ledger_dispatched_flops_total",
+        "FLOPs dispatched per jit name (executable FLOPs x calls)")
+    for name, flops in led.dispatched_flops().items():
+        flops_total.set_total(flops, name=name)
+    hbm = reg.gauge("ds_ledger_peak_hbm_bytes",
+                    "compiler-reported peak HBM per executable name "
+                    "(max over live shape signatures)")
+    max_peak = 0
+    for name, peak_bytes in led.peak_hbm_by_name().items():
+        hbm.set(peak_bytes, name=name)
+        max_peak = max(max_peak, peak_bytes)
+    from ..utils.memory import device_memory_stats
+    limit = float(device_memory_stats().get("bytes_limit", 0) or 0)
+    if limit > 0 and max_peak > 0:
+        reg.gauge("ds_hbm_headroom_bytes",
+                  "device memory limit minus the largest registered "
+                  "executable's peak HBM").set(limit - max_peak)
+    traffic = led.traffic()
+    if traffic:
+        byts = reg.counter(
+            "ds_hlo_collective_bytes_total",
+            "collective payload bytes from HLO accounting, dispatch-"
+            "weighted, attributed to mesh axes")
+        sites = reg.counter(
+            "ds_hlo_collective_sites_total",
+            "collective instruction sites in registered executables")
+        for (axis, op), row in traffic.items():
+            byts.set_total(row["bytes"], axis=axis, op=op)
+            sites.set_total(row["sites"], axis=axis, op=op)
+
+
 def collect_throughput(reg: MetricsRegistry, tput_timer) -> None:
     """``ThroughputTimer`` -> samples/s (+ TFLOPS when configured)."""
     sps = tput_timer.avg_samples_per_sec()
@@ -193,6 +257,7 @@ def record_train_step(reg: MetricsRegistry, engine, metrics) -> None:
         collect_throughput(reg, tput)
     collect_memory(reg)
     collect_comms(reg)
+    collect_ledger(reg)
 
 
 def flush_to_monitor(monitor, step: int,

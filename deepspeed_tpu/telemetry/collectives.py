@@ -1,0 +1,245 @@
+"""HLO collective accounting (ISSUE 5 tentpole part 2).
+
+XLA fuses collectives into the compiled step, so per-op wall time is
+unobservable from the host (comm/comm.py logs shapes at trace time and
+leaves timing to the profiler). What IS knowable exactly is the
+*static* collective content of each compiled executable: this module
+walks the optimized HLO text of a registered executable
+(``Compiled.as_text()``), finds every
+all-reduce/all-gather/reduce-scatter/all-to-all/collective-permute
+(sync or async ``-start`` form), decodes the payload bytes from the
+result shapes, and attributes each op to the mesh axis (or axis
+combination) whose device groups match the instruction's
+``replica_groups`` — the T3-style per-axis traffic matrix the overlap
+analysis needs.
+
+Combined with the executable ledger's per-executable dispatch counts
+and the span tracer's measured window, ``traffic_matrix()`` rows give
+honest algbw/busbw LOWER bounds per (axis, op): every dispatched byte
+moved somewhere inside the measured window.
+
+Pure host-side text analysis: never imports the model, never runs
+device code; one walk per *newly registered executable*, never per
+dispatch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from typing import Optional
+
+import numpy as np
+
+# HLO primitive -> comm-facade op name (comms_logging.get_bw formulas)
+HLO_TO_COMM_OP = {
+    "all-reduce": "all_reduce",
+    "all-gather": "all_gather",
+    "reduce-scatter": "reduce_scatter",
+    "all-to-all": "all_to_all",
+    "ragged-all-to-all": "all_to_all",
+    "collective-permute": "ppermute",
+    "collective-broadcast": "broadcast",
+}
+
+_OP_RE = re.compile(
+    r"=\s*(?P<shapes>[^=]*?)\s*"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"ragged-all-to-all|collective-permute|collective-broadcast)"
+    r"(?P<start>-start)?\(")
+_SHAPE_RE = re.compile(r"\b([a-z]+[0-9]*(?:e[0-9a-z]+)?)\[([0-9,]*)\]")
+_GROUPS_BRACE_RE = re.compile(r"replica_groups=\{(\{[0-9,{} ]*\})\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([0-9,{} ]*)\}")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "s4": 1, "u2": 1, "u4": 1,
+    "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "bf16": 2, "f16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16, "token": 0, "opaque": 0,
+}
+
+
+def _dtype_bytes(name: str) -> int:
+    if name in _DTYPE_BYTES:
+        return _DTYPE_BYTES[name]
+    if name.startswith("f8") or name.startswith("e4") \
+            or name.startswith("e5"):
+        return 1
+    return 4
+
+
+def _shapes_bytes(text: str) -> int:
+    """Total bytes of every ``dtype[dims]`` shape token in ``text``
+    (handles variadic tuple results)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _dtype_bytes(dtype)
+    return total
+
+
+def _parse_groups(line: str) -> Optional[list[list[int]]]:
+    """Device-id groups from either HLO syntax: literal
+    ``{{0,2},{1,3}}`` braces or the iota form
+    ``[groups,size]<=[dims]T(perm)``."""
+    m = _GROUPS_BRACE_RE.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([0-9, ]*)\}", m.group(1)):
+            ids = [int(x) for x in grp.replace(" ", "").split(",") if x]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            perm = [int(x) for x in m.group(4).split(",")]
+            ids = ids.transpose(perm)
+        return [r.tolist() for r in ids.reshape(n_groups, group_size)]
+    return None
+
+
+def mesh_axis_groups(mesh) -> dict[frozenset, str]:
+    """{partition-of-device-ids -> axis label} for every non-empty
+    combination of the mesh's axes (size-1 groups excluded: they move
+    no bytes). A collective whose ``replica_groups`` match one of
+    these partitions ran along that axis (combinations label as
+    ``"dp+tp"``). Best-effort: an exotic mesh yields fewer matches and
+    the caller falls back to an ``"n<group_size>"`` label."""
+    if mesh is None:
+        return {}
+    try:
+        devices = np.asarray(mesh.devices)
+        ids = np.vectorize(lambda d: int(d.id))(devices)
+        axes = list(mesh.axis_names)
+    except Exception:
+        return {}
+    table: dict[frozenset, str] = {}
+    n = ids.ndim
+    for r in range(1, n + 1):
+        for subset in itertools.combinations(range(n), r):
+            perm = ([i for i in range(n) if i not in subset]
+                    + list(subset))
+            grp = ids.transpose(perm).reshape(-1, int(np.prod(
+                [ids.shape[i] for i in subset])))
+            if grp.shape[1] <= 1:
+                continue
+            key = frozenset(frozenset(int(x) for x in row)
+                            for row in grp)
+            # r ascends, so a single axis wins over an equivalent
+            # multi-axis flattening of size-1 axes
+            table.setdefault(key, "+".join(axes[i] for i in subset))
+    return table
+
+
+def _permute_axis(pairs: list[tuple[int, int]], mesh) -> Optional[str]:
+    """Mesh axis a collective-permute rotates along: every
+    source->target pair differs in exactly that one mesh coordinate."""
+    if mesh is None:
+        return None
+    try:
+        ids = np.vectorize(lambda d: int(d.id))(np.asarray(mesh.devices))
+        axes = list(mesh.axis_names)
+        coord = {int(ids[idx]): idx for idx in np.ndindex(ids.shape)}
+        moved: set[int] = set()
+        for s, t in pairs:
+            cs, ct = coord[s], coord[t]
+            moved |= {i for i in range(len(cs)) if cs[i] != ct[i]}
+        if len(moved) == 1:
+            return axes[moved.pop()]
+    except Exception:
+        pass
+    return None
+
+
+def analyze_hlo(hlo_text: str, mesh=None,
+                n_devices: Optional[int] = None) -> list[dict]:
+    """Per-collective-instruction records
+    ``{op, hlo_op, bytes, group_size, axis, groups}`` from optimized
+    HLO text. ``bytes`` is the full logical payload per device group
+    participant (the reference comms-logging convention get_bw
+    expects: full tensor for all-reduce / gathered output for
+    all-gather / full input for reduce-scatter). Async ``-start`` ops
+    count once; their ``-done`` halves are ignored."""
+    axis_table = mesh_axis_groups(mesh)
+    records: list[dict] = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None or "-done" in line.split("=", 1)[0]:
+            continue
+        hlo_op = m.group("op")
+        out_bytes = _shapes_bytes(m.group("shapes"))
+        groups = _parse_groups(line)
+        axis = None
+        if hlo_op == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            pairs = []
+            if pm:
+                pairs = [tuple(int(x) for x in p.replace(" ", "")
+                               .split(","))
+                         for p in re.findall(r"\{([0-9, ]+)\}",
+                                             pm.group(1))]
+            group_size = len({d for p in pairs for d in p}) or 2
+            axis = _permute_axis(pairs, mesh)
+        else:
+            if groups:
+                group_size = max(len(g) for g in groups)
+                key = frozenset(frozenset(g) for g in groups
+                                if len(g) > 1)
+                axis = axis_table.get(key)
+            else:
+                group_size = n_devices or (
+                    int(np.asarray(mesh.devices).size)
+                    if mesh is not None else 0)
+                axis = "world" if group_size else None
+        if group_size <= 1:
+            continue        # degenerate single-participant group
+        payload = out_bytes
+        if hlo_op == "reduce-scatter":
+            payload = out_bytes * group_size
+        records.append({
+            "op": HLO_TO_COMM_OP[hlo_op],
+            "hlo_op": hlo_op + ("-start" if m.group("start") else ""),
+            "bytes": int(payload),
+            "group_size": int(group_size),
+            "axis": axis or f"n{group_size}",
+            "groups": len(groups) if groups else 1,
+        })
+    return records
+
+
+def traffic_matrix(records: list[dict], calls: int = 1) -> dict:
+    """Aggregate per-instruction records into the per-(axis, op)
+    traffic matrix: ``{(axis, op): {bytes, sites, group_size}}`` where
+    ``bytes`` is per-execution payload x ``calls`` dispatches."""
+    out: dict = {}
+    for r in records:
+        key = (r["axis"], r["op"])
+        row = out.setdefault(key, {"bytes": 0, "sites": 0,
+                                   "group_size": r["group_size"]})
+        row["bytes"] += r["bytes"] * calls
+        row["sites"] += 1
+        row["group_size"] = max(row["group_size"], r["group_size"])
+    return out
+
+
+def merge_traffic(*matrices: dict) -> dict:
+    """Fold several per-executable traffic matrices into one."""
+    out: dict = {}
+    for mat in matrices:
+        for key, row in mat.items():
+            dst = out.setdefault(key, {"bytes": 0, "sites": 0,
+                                       "group_size": row["group_size"]})
+            dst["bytes"] += row["bytes"]
+            dst["sites"] += row["sites"]
+            dst["group_size"] = max(dst["group_size"],
+                                    row["group_size"])
+    return out

@@ -1,0 +1,312 @@
+"""Flight recorder + hang/straggler watchdog (ISSUE 5 tentpole part 3).
+
+BENCH runs have died rc=124 with zero forensics: a wedged XLA compile
+or a stuck collective leaves nothing behind but the kill. This module
+keeps a lock-free per-rank ring buffer of the last N dispatch /
+collective / progress events (``FlightRecorder``) and a watchdog
+thread (``HangWatchdog``) that — when the instrumented loops
+(``engine.train_batch``, the fused-decode drain) stop reporting
+progress past a configurable deadline — dumps everything a post-mortem
+needs into an artifact directory: flight-recorder events, the span
+tracer's OPEN spans (what the host was inside when it stalled), the
+executable ledger, device/host memory, and every thread's Python
+stack. Optionally aborts the process afterwards so an external
+supervisor restarts it instead of waiting out a harness SIGKILL.
+
+Multiprocess straggler accounting rides the same machinery:
+``record_straggler_skew`` host-all-reduces a per-step timestamp and
+exposes max-min as ``ds_straggler_skew_seconds``.
+
+Lock-free claim: ``record()`` takes a slot from ``itertools.count``
+(atomic under the GIL) and writes one list cell — no lock anywhere on
+the hot path, so the recorder can never deadlock-or-slow the loop it
+is black-boxing. Host-only API (graftlint GL041): never call from
+jit-reachable code.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+class FlightRecorder:
+    """Bounded ring of recent events plus per-key progress heartbeats.
+
+    An *event* is ``(unix_time, slot, kind, name, meta)``; *progress*
+    is a monotonic heartbeat the watchdog compares against its
+    deadline (and also lands in the ring, so the dump shows the last
+    thing that DID advance)."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = max(int(capacity), 8)
+        self._buf: list = [None] * self.capacity
+        self._slot = itertools.count()
+        # key -> monotonic stamp of the key's latest progress report
+        self._progress: dict[str, float] = {}
+
+    # -- hot path (lock-free) -----------------------------------------
+    def record(self, kind: str, name: str, **meta) -> None:
+        slot = next(self._slot)
+        self._buf[slot % self.capacity] = (
+            time.time(), slot, kind, name, meta or None)
+
+    def progress(self, key: str, **meta) -> None:
+        self._progress[key] = time.monotonic()
+        self.record("progress", key, **meta)
+
+    # -- readers -------------------------------------------------------
+    @property
+    def recorded(self) -> int:
+        return self._peek_slot()
+
+    def _peek_slot(self) -> int:
+        # count() holds the NEXT slot; __reduce__ -> (count, (n,))
+        # peeks it without consuming
+        return self._slot.__reduce__()[1][0]
+
+    def last_progress(self) -> dict[str, float]:
+        return dict(self._progress)
+
+    def stalled_for(self) -> Optional[float]:
+        """Seconds since the most recent progress report from ANY key;
+        None until something has reported once (never armed before the
+        loops start)."""
+        if not self._progress:
+            return None
+        return time.monotonic() - max(self._progress.values())
+
+    def events(self) -> list[dict]:
+        rows = [e for e in list(self._buf) if e is not None]
+        rows.sort(key=lambda e: e[1])
+        return [{"unix_time": t, "slot": s, "kind": k, "name": n,
+                 **({"meta": m} if m else {})}
+                for t, s, k, n, m in rows]
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        return {"capacity": self.capacity,
+                "recorded": self._peek_slot(),
+                "progress_age_s": {k: round(now - v, 4)
+                                   for k, v in self._progress.items()},
+                "events": self.events()}
+
+    def clear(self) -> None:
+        self._buf = [None] * self.capacity
+        self._slot = itertools.count()
+        self._progress.clear()
+
+
+# --- straggler skew ------------------------------------------------------
+
+def skew_from_timestamps(timestamps) -> float:
+    """Per-step straggler skew: spread (max - min) of the ranks' step
+    timestamps. Pure so the multiprocess gauge is unit-testable with
+    fake clocks."""
+    ts = [float(t) for t in timestamps]
+    if len(ts) < 2:
+        return 0.0
+    return max(ts) - min(ts)
+
+
+def record_straggler_skew(reg, step: int, now: Optional[float] = None,
+                          reduce_fn=None) -> float:
+    """Host-all-reduce this rank's step timestamp and expose the
+    cross-rank spread as ``ds_straggler_skew_seconds``. Costs two tiny
+    host collectives — call at flush boundaries only. Returns the skew
+    (0.0 single-process, where no collective runs)."""
+    if reduce_fn is None:
+        from .. import comm as dist
+        reduce_fn = dist.host_all_reduce
+    t = time.time() if now is None else now
+    from ..comm.comm import ReduceOp
+    lo = float(reduce_fn(t, ReduceOp.MIN))
+    hi = float(reduce_fn(t, ReduceOp.MAX))
+    skew = max(hi - lo, 0.0)
+    if reg is not None:
+        reg.gauge("ds_straggler_skew_seconds",
+                  "cross-rank spread of the latest step timestamp "
+                  "(max - min over processes)").set(skew)
+        reg.gauge("ds_straggler_last_step",
+                  "step the skew gauge was sampled at").set(step)
+    return skew
+
+
+# --- hang dump -----------------------------------------------------------
+
+def _thread_stacks() -> dict:
+    import sys
+    import traceback
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        out[label] = traceback.format_stack(frame)
+    return out
+
+
+def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
+               ledger=None, registry=None) -> str:
+    """Write one self-contained hang-dump JSON artifact and return its
+    path. Safe to call from any thread (the watchdog's, bench's
+    budget watchdog, a signal handler's deferred path); never raises —
+    forensics must not mask the original failure."""
+    doc: dict = {"reason": reason, "unix_time": time.time(),
+                 "pid": os.getpid()}
+    try:
+        doc["thread_stacks"] = _thread_stacks()
+    except Exception as e:   # noqa: BLE001
+        doc["thread_stacks_error"] = repr(e)
+    try:
+        if recorder is not None:
+            doc["flight_recorder"] = recorder.snapshot()
+    except Exception as e:   # noqa: BLE001
+        doc["flight_recorder_error"] = repr(e)
+    try:
+        if tracer is not None:
+            doc["open_spans"] = tracer.open_spans()
+            doc["span_totals"] = {
+                name: {"seconds": sec, "count": cnt}
+                for name, (sec, cnt) in tracer.totals().items()}
+    except Exception as e:   # noqa: BLE001
+        doc["open_spans_error"] = repr(e)
+    try:
+        if ledger is not None:
+            doc["ledger"] = ledger.snapshot()
+    except Exception as e:   # noqa: BLE001
+        doc["ledger_error"] = repr(e)
+    try:
+        if registry is not None:
+            doc["metrics"] = registry.snapshot()
+    except Exception as e:   # noqa: BLE001
+        doc["metrics_error"] = repr(e)
+    try:
+        with open("/proc/self/status") as f:
+            doc["host_memory"] = {
+                k: v.strip() for k, v in
+                (line.split(":", 1) for line in f
+                 if line.startswith(("VmRSS", "VmHWM")))}
+    except Exception:
+        pass
+    try:
+        # device stats LAST: on a truly wedged runtime the PJRT query
+        # itself may block, and everything above is already on disk
+        # semantics-wise (the dict is complete before the write below)
+        from ..utils.memory import device_memory_stats
+        doc["device_memory"] = device_memory_stats()
+    except Exception as e:   # noqa: BLE001
+        doc["device_memory_error"] = repr(e)
+    try:
+        import jax
+        doc["rank"] = jax.process_index()
+    except Exception:
+        doc["rank"] = 0
+    path = os.path.join(
+        out_dir, f"hangdump_r{doc['rank']}_{int(doc['unix_time'])}_"
+                 f"{os.getpid()}.json")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, default=str)
+    except Exception:   # noqa: BLE001
+        return ""
+    return path
+
+
+class HangWatchdog:
+    """Daemon thread that dumps forensics when the instrumented loops
+    stall. Arms only after the FIRST progress report (so import-time /
+    warmup compiles can take as long as they take), fires once per
+    stall (re-arms when progress resumes), and optionally SIGABRTs the
+    process after the dump so a supervisor restarts instead of an
+    external timeout SIGKILLing without artifacts."""
+
+    def __init__(self, recorder: FlightRecorder, deadline_s: float,
+                 artifact_dir: str, poll_s: Optional[float] = None,
+                 abort: bool = False):
+        self.recorder = recorder
+        self.deadline_s = float(deadline_s)
+        self.artifact_dir = artifact_dir
+        self.poll_s = poll_s if poll_s else max(
+            min(self.deadline_s / 4.0, 5.0), 0.05)
+        self.abort = bool(abort)
+        self.dumps: list[str] = []
+        self._stop = threading.Event()
+        self._fired_at: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="telemetry-hang-watchdog")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            stalled = self.recorder.stalled_for()
+            if stalled is None or stalled <= self.deadline_s:
+                self._fired_at = None
+                continue
+            last = max(self.recorder.last_progress().values())
+            if self._fired_at == last:
+                continue       # already dumped THIS stall
+            self._fired_at = last
+            self.fire(f"no progress for {stalled:.1f}s "
+                      f"(deadline {self.deadline_s:.1f}s)")
+            if self.abort:
+                import signal
+                os.kill(os.getpid(), signal.SIGABRT)
+
+    def fire(self, reason: str) -> str:
+        """Dump now, regardless of stall state (bench's total-budget
+        watchdog routes through here)."""
+        from . import get_ledger, get_registry, get_tracer
+        path = dump_state(reason, self.artifact_dir,
+                          recorder=self.recorder, tracer=get_tracer(),
+                          ledger=get_ledger(), registry=get_registry())
+        if path:
+            self.dumps.append(path)
+            from ..utils.logging import logger
+            logger.error(
+                f"telemetry hang watchdog: {reason}; forensics dumped "
+                f"to {path}")
+        return path
+
+
+# --- module-level current recorder/watchdog (wired by configure) ---------
+
+_RECORDER: Optional[FlightRecorder] = None
+_WATCHDOG: Optional[HangWatchdog] = None
+
+
+def get_flight_recorder() -> Optional[FlightRecorder]:
+    return _RECORDER
+
+
+def set_flight_recorder(rec: Optional[FlightRecorder]) -> None:
+    global _RECORDER
+    _RECORDER = rec
+
+
+def get_watchdog() -> Optional[HangWatchdog]:
+    return _WATCHDOG
+
+
+def set_watchdog(dog: Optional[HangWatchdog]) -> None:
+    global _WATCHDOG
+    if _WATCHDOG is not None and dog is not _WATCHDOG:
+        _WATCHDOG.stop()
+    _WATCHDOG = dog

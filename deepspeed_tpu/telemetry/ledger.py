@@ -1,0 +1,273 @@
+"""Executable cost/memory ledger (ISSUE 5 tentpole part 1).
+
+Host-side telemetry (PR 2) can time dispatches but knows nothing about
+what a compiled step *costs*: FLOPs, HBM traffic, peak device memory.
+XLA does — ``Compiled.cost_analysis()`` / ``memory_analysis()`` carry
+the compiler's own accounting of the fused, optimized program. The
+ledger keeps one entry per ``(jit name, abstract operand signature)``:
+call sites hand it the jitted callable plus the operands of a dispatch
+(``observe()``), and on FIRST sight of a signature it compiles the same
+AOT path the flops profiler uses (``profiler.lower_compiled`` — cached
+by jax per signature, so this costs ONE extra backend compile per new
+executable during warmup and a dict lookup afterwards), records the
+normalized cost/memory analysis, and — when a mesh is given — walks
+the optimized HLO for the collective traffic matrix
+(:mod:`.collectives`).
+
+Ledger entry names deliberately match the span names of the same call
+sites (``compiled_step``, ``v2/dispatch``, ``v2/fused_dispatch``):
+``mfu_by_name()`` joins dispatched FLOPs against the span tracer's
+measured seconds to produce live MFU — a lower bound, since the span
+window includes host time around the device work.
+
+Everything here is host-only API (graftlint GL041): nothing may be
+called from jit-reachable code.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from . import collectives as _collectives
+
+
+def _signature(args, kwargs) -> tuple:
+    """Abstract (shape, dtype) tuple over the flattened operands —
+    the executable-cache key modulo sharding. Works on donated/deleted
+    arrays (avals survive donation) and plain numpy/python leaves."""
+    import jax
+    sig = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs or {})):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            sig.append((type(leaf).__name__,))
+        else:
+            sig.append((tuple(int(d) for d in shape),
+                        str(getattr(leaf, "dtype", "?"))))
+    return tuple(sig)
+
+
+class ExecutableEntry:
+    """Ledger row for one compiled executable."""
+
+    __slots__ = ("name", "signature", "flops", "bytes_accessed",
+                 "memory", "collectives", "traffic", "calls",
+                 "registered_unix", "register_error")
+
+    def __init__(self, name: str, signature: tuple):
+        self.name = name
+        self.signature = signature
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.memory: dict = {}
+        self.collectives: list[dict] = []
+        self.traffic: dict = {}
+        self.calls = 0
+        self.registered_unix = time.time()
+        self.register_error = ""
+
+    @property
+    def peak_hbm_bytes(self) -> int:
+        return int(self.memory.get("peak", 0))
+
+    def signature_str(self) -> str:
+        parts = []
+        for leaf in self.signature:
+            if len(leaf) == 2:
+                shape, dtype = leaf
+                parts.append(dtype + "[" + ",".join(map(str, shape))
+                             + "]")
+            else:
+                parts.append(str(leaf[0]))
+        return " ".join(parts)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "signature": self.signature_str(),
+            "n_operands": len(self.signature),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "arithmetic_intensity": (
+                self.flops / self.bytes_accessed
+                if self.bytes_accessed else 0.0),
+            "memory": dict(self.memory),
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "calls": self.calls,
+            "collectives": list(self.collectives),
+            "register_error": self.register_error,
+        }
+
+
+class ExecutableLedger:
+    """Process-wide registry of compiled executables' device-truth
+    cost. Thread-safe; ``observe()`` is cheap after first registration
+    (signature hash + dict lookup) and NEVER raises — a broken cost
+    model must not take down the training step it measures."""
+
+    def __init__(self, hlo_collectives: bool = True):
+        self.hlo_collectives = bool(hlo_collectives)
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, ExecutableEntry] = {}
+        # compile-path seconds by phase, fed by the jax.monitoring
+        # listener in bridges.py (covers EVERY compile in the process,
+        # including ones the ledger never sees an observe() for)
+        self.compile_seconds: dict[str, float] = {}
+        self.compile_events: dict[str, int] = {}
+
+    # -- registration --------------------------------------------------
+    def observe(self, name: str, jitted, args: tuple = (),
+                kwargs: Optional[dict] = None, mesh=None,
+                n_devices: Optional[int] = None) -> \
+            Optional[ExecutableEntry]:
+        """Count one dispatch of ``jitted`` at these operands,
+        registering cost/memory/collective analysis on first sight of
+        the (name, signature) pair. Call BEFORE the dispatch when any
+        operand is donated. Returns the entry (None only if even the
+        signature walk failed)."""
+        try:
+            key = (name, _signature(args, kwargs))
+        except Exception:
+            return None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.calls += 1
+                return entry
+            entry = self._entries[key] = ExecutableEntry(name, key[1])
+            entry.calls = 1
+        self._register(entry, jitted, args, kwargs or {}, mesh,
+                       n_devices)
+        return entry
+
+    def _register(self, entry: ExecutableEntry, jitted, args, kwargs,
+                  mesh, n_devices) -> None:
+        from ..profiling.flops_profiler.profiler import (
+            compiled_cost, compiled_memory, lower_compiled)
+        try:
+            compiled = lower_compiled(jitted, *args, **kwargs)
+        except Exception as e:   # noqa: BLE001 - telemetry never raises
+            entry.register_error = f"{type(e).__name__}: {e}"[:200]
+            return
+        cost = compiled_cost(compiled)
+        entry.flops = cost.get("flops", 0.0)
+        entry.bytes_accessed = cost.get("bytes accessed", 0.0)
+        entry.memory = compiled_memory(compiled)
+        if self.hlo_collectives:
+            try:
+                entry.collectives = _collectives.analyze_hlo(
+                    compiled.as_text(), mesh=mesh, n_devices=n_devices)
+                entry.traffic = _collectives.traffic_matrix(
+                    entry.collectives)
+            except Exception as e:   # noqa: BLE001
+                entry.register_error = (
+                    f"hlo: {type(e).__name__}: {e}"[:200])
+
+    def on_compile_event(self, phase: str, dur_s: float) -> None:
+        with self._lock:
+            self.compile_seconds[phase] = (
+                self.compile_seconds.get(phase, 0.0) + dur_s)
+            self.compile_events[phase] = (
+                self.compile_events.get(phase, 0) + 1)
+
+    # -- readers -------------------------------------------------------
+    def entries(self) -> list[ExecutableEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def dispatched_flops(self) -> dict[str, float]:
+        """{name: flops x calls summed over signatures}."""
+        out: dict[str, float] = {}
+        for e in self.entries():
+            out[e.name] = out.get(e.name, 0.0) + e.flops * e.calls
+        return out
+
+    def peak_hbm_by_name(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.entries():
+            out[e.name] = max(out.get(e.name, 0), e.peak_hbm_bytes)
+        return out
+
+    def calls_by_name(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for e in self.entries():
+            out[e.name] = out.get(e.name, 0) + e.calls
+        return out
+
+    def traffic(self) -> dict:
+        """Dispatch-weighted per-(axis, op) traffic matrix over every
+        registered executable: static bytes per execution x calls."""
+        return _collectives.merge_traffic(
+            *(_collectives.traffic_matrix(e.collectives, e.calls)
+              for e in self.entries()))
+
+    def mfu_by_name(self, span_totals: dict, peak_flops: float) -> dict:
+        """{name: MFU} joining per-dispatch FLOPs against measured
+        span seconds: ``avg_flops_per_call x span_count / span_seconds
+        / peak``. ``span_totals`` is ``SpanTracer.totals()`` — or
+        ``totals_trimmed()`` for steady-state MFU that excludes the
+        warmup span (whose duration includes the XLA compile). Names
+        absent from the span totals (or zero-duration) are skipped;
+        result values are finite by construction."""
+        if peak_flops <= 0:
+            return {}
+        calls = self.calls_by_name()
+        out = {}
+        for name, flops in self.dispatched_flops().items():
+            tot = span_totals.get(name)
+            if not tot or tot[0] <= 0 or flops <= 0:
+                continue
+            avg = flops / max(calls.get(name, 1), 1)
+            out[name] = avg * tot[1] / tot[0] / peak_flops
+        return out
+
+    def snapshot(self) -> dict:
+        rows = sorted((e.to_dict() for e in self.entries()),
+                      key=lambda r: (-r["flops"] * r["calls"],
+                                     r["name"]))
+        traffic = {f"{axis}/{op}": dict(row) for (axis, op), row
+                   in sorted(self.traffic().items())}
+        return {"executables": rows,
+                "n_executables": len(rows),
+                "traffic": traffic,
+                "compile_seconds": dict(self.compile_seconds),
+                "compile_events": dict(self.compile_events)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.compile_seconds.clear()
+            self.compile_events.clear()
+
+
+# --- module-level current ledger (wired by telemetry.configure) ---------
+
+_LEDGER: Optional[ExecutableLedger] = None
+
+
+def get_ledger() -> Optional[ExecutableLedger]:
+    return _LEDGER
+
+
+def set_ledger(ledger: Optional[ExecutableLedger]) -> None:
+    global _LEDGER
+    _LEDGER = ledger
+
+
+def device_peak_flops(configured: float = 0.0) -> float:
+    """Per-device peak FLOPs for MFU accounting: the configured value
+    when nonzero, else the accelerator table (1e12 CPU floor — an
+    arbitrary but finite denominator, clearly an estimate on hosts
+    with no published peak)."""
+    if configured and configured > 0:
+        return float(configured)
+    try:
+        from ..accelerator import get_accelerator
+        return float(get_accelerator().peak_flops())
+    except Exception:
+        return 1e12

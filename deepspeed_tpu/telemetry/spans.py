@@ -84,12 +84,19 @@ class _SpanContext:
             self._ann = jax.profiler.TraceAnnotation(self._name)
             self._ann.__enter__()
         self._t0 = time.perf_counter_ns()
+        # open-span stack for the hang watchdog's dump: each thread
+        # appends/pops only its own list, so no lock is needed
+        self._tracer._open.setdefault(
+            threading.get_ident(), []).append((self._name, self._t0))
         return self
 
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter_ns()
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
+        stack = self._tracer._open.get(threading.get_ident())
+        if stack:
+            stack.pop()
         self._tracer._tls.depth = self._depth
         self._tracer._record(
             self._name,
@@ -116,10 +123,17 @@ class SpanTracer:
         self.epoch_unix = time.time()
         self._buf: deque[Span] = deque(maxlen=self.capacity)
         self._tls = threading.local()
+        # thread ident -> stack of (name, t0_ns) for spans currently
+        # ENTERED but not exited — what a hang dump reports the host
+        # was inside when the loop stalled
+        self._open: dict[int, list] = {}
         self._lock = threading.Lock()
         # name -> [total_seconds, count]; never evicted (bounded by the
         # number of distinct span names, not the number of events)
         self._totals: dict[str, list] = {}
+        # name -> max single-span seconds (survives eviction); lets
+        # steady-state consumers (MFU) trim the warmup outlier
+        self._maxes: dict[str, float] = {}
         # drain marks: consumer key -> {name: [seconds, count]} snapshot
         self._marks: dict[str, dict[str, tuple]] = {}
         # depth-0 seconds only (survives ring eviction); kept separate
@@ -154,6 +168,8 @@ class SpanTracer:
             tot = self._totals.setdefault(name, [0.0, 0])
             tot[0] += dur_us / 1e6
             tot[1] += 1
+            if dur_us / 1e6 > self._maxes.get(name, 0.0):
+                self._maxes[name] = dur_us / 1e6
             if depth == 0:
                 self._depth0_seconds += dur_us / 1e6
             self.recorded += 1
@@ -167,6 +183,23 @@ class SpanTracer:
         """Cumulative {name: (seconds, count)} since construction/clear."""
         with self._lock:
             return {k: (v[0], v[1]) for k, v in self._totals.items()}
+
+    def totals_trimmed(self) -> dict[str, tuple[float, int]]:
+        """Cumulative {name: (seconds, count)} with each name's single
+        LONGEST span removed when it has more than one — steady-state
+        accounting that excludes the warmup occurrence (whose duration
+        includes trace + XLA compile). Names with one span pass
+        through untrimmed."""
+        with self._lock:
+            out = {}
+            for name, (sec, cnt) in ((k, (v[0], v[1]))
+                                     for k, v in self._totals.items()):
+                if cnt > 1:
+                    out[name] = (sec - self._maxes.get(name, 0.0),
+                                 cnt - 1)
+                else:
+                    out[name] = (sec, cnt)
+            return out
 
     def drain_totals(self, consumer: str = "default") \
             -> dict[str, tuple[float, int]]:
@@ -186,6 +219,22 @@ class SpanTracer:
                                      for k, v in self._totals.items()}
             return out
 
+    def open_spans(self) -> list[dict]:
+        """Spans currently entered and not yet exited, innermost last
+        per thread — the hang watchdog's 'where was the host stuck'
+        view. Reads other threads' stacks without a lock (each entry
+        is an immutable tuple; a torn read worst-case misses one
+        in-flight span)."""
+        now = time.perf_counter_ns()
+        out = []
+        for tid, stack in list(self._open.items()):
+            for depth, item in enumerate(list(stack)):
+                name, t0 = item
+                out.append({"tid": tid & 0xFFFFFFFF, "name": name,
+                            "depth": depth,
+                            "elapsed_s": (now - t0) / 1e9})
+        return out
+
     def window_seconds(self) -> float:
         """Total measured wall time of top-level (depth-0) spans. The
         comms logger uses this as the measured window over which
@@ -199,7 +248,9 @@ class SpanTracer:
     def clear(self) -> None:
         with self._lock:
             self._buf.clear()
+            self._open.clear()
             self._totals.clear()
+            self._maxes.clear()
             self._marks.clear()
             self._depth0_seconds = 0.0
             self.recorded = 0

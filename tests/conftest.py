@@ -129,6 +129,10 @@ _SLOW = {
     # fresh-interpreter subprocess (two small compiles); the in-process
     # disabled-mode test covers the same hot paths in the default tier
     ("test_telemetry.py", "test_disabled_guard_no_import_no_state"),
+    # device-truth ledger (ISSUE 5): the train_batch acceptance test +
+    # the psum-based axis-attribution unit test stay tier-1; this v2
+    # engine-build variant covers the same observe path
+    ("test_device_truth.py", "test_fused_decode_ledger_entries"),
     # sentinel variants with tier-1 siblings: the compile-once + guard
     # acceptance tests stay tier-1; these cover declared-shape-change /
     # stochastic-parity wrinkles on extra engine builds

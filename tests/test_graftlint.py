@@ -235,6 +235,27 @@ FIXTURES = {
             return tel.get_registry() if tel is not None else None
         """,
     ),
+    "GL041": (
+        """
+        import jax, jax.numpy as jnp
+        def step(x, fr):
+            fr.record("dispatch", "step")
+            return jnp.sum(x)
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            return jnp.sum(x)
+        step_j = jax.jit(step)
+        def drive(tel, batches):
+            fr = tel.get_flight_recorder()
+            for b in batches:
+                if fr is not None:
+                    fr.progress("train_batch")
+                step_j(b)
+        """,
+    ),
 }
 
 
@@ -263,6 +284,21 @@ def test_rule_quiet_on_negative_fixture(tmp_path, rule_id):
     res = _lint_src(tmp_path, neg, name=name)
     hits = [f for f in res.findings if f.rule == rule_id]
     assert not hits, f"{rule_id} false-positive: {hits}"
+
+
+def test_gl041_getter_in_jit_fires(tmp_path):
+    """The handle getters themselves are host-only API: even without a
+    record call, fetching the ledger/flight recorder inside
+    jit-reachable code is flagged."""
+    src = """
+        import jax, jax.numpy as jnp
+        def step(x, tel):
+            led = tel.get_ledger()
+            return jnp.sum(x)
+        step_j = jax.jit(step)
+    """
+    res = _lint_src(tmp_path, src)
+    assert any(f.rule == "GL041" for f in res.findings)
 
 
 def test_gl040_probe_and_package_are_exempt(tmp_path):

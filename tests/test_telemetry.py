@@ -466,6 +466,20 @@ def test_disabled_mode_zero_events_and_no_hot_path_errors(devices8):
     engine.step()
     assert telemetry.get_tracer() is None
     assert telemetry.get_registry() is None
+    # device-truth layer (ISSUE 5) obeys the same contract: no
+    # ledger/flight-recorder/watchdog state on the disabled path
+    assert telemetry.get_ledger() is None
+    assert telemetry.get_flight_recorder() is None
+    assert telemetry.get_watchdog() is None
+
+
+def test_device_truth_opt_in_defaults_off():
+    """Enabling base telemetry must NOT allocate the ISSUE 5 layer:
+    ledger, flight recorder, and watchdog are separate opt-ins."""
+    telemetry.configure()
+    assert telemetry.get_ledger() is None
+    assert telemetry.get_flight_recorder() is None
+    assert telemetry.get_watchdog() is None
 
 
 def test_disabled_guard_no_import_no_state():

@@ -1,8 +1,21 @@
-"""Summarize a telemetry dump (ISSUE 2): span trace + metrics.
+"""Summarize a telemetry dump (ISSUE 2 + 5): span trace + metrics +
+executable ledger, multi-rank trace merging, and snapshot diffing.
 
 Usage::
 
-    python tools/telemetry_report.py TRACE.trace.json [METRICS.prom | METRICS.metrics.json] [--json]
+    # per-run report
+    python tools/telemetry_report.py TRACE.trace.json \
+        [METRICS.prom | METRICS.metrics.json] [--ledger LEDGER.json] \
+        [--json]
+
+    # merge per-rank Chrome traces into one Perfetto timeline with
+    # rank-labelled tracks (eyeball straggler skew)
+    python tools/telemetry_report.py --merge OUT.trace.json \
+        r0.trace.json r1.trace.json ...
+
+    # metric-snapshot regression diff (exit 1 on regression)
+    python tools/telemetry_report.py --diff A.json B.json \
+        [--threshold 0.05]
 
 Reads the Chrome-trace JSON written by
 ``telemetry.export_artifacts()`` (or any Chrome-trace file with ``X``
@@ -10,10 +23,18 @@ events) and prints a per-span-name table — count, total/mean/max ms,
 share of top-level wall time — plus, when a metrics file is given, the
 scalar metric values (Prometheus text or the registry's JSON snapshot)
 and a serving summary rolling up the ``ds_serving_*`` series,
-prefix-cache hit/miss/eviction counters included.
+prefix-cache hit/miss/eviction counters included. ``--ledger`` adds
+the per-executable device-truth table (FLOPs, HBM, collectives).
 
 ``--json`` emits one machine-readable JSON object instead of tables
 (the smoke path CI exercises).
+
+``--diff`` flattens ANY two JSON files to numeric leaves (registry
+``.metrics.json`` snapshots and ``BENCH_r*.json`` records both work),
+prints per-metric deltas, and exits 1 when a metric regressed past
+``--threshold`` (relative). Direction is inferred from the metric
+name: throughput-like series regress downward, latency-like series
+regress upward; unrecognized series are reported but never gate.
 """
 
 from __future__ import annotations
@@ -105,7 +126,8 @@ def serving_summary(metrics: dict) -> dict:
     return out
 
 
-def build_report(trace_path: str, metrics_path: str | None) -> dict:
+def build_report(trace_path: str, metrics_path: str | None,
+                 ledger_path: str | None = None) -> dict:
     events = load_trace(trace_path)
     rows = span_table(events)
     report = {
@@ -120,6 +142,9 @@ def build_report(trace_path: str, metrics_path: str | None) -> dict:
         else:
             report["metrics"] = parse_prometheus(metrics_path)
         report["serving"] = serving_summary(report["metrics"])
+    if ledger_path:
+        with open(ledger_path) as f:
+            report["ledger"] = json.load(f)
     return report
 
 
@@ -149,20 +174,223 @@ def print_report(report: dict) -> None:
             v = serving[series]
             sval = f"{v:.6g}" if isinstance(v, float) else str(v)
             print(f"{series[:63]:<64}{sval:>14}")
+    ledger = report.get("ledger")
+    if ledger:
+        print()
+        print(f"executable ledger ({ledger.get('n_executables', 0)} "
+              "executables; compiler cost/memory ground truth):")
+        print(f"{'name':<22}{'calls':>7}{'GFLOP':>10}{'GB acc':>9}"
+              f"{'peak HBM':>12}{'collectives':>12}  signature")
+        for row in ledger.get("executables", []):
+            print(f"{row['name'][:21]:<22}{row['calls']:>7}"
+                  f"{row['flops'] / 1e9:>10.3f}"
+                  f"{row['bytes_accessed'] / 1e9:>9.3f}"
+                  f"{row['peak_hbm_bytes']:>12}"
+                  f"{len(row.get('collectives', [])):>12}  "
+                  f"{row['signature'][:40]}")
+        traffic = ledger.get("traffic", {})
+        if traffic:
+            print("collective traffic (dispatch-weighted, per mesh "
+                  "axis):")
+            print(f"{'axis/op':<30}{'sites':>7}{'bytes':>16}")
+            for key in sorted(traffic):
+                row = traffic[key]
+                print(f"{key[:29]:<30}{row['sites']:>7}"
+                      f"{row['bytes']:>16}")
+
+
+# ---------------------------------------------------------------------
+# --merge: per-rank Chrome traces -> one Perfetto timeline
+# ---------------------------------------------------------------------
+
+def merge_traces(out_path: str, inputs: list[str]) -> dict:
+    """Merge several per-rank Chrome-trace files into one document
+    with rank-labelled process tracks. Each input keeps its own pid
+    (re-assigned to its position when inputs collide on pid 0 — the
+    common single-process-per-rank case), so Perfetto renders one
+    swimlane group per rank and straggler skew is visible at a
+    glance."""
+    events: list[dict] = []
+    seen_pids: set[int] = set()
+    meta: dict = {"merged_from": []}
+    for rank, path in enumerate(inputs):
+        with open(path) as f:
+            doc = json.load(f)
+        in_events = (doc.get("traceEvents", [])
+                     if isinstance(doc, dict) else doc)
+        pids = {e.get("pid", 0) for e in in_events}
+        remap = {}
+        for pid in sorted(pids):
+            new = pid if pid not in seen_pids else rank * 10000 + pid
+            while new in seen_pids:
+                new += 1
+            remap[pid] = new
+            seen_pids.add(new)
+        label_done = set()
+        for e in in_events:
+            e = dict(e)
+            pid = remap.get(e.get("pid", 0), e.get("pid", 0))
+            e["pid"] = pid
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                # one rank-qualified label per merged process track
+                e = {**e, "args": {"name": f"rank {rank}: "
+                     f"{(e.get('args') or {}).get('name', '')}"}}
+                label_done.add(pid)
+            events.append(e)
+        for pid in remap.values():
+            if pid not in label_done:
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "tid": 0,
+                               "args": {"name": f"rank {rank}"}})
+        meta["merged_from"].append({"rank": rank, "path": path,
+                                    "events": len(in_events)})
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "otherData": meta}
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+# ---------------------------------------------------------------------
+# --diff: metric-snapshot regression gate
+# ---------------------------------------------------------------------
+
+# substrings deciding a metric's good direction for the gate. Checked
+# lower-is-better FIRST: latency suffixes are more specific than the
+# throughput stems (e.g. ..._tokens_per_sec vs ..._ttft_seconds_mean).
+_LOWER_IS_BETTER = ("_seconds", "_ms", "latency", "ttft", "itl",
+                    "skew", "dispatches_per_token", "_time")
+_HIGHER_IS_BETTER = ("tokens_per_sec", "samples_per_second", "mfu",
+                     "tflops", "hit_rate", "occupancy", "throughput",
+                     "headroom", "/value")
+
+
+def _flatten_numeric(obj, prefix="") -> dict[str, float]:
+    """Any JSON document -> {path: number} over numeric leaves (bool
+    excluded). Registry snapshots, bench records, plain dicts all
+    flatten the same way."""
+    out: dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten_numeric(v, f"{prefix}/{k}" if prefix
+                                        else str(k)))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(_flatten_numeric(v, f"{prefix}[{i}]"))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix] = float(obj)
+    return out
+
+
+def _direction(name: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 report-only."""
+    low = name.lower()
+    for stem in _LOWER_IS_BETTER:
+        if stem in low:
+            return -1
+    for stem in _HIGHER_IS_BETTER:
+        if stem in low:
+            return +1
+    return 0
+
+
+def diff_snapshots(path_a: str, path_b: str,
+                   threshold: float = 0.05) -> dict:
+    """Compare two metric snapshots (A = baseline, B = candidate).
+    Returns {rows, regressions, added, removed}; a row regresses when
+    its direction-aware relative change exceeds ``threshold``."""
+    with open(path_a) as f:
+        a = _flatten_numeric(json.load(f))
+    with open(path_b) as f:
+        b = _flatten_numeric(json.load(f))
+    rows, regressions = [], []
+    for name in sorted(set(a) & set(b)):
+        va, vb = a[name], b[name]
+        rel = (vb - va) / abs(va) if va else (0.0 if vb == va
+                                             else float("inf"))
+        direction = _direction(name)
+        regressed = bool(
+            direction == +1 and rel < -threshold
+            or direction == -1 and rel > threshold)
+        row = {"metric": name, "a": va, "b": vb, "rel": rel,
+               "direction": direction, "regressed": regressed}
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+    return {"rows": rows, "regressions": regressions,
+            "added": sorted(set(b) - set(a)),
+            "removed": sorted(set(a) - set(b)),
+            "threshold": threshold}
+
+
+def print_diff(diff: dict) -> None:
+    print(f"{'metric':<58}{'A':>13}{'B':>13}{'delta%':>9}  gate")
+    for row in diff["rows"]:
+        rel = row["rel"]
+        pct = f"{rel * 100:+.2f}" if abs(rel) != float("inf") else "inf"
+        gate = ("REGRESSED" if row["regressed"]
+                else {1: "up-good", -1: "down-good", 0: ""}
+                [row["direction"]])
+        print(f"{row['metric'][:57]:<58}{row['a']:>13.6g}"
+              f"{row['b']:>13.6g}{pct:>9}  {gate}")
+    for name in diff["removed"]:
+        print(f"{name[:57]:<58}{'':>13}{'-':>13}{'':>9}  removed")
+    for name in diff["added"]:
+        print(f"{name[:57]:<58}{'-':>13}{'':>13}{'':>9}  added")
+    n = len(diff["regressions"])
+    print(f"\n{n} regression(s) past ±{diff['threshold'] * 100:.1f}% "
+          f"over {len(diff['rows'])} shared metrics")
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="summarize a deepspeed_tpu telemetry dump")
-    ap.add_argument("trace", help="Chrome-trace JSON "
-                                  "(telemetry export_artifacts *.trace.json)")
-    ap.add_argument("metrics", nargs="?", default=None,
-                    help="optional *.prom (Prometheus text) or "
-                         "*.metrics.json (registry snapshot)")
+        description="summarize / merge / diff deepspeed_tpu telemetry "
+                    "dumps")
+    ap.add_argument("paths", nargs="*",
+                    help="report mode: TRACE [METRICS]; --merge mode: "
+                         "per-rank trace inputs; --diff mode: A B")
+    ap.add_argument("--ledger", default=None,
+                    help="per-executable ledger JSON "
+                         "(telemetry *.ledger.json)")
+    ap.add_argument("--merge", metavar="OUT", default=None,
+                    help="merge the input Chrome traces into OUT with "
+                         "rank-labelled tracks")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two metric-snapshot JSONs (A B); exit 1 "
+                         "on regression past --threshold")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression threshold for --diff "
+                         "(default 0.05)")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")
     args = ap.parse_args(argv)
-    report = build_report(args.trace, args.metrics)
+
+    if args.merge:
+        if len(args.paths) < 1:
+            ap.error("--merge needs at least one input trace")
+        doc = merge_traces(args.merge, args.paths)
+        print(f"merged {len(doc['otherData']['merged_from'])} traces "
+              f"({len(doc['traceEvents'])} events) -> {args.merge}")
+        return 0
+
+    if args.diff:
+        if len(args.paths) != 2:
+            ap.error("--diff needs exactly two snapshot paths: A B")
+        diff = diff_snapshots(args.paths[0], args.paths[1],
+                              threshold=args.threshold)
+        if args.json:
+            json.dump(diff, sys.stdout)
+            print()
+        else:
+            print_diff(diff)
+        return 1 if diff["regressions"] else 0
+
+    if not args.paths:
+        ap.error("report mode needs a trace path "
+                 "(or use --merge / --diff)")
+    report = build_report(args.paths[0],
+                          args.paths[1] if len(args.paths) > 1 else None,
+                          ledger_path=args.ledger)
     if args.json:
         json.dump(report, sys.stdout)
         print()
