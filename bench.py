@@ -447,6 +447,176 @@ def _fused_decode_metrics(e, prompts: list, k: int,
                 len(uids) * k * 1e3 / max(p50, 1e-9), 1)}
 
 
+def _decode_step_probe(model, e, uids, use_kernel: bool, long_n: int,
+                       short_n: int, reps: int) -> float:
+    """Chain-differenced device-truth decode-step time (ms) for
+    sequences already resident in engine ``e`` — the shared probe
+    behind the serving stages' compute denominators. Never donates
+    ``e.pools``, so the engine stays usable afterwards."""
+    make_chain, args = _decode_chain_setup(model, e, uids,
+                                           use_kernel=use_kernel)
+    chain_l, chain_s = make_chain(long_n), make_chain(short_n)
+    pools = e.pools
+    for c in (chain_l, chain_s):                        # compile + warm
+        lgs, pools = c(e.params, pools, *args)
+        float(jnp.sum(lgs))
+    ms, _ = _chain_pair_ms(chain_l, chain_s, e.params, pools, args,
+                           long_n, short_n, reps=reps)
+    return ms
+
+
+def _chained_serve_metrics(e, prompts: list, k: int,
+                           max_new: int) -> dict:
+    """Drive the N-deep chained serving loop (ISSUE 6) over `prompts`
+    and report the acceptance figures: per-decode-step wall time with
+    the chain's host syncs amortized in (``tick_p50_ms`` over per-chain
+    drains; the gate compares it against ``decode_step_ms_compute``)
+    and host dispatches per decoded token at equal greedy outputs.
+    Engine state is left flushed. Call once warm (compiles), once
+    timed."""
+    from deepspeed_tpu.inference.v2.serve_loop import FusedServeLoop
+    e.reset_serving_metrics()
+    loop = FusedServeLoop(e, k_steps=k, strict=True)
+    for i, p in enumerate(prompts):
+        loop.submit(p, max_new, uid=i)
+    t0 = time.perf_counter()
+    n_tok = 0
+    while loop.has_work():
+        for evt in loop.step():
+            n_tok += len(evt.tokens)
+    wall = time.perf_counter() - t0
+    ticks = sorted(dt / s * 1e3 for dt, s in loop.drain_stats if s > 0)
+    steps_total = sum(s for _, s in loop.drain_stats)
+    m = e.serving_metrics()
+    return {"tick_p50_ms": round(ticks[len(ticks) // 2], 2) if ticks
+            else None,
+            "tick_p99_ms": round(
+                ticks[min(len(ticks) - 1, int(len(ticks) * 0.99))], 2)
+            if ticks else None,
+            "tick_mean_ms": round(wall * 1e3 / max(steps_total, 1), 2),
+            "chained_tokens_per_sec": round(n_tok / max(wall, 1e-9), 1),
+            "dispatches_per_token_chained": round(
+                m["dispatches_per_token"], 4),
+            "fused_occupancy_chained": round(m["fused_occupancy"], 3),
+            "chain_depth": int(e._config.max_inflight_dispatches),
+            "fused_admission": bool(e._config.fused_admission)}
+
+
+def serve_openloop_bench(ds, on_tpu: bool):
+    """Open-loop Poisson traffic against the async continuous-batching
+    server (ISSUE 6): synthetic clients arrive at a fixed rate, stream
+    their tokens, and the stage reports the serving SLO histograms —
+    TTFT p50/p99 (submit -> first streamed token, queueing included)
+    and per-request mean inter-token latency p50/p99 — plus the
+    tick-vs-compute ratio: p50 wall time per decode step through the
+    chained serving loop over the chain-differenced device compute
+    step (1.0 = the host adds nothing; the acceptance gate is <= 2)."""
+    import asyncio
+
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.serving import AsyncInferenceServer, ServingConfig
+
+    if on_tpu:
+        model = Llama(hidden_size=1024, num_layers=12, num_heads=8,
+                      num_kv_heads=8, intermediate_size=2816,
+                      vocab_size=32000, max_seq_len=2048)
+        bs_kv, nb, chunk, B = 64, 256, 256, 16
+        n_req, rate_rps, p_len, max_new, K, depth = 48, 6.0, 128, 48, 8, 4
+    else:
+        model = Llama(size="tiny", max_seq_len=256)
+        bs_kv, nb, chunk, B = 8, 128, 16, 8
+        n_req, rate_rps, p_len, max_new, K, depth = 10, 20.0, 12, 6, 4, 2
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+        dtype="bfloat16" if on_tpu else "float32", kv_block_size=bs_kv,
+        num_kv_blocks=nb, max_chunk_size=chunk,
+        max_ragged_sequence_count=B, fused_decode_steps=K,
+        max_inflight_dispatches=depth, fused_admission=True))
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    prompts = [rng.integers(0, vocab, p_len).tolist()
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, n_req))
+
+    # device-truth decode step for the ratio denominator
+    probe_uids = list(range(10 ** 6, 10 ** 6 + min(4, B)))
+    e.put(probe_uids, [prompts[i % n_req] for i in range(len(probe_uids))])
+    step_ms = _decode_step_probe(model, e, probe_uids, on_tpu,
+                                 *((32, 8, 3) if on_tpu else (4, 2, 1)))
+    e.flush(probe_uids)
+
+    # warm the serving-loop executables (prefill buckets + the serve
+    # ring loop) outside the measured traffic window — both the full
+    # decode-batch bucket and the single-row bucket, so the measured
+    # ticks mostly hit the executable cache
+    for n_warm in (min(B, n_req), 1):
+        _chained_serve_metrics(e, prompts[:n_warm], K,
+                               max_new=min(max_new, 2 * K))
+    # the gated efficiency counters must cover ONLY the measured
+    # traffic window, not the warm-up drives
+    e.reset_serving_metrics()
+
+    results = {"ttft": [], "itl_req": [], "done": 0}
+
+    async def client(srv, i):
+        await asyncio.sleep(float(arrivals[i]))
+        t_sub = time.perf_counter()
+        h = await srv.submit(prompts[i], max_new_tokens=max_new)
+        t_first = t_last = None
+        n = 0
+        async for _tok in h:
+            now = time.perf_counter()
+            if t_first is None:
+                t_first = now
+            t_last = now
+            n += 1
+        if t_first is None:
+            return
+        results["ttft"].append((t_first - t_sub) * 1e3)
+        if n > 1:
+            results["itl_req"].append((t_last - t_first) / (n - 1) * 1e3)
+        results["done"] += 1
+
+    async def run():
+        async with AsyncInferenceServer(
+                e, ServingConfig(k_steps=K)) as srv:
+            await asyncio.gather(*(client(srv, i)
+                                   for i in range(n_req)))
+            return srv.session.drain_stats, srv.metrics()
+
+    drains, m = asyncio.run(run())
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(len(xs) * q))], 2)
+
+    ticks = [dt / s * 1e3 for dt, s in drains if s > 0]
+    tick_p50 = pct(ticks, 0.5)
+    return {"metric": "serve_openloop_ttft_p50_ms",
+            "value": pct(results["ttft"], 0.5), "unit": "ms",
+            "requests": n_req, "completed": results["done"],
+            "arrival_rate_rps": rate_rps, "prompt_tokens": p_len,
+            "max_new_tokens": max_new,
+            "ttft_p99_ms": pct(results["ttft"], 0.99),
+            "itl_p50_ms": pct(results["itl_req"], 0.5),
+            "itl_p99_ms": pct(results["itl_req"], 0.99),
+            "tick_p50_ms": tick_p50,
+            "tick_p99_ms": pct(ticks, 0.99),
+            "decode_step_ms_compute": round(step_ms, 3),
+            "tick_vs_compute_ratio": (
+                round(tick_p50 / max(step_ms, 1e-3), 2)
+                if tick_p50 else None),
+            "dispatches_per_token": round(m["dispatches_per_token"], 4),
+            "fused_occupancy": round(m["fused_occupancy"], 3),
+            "preemptions": m["preemptions"],
+            "chain_depth": depth, "fused_k": K,
+            "fused_admission": True}
+
+
 def serving_bench(ds, on_tpu: bool):
     """Serving class (BASELINE configs 1-2 / FastGen): greedy batch
     decode on the Llama-340M-class model. Reports the v1 engine's
@@ -848,17 +1018,8 @@ def serve7b_int8(ds, on_tpu: bool):
 
     p50, p99 = _tick_percentiles(one_tick, 16)
 
-    # device-truth decode step: chain-differenced (shared scaffolding)
-    make_chain, args = _decode_chain_setup(model, e2, uids,
-                                           use_kernel=True)
-    long_n, short_n = 32, 8
-    chain_l, chain_s = make_chain(long_n), make_chain(short_n)
-    pools = e2.pools
-    for c in (chain_l, chain_s):
-        lgs, pools = c(e2.params, pools, *args)
-        float(jnp.sum(lgs))
-    step_ms, pools = _chain_pair_ms(chain_l, chain_s, e2.params, pools,
-                                    args, long_n, short_n, reps=3)
+    # device-truth decode step: chain-differenced (shared probe)
+    step_ms = _decode_step_probe(model, e2, uids, True, 32, 8, 3)
 
     # fused multi-step decode (ISSUE 1 acceptance): the per-tick p50
     # above rides one tunnel RTT PER TOKEN; the fused loop pays it once
@@ -867,6 +1028,18 @@ def serve7b_int8(ds, on_tpu: bool):
     e2.flush(uids)
     K = 8
     fused = _fused_decode_metrics(e2, prompts, k=K, n_dispatches=6)
+
+    # ISSUE 6 acceptance: N-deep chained serving with in-graph
+    # admission + one host read per chain. decode_fused above blocks on
+    # every dispatch (RTT per K tokens); the chained loop pays the RTT
+    # once per chain of `depth` dispatches, so its per-step tick should
+    # sit within 2x decode_step_ms_compute — and its host dispatches
+    # per token at equal greedy outputs strictly below the PR 1 figure.
+    e2.flush(list(range(B)))
+    e2._config.max_inflight_dispatches = 4
+    e2._config.fused_admission = True
+    _chained_serve_metrics(e2, prompts, K, max_new=64)   # warm/compile
+    chained = _chained_serve_metrics(e2, prompts, K, max_new=64)
     return {"metric": "serve7b_int8_decode_tokens_per_sec",
             "value": round(B * 1e3 / step_ms, 1), "unit": "tokens/s/chip",
             "batch": B, "params_b": round(
@@ -874,11 +1047,18 @@ def serve7b_int8(ds, on_tpu: bool):
             "weights_int8_gib": round(int8_gib, 2),
             "context_tokens": P,
             "decode_step_ms_compute": round(step_ms, 2),
-            "tick_p50_ms": round(p50, 1), "tick_p99_ms": round(p99, 1),
+            # host-in-loop per-tick scheduler (the BENCH_r05 "tick_p50"
+            # baseline: one RTT per token); the serving tick_p50_ms now
+            # comes from the chained loop below
+            "per_tick_p50_ms": round(p50, 1),
+            "per_tick_p99_ms": round(p99, 1),
             **fused,
             "fused_step_ms": round(fused["fused_tick_p50_ms"] / K, 2),
-            "tick_note": "host-in-loop ticks ride the dev tunnel RTT; "
-                         "fused pays it once per K tokens"}
+            **chained,
+            "tick_note": "per-tick rides one tunnel RTT per token; "
+                         "decode_fused pays it once per K tokens; the "
+                         "chained serving loop (tick_p50_ms) once per "
+                         "chain of depth dispatches"}
 
 
 def llama7b_streamed(ds, on_tpu: bool):
@@ -1287,6 +1467,7 @@ STAGES = [("headline", headline_bench),
           ("llama", llama_bench), ("longctx", longctx_bench),
           ("moe", moe_bench), ("serving", serving_bench),
           ("prefix", prefix_bench),
+          ("serve_openloop", serve_openloop_bench),
           ("moe_serving", moe_serving_bench),
           ("offload", offload_smoke),
           ("domino", domino_bench),

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import functools
 import time
-from collections import deque
 from typing import Any, Optional, Sequence
 
 import jax
@@ -33,7 +32,7 @@ from ...utils.logging import log_dist
 from ...utils.telemetry_probe import (NULL_CM as _NULLCM,
                                       active_telemetry as _telemetry)
 from ..config import DeepSpeedInferenceConfig
-from .paged import fused_decode_loop, paged_forward
+from .paged import fused_decode_loop, fused_serve_loop, paged_forward
 from .ragged import (PrefixCache, DSStateManager, SequenceDescriptor)
 
 PyTree = Any
@@ -93,6 +92,14 @@ class _LatencyProbe:
                 self._itl.observe(per)
         self._last_t[uid] = now
 
+    def finished(self, uid: int) -> None:
+        """Drop per-uid state. A probe used to die with one
+        generate call; the serving loop keeps one alive for the
+        server's lifetime, so finished/preempted uids must not
+        accumulate."""
+        self._admit_t.pop(uid, None)
+        self._last_t.pop(uid, None)
+
 
 def _bucket(n: int, lo: int = 1) -> int:
     b = lo
@@ -149,6 +156,20 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     sampling_top_p: float = 0.0
     # sequences terminate in-graph when they sample this token
     eos_token_id: Optional[int] = None
+    # dispatch-chain depth for the fused drivers (ISSUE 6): how many
+    # fused decode dispatches may be in flight before the host drains
+    # one. 2 = the PR 1 double buffering (default path byte-identical);
+    # deeper chains amortize the host round trip further at the cost
+    # of admission latency (a waiting prompt rides out the chain).
+    max_inflight_dispatches: int = Field(2, ge=1)
+    # device-resident multi-tick serving (ISSUE 6): pre-staged requests
+    # (prefilled, blocks reserved) are swapped into finished rows'
+    # slots INSIDE the compiled loop (activity-mask swap + staged
+    # token/position/table operands), and sampled tokens accumulate in
+    # a device-side ring the host reads ONCE per dispatch chain instead
+    # of once per dispatch. Off by default — the disabled path is
+    # byte-identical to the PR 1 fused driver.
+    fused_admission: bool = False
     # runtime sentinels (ISSUE 3, analysis/sentinels.py): every fused
     # decode dispatch runs under a recompile watch (a previously-seen
     # (jit key, operand shapes) signature must hit the executable
@@ -504,6 +525,25 @@ class InferenceEngineV2:
                                pool_sh))
         return self._fused_cache[key]
 
+    def _serve_fn(self, num_steps: int, temperature: float, top_k: int,
+                  top_p: float, eos_id: Optional[int]):
+        """Ring-mode executable (ISSUE 6): the fused decode loop with
+        in-graph admission of pre-staged requests and the device-side
+        output ring (paged.fused_serve_loop). Cached beside the plain
+        fused executables under a mode-tagged key."""
+        key = ("serve", num_steps, temperature, top_k, top_p, eos_id)
+        if key not in self._fused_cache:
+            tp = self._v1.topology.model_parallel_size
+            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            self._fused_cache[key] = jax.jit(
+                functools.partial(
+                    fused_serve_loop, self.model, num_steps=num_steps,
+                    eos_id=eos_id, temperature=temperature, top_k=top_k,
+                    top_p=top_p, use_kernel=(tp <= 1)),
+                donate_argnums=(1,),
+                out_shardings=(None,) * 11 + (pool_sh,))
+        return self._fused_cache[key]
+
     def _fused_operands(self, uids: list[int], k: int,
                         budgets: dict[int, int], seed: int):
         """Host-side build of one fused dispatch's operands. Every uid
@@ -702,6 +742,11 @@ class InferenceEngineV2:
             st["host_dispatches"] / max(st["decoded_tokens"], 1))
         st["fused_occupancy"] = (
             st["fused_slot_tokens"] / max(st["fused_slots"], 1))
+        # active dispatch-chain depth (ISSUE 6 knob) rides along so
+        # consumers can correlate dispatch ratios with the configured
+        # chain depth
+        st["max_inflight_dispatches"] = int(
+            self._config.max_inflight_dispatches)
         return st
 
     def reset_serving_metrics(self) -> None:
@@ -835,271 +880,35 @@ class InferenceEngineV2:
 
         Between dispatches the host admits new prompts, prefills them
         through the bucketed chunk path, and drains finished tokens
-        from the dispatch's output ring buffer. Transfers are
-        double-buffered: while a dispatch runs on device, the host
-        drains the PREVIOUS dispatch's ring buffer — chaining works
-        because the loop's carry (next tokens, positions, active masks)
-        stays on device, so dispatch N+1 needs no host read of
-        dispatch N. Greedy decode is token-identical to generate();
-        stochastic decode is dispatch-schedule-invariant (position-keyed
-        sampling), so per-tick and fused-K agree there too."""
-        cfg = self._config
-        k = max(1, int(k_steps if k_steps is not None
-                       else (cfg.fused_decode_steps or 8)))
-        temperature, top_k, top_p, eos = self._sampling_args(
-            temperature, top_k, top_p, eos_id)
-        mgr = self.state_manager
-        bs = mgr.block_size
-        stats = self.serving_stats
-        pending = list(enumerate([list(map(int, p)) for p in prompts]))
-        live: dict[int, list[int]] = {}
+        from the dispatch's output ring buffer. Dispatches chain up to
+        ``max_inflight_dispatches`` deep (default 2 — double
+        buffering): while dispatches run on device, the host drains
+        the OLDEST one's ring buffer — chaining works because the
+        loop's carry (next tokens, positions, active masks) stays on
+        device, so dispatch N+1 needs no host read of dispatch N. With
+        ``fused_admission`` the chain goes further device-resident:
+        waiting prompts are pre-staged and swapped into finished rows'
+        slots inside the compiled loop, and the host reads one output
+        ring per CHAIN instead of per dispatch. Greedy decode is
+        token-identical to generate(); stochastic decode is
+        dispatch-schedule-invariant (position-keyed sampling), so
+        per-tick and fused-K agree there too.
+
+        The scheduler itself lives in
+        :class:`~.serve_loop.FusedServeLoop` (shared with the async
+        serving front end, ``deepspeed_tpu.serving``); this wrapper
+        runs it closed-loop over a fixed prompt list."""
+        from .serve_loop import FusedServeLoop
+        loop = FusedServeLoop(self, k_steps=k_steps,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, eos_id=eos_id, seed=seed,
+                              strict=True)
         results: dict[int, list[int]] = {}
-        to_flush: list[int] = []
-        max_live = self._config.max_ragged_sequence_count
-        # telemetry resolved once per call; every probe below is
-        # per-admission/per-dispatch/per-drain — never per token
-        tel = _telemetry()
-        reg = tel.get_registry() if tel is not None else None
-        lat = _LatencyProbe(reg) if reg is not None else None
-
-        def admit() -> list[int]:
-            """Admit pending prompts, ALLOCATING the full worst-case
-            block budget (prompt + max_new) up front: fused dispatches
-            write KV in-graph through a table fixed at build time, so
-            every block a sequence can ever touch must exist before its
-            first dispatch (the per-tick driver only *reserves* this
-            budget arithmetically)."""
-            batch: list[tuple[int, list[int]]] = []
-            free = mgr.available_blocks
-            while pending and len(live) + len(batch) < max_live:
-                uid, prompt = pending[0]
-                need = -(-(len(prompt) + max_new_tokens) // bs)
-                if need > mgr.max_blocks_per_seq or \
-                        need > mgr.allocator.num_blocks:
-                    raise ValueError(
-                        f"prompt {uid}: {len(prompt)} tokens + "
-                        f"{max_new_tokens} new can never fit the KV pool "
-                        f"(needs {need} blocks)")
-                # prefix-cache hits shrink the admission cost to the
-                # uncached blocks (+ parked hits leaving the evictable
-                # pool); schedule() re-pins the same match exactly
-                cost = mgr.admission_cost(prompt, need)
-                if cost > free:
-                    break
-                pending.pop(0)
-                free -= cost
-                batch.append((uid, prompt))
-            if lat is not None:
-                lat.admitted([u for u, _ in batch], waiting=len(pending))
-            if not batch:
-                return []
-            self.schedule([u for u, _ in batch], [p for _, p in batch])
-            # the whole batch joins `live` BEFORE reserving: a reserve
-            # failure mid-batch must leave every scheduled uid visible
-            # to the driver's block-leak guard
-            for uid, _ in batch:
-                live[uid] = []
-            for uid, _ in batch:
-                mgr.reserve(uid, max_new_tokens)
-            return [u for u, _ in batch]
-
-        def finish(uid: int) -> None:
-            results[uid] = live.pop(uid)[:max_new_tokens]
-            to_flush.append(uid)
-
-        def prefill(uids_new: list[int]) -> None:
-            """Chunked prefill of newly admitted prompts, then the first
-            generated token — sampled with the same op and position
-            keying as the in-graph loop, so it belongs to the same
-            stochastic stream."""
-            from ...ops import sampling
-            filling = list(uids_new)
-            firsts: dict[int, jnp.ndarray] = {}
-            with (tel.span("v2/prefill", rows=len(filling))
-                  if tel is not None else _NULLCM):
-                while filling:
-                    run = [u for u in filling if mgr.seqs[u].pending]
-                    logits = self._run(run)
-                    for i, u in enumerate(run):
-                        if not mgr.seqs[u].pending:
-                            firsts[u] = logits[i]
-                            filling.remove(u)
-            if not firsts:
-                return
-            # first tokens for the WHOLE admission batch sampled in one
-            # device call and drained with one transfer (was a
-            # per-sequence int() sync — graftlint GL004). Keys are the
-            # same per-(uid, position) stream the in-graph loop uses,
-            # so fused/per-tick parity is unchanged.
-            uids_f = list(firsts)
-            base = self._base_key(seed)
-            row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(
-                jnp.asarray(np.asarray(uids_f, np.uint32)))
-            keys = sampling.position_keys(
-                row_keys,
-                jnp.asarray(np.asarray([mgr.seqs[u].seen
-                                        for u in uids_f])))
-            toks_dev = sampling.sample_tokens_batched(
-                jnp.stack([firsts[u] for u in uids_f]).astype(jnp.float32),
-                keys, temperature=temperature, top_k=top_k, top_p=top_p)
-            for u, tok in zip(uids_f, jax.device_get(toks_dev)):
-                tok = int(tok)
-                live[u].append(tok)
-                stats["decoded_tokens"] += 1
-                if lat is not None:
-                    lat.tokens(u, 1, first=True)
-                if max_new_tokens <= 1 or (eos is not None and tok == eos):
-                    finish(u)
-                else:
-                    # the first token becomes the pending input of the
-                    # first fused dispatch (blocks preallocated)
-                    mgr.extend(u, [tok])
-
-        fn = self._fused_fn(k, temperature, top_k, top_p, eos)
-        infl: deque = deque()   # in-flight dispatches (double buffer)
-
-        try:
-            self._drive_fused(
-                live, pending, infl, to_flush, admit, prefill, finish,
-                fn, k, temperature, top_k, top_p, eos, seed,
-                max_new_tokens, tel, lat, stats)
-        except BaseException:
-            # block-leak guard: drain what's in flight (its commits are
-            # lost, but the device must stop referencing the tables
-            # before the blocks are recycled), then release every
-            # scheduled-but-unfinished sequence's KV blocks
-            try:
-                jax.block_until_ready([out for _, out, _ in infl])
-            except Exception:   # noqa: BLE001 — best-effort drain
-                pass
-            for u in set(live) | set(to_flush):
-                self.flush(u)
-            raise
-        for u in to_flush:
-            self.flush(u)
-        return [results[i] for i in range(len(prompts))]
-
-    def _drive_fused(self, live, pending, infl, to_flush, admit, prefill,
-                     finish, fn, k, temperature, top_k, top_p, eos, seed,
-                     max_new_tokens, tel, lat, stats):
-        """generate_fused()'s admission/enqueue/drain loop (split out so
-        the driver can wrap it with block-leak cleanup)."""
-        mgr = self.state_manager
-        carry = None            # device-side loop carry for `rowset`
-        rowset: list[int] = []
-        budgets: dict[int, int] = {}
-        tables = row_keys = None
-        n_enq = 0               # dispatches chained since last rebuild
-
-        while live or pending or infl:
-            if not live and not infl:
-                for u in to_flush:
-                    self.flush(u)
-                to_flush.clear()
-                ids = admit()
-                if not ids:
-                    raise RuntimeError(
-                        "continuous-batching deadlock: pending prompts "
-                        "but nothing admissible")
-                carry = None
-                prefill(ids)
-                continue
-
-            # enqueue: ≤2 dispatches in flight. The first after a
-            # rebuild always goes; a chained one only when no admission
-            # is waiting and some row's budget can outlast the chain.
-            while live and len(infl) < 2:
-                if carry is None and infl:
-                    # rebuild needs the in-flight dispatch's commits
-                    # first — rebuilding from stale host state would
-                    # replay its decode steps
-                    break
-                if carry is None:
-                    rowset = sorted(live)
-                    budgets = {u: max_new_tokens - len(live[u])
-                               for u in rowset}
-                    (tok_a, pos_a, tables, act_a, rem_a,
-                     row_keys) = self._fused_operands(
-                         rowset, k, budgets, seed)
-                    n_enq = 0
-                else:
-                    tok_a, pos_a, act_a, rem_a = carry
-                if n_enq > 0 and (pending
-                                  or max(budgets.values()) <= k * n_enq):
-                    break
-                if tel is not None:
-                    self._device_truth_observe(
-                        tel, "v2/fused_dispatch", fn,
-                        (tok_a, pos_a, tables, act_a, rem_a, row_keys))
-                with (tel.span("v2/fused_enqueue",
-                               dispatch_id=stats["fused_dispatches"] + 1,
-                               rows=len(rowset), k=k)
-                      if tel is not None else _NULLCM):
-                    with self._fused_dispatch_scope(
-                            (k, temperature, top_k, top_p, eos),
-                            (tok_a, pos_a, tables, act_a, rem_a,
-                             row_keys),
-                            variant="carry" if n_enq > 0 else "host"):
-                        out, steps, t2, p2, a2, r2, self.pools = fn(
-                            self.params, self.pools, tok_a, pos_a, tables,
-                            act_a, rem_a, row_keys)
-                carry = (t2, p2, a2, r2)
-                n_enq += 1
-                infl.append((list(rowset), out, steps))
-                stats["host_dispatches"] += 1
-                stats["fused_dispatches"] += 1
-
-            if not infl:          # chain declined to enqueue: rebuild
-                carry = None
-                continue
-            # drain the OLDEST dispatch's ring buffer (device may still
-            # be running the newer chained one — that's the overlap)
-            rows, out, steps = infl.popleft()
-            t_drain = time.perf_counter() if tel is not None else 0.0
-            with (tel.span("v2/fused_drain", rows=len(rows))
-                  if tel is not None else _NULLCM):
-                # the drain is the ONE sanctioned host read of the
-                # decode loop; under the sentinel it runs inside
-                # transfer_guard("disallow"), which still admits these
-                # EXPLICIT device->host pulls — anything implicit
-                # sneaking in here raises
-                with (self._hot_guard() if self._hot_guard is not None
-                      else _NULLCM):
-                    toks = np.asarray(out)
-                    n_exec = int(steps)
-            stats["fused_steps"] += n_exec
-            stats["fused_slots"] += n_exec * len(rows)
-            membership_changed = False
-            for i, u in enumerate(rows):
-                if u not in live:     # finished in an earlier dispatch
-                    continue
-                row = [int(t) for t in toks[i] if t >= 0]
-                if not row:
-                    continue
-                mgr.commit_device_tokens(u, row)
-                live[u].extend(row)
-                stats["decoded_tokens"] += len(row)
-                stats["fused_slot_tokens"] += len(row)
-                if lat is not None:
-                    lat.tokens(u, len(row))
-                if (len(live[u]) >= max_new_tokens
-                        or (eos is not None and row[-1] == eos)):
-                    finish(u)
-                    membership_changed = True
-            if tel is not None:
-                self._record_dispatch_telemetry(
-                    tel, time.perf_counter() - t_drain)
-            if membership_changed or pending:
-                # a finished row's slot should go to a waiting prompt;
-                # rebuild operands once the in-flight chain drains
-                carry = None
-            if not infl:
-                # nothing in flight references the old tables/blocks:
-                # safe to recycle KV blocks and admit
-                for u in to_flush:
-                    self.flush(u)
-                to_flush.clear()
-                ids = admit()
-                if ids:
-                    carry = None
-                    prefill(ids)
+        for i, p in enumerate(prompts):
+            loop.submit(p, max_new_tokens, uid=i)
+            results[i] = []
+        while loop.has_work():
+            for evt in loop.step():
+                results[evt.uid].extend(evt.tokens)
+        return [results[i][:max_new_tokens]
+                for i in range(len(prompts))]

@@ -417,3 +417,100 @@ def fused_decode_loop(model, params: PyTree, pools: PyTree,
         cond, body, (jnp.asarray(0, jnp.int32), tokens, pos, active,
                      remaining, pools, out0))
     return out, step, tokens, pos, active, remaining, pools
+
+
+def fused_serve_loop(model, params: PyTree, pools: PyTree,
+                     tokens: jax.Array, pos: jax.Array,
+                     block_tables: jax.Array, active: jax.Array,
+                     remaining: jax.Array, row_keys: jax.Array,
+                     epoch: jax.Array, stage_tokens: jax.Array,
+                     stage_pos: jax.Array, stage_rem: jax.Array,
+                     stage_keys: jax.Array, stage_tables: jax.Array,
+                     stage_valid: jax.Array, ring: jax.Array,
+                     ring_epochs: jax.Array, ring_ptr: jax.Array, *,
+                     num_steps: int, eos_id: int | None,
+                     temperature: float, top_k: int, top_p: float,
+                     use_kernel: bool = True):
+    """:func:`fused_decode_loop` extended for device-resident multi-tick
+    serving (ISSUE 6): in-graph admission of PRE-STAGED requests and a
+    device-side output ring the host drains once per dispatch CHAIN,
+    not once per dispatch.
+
+    Two additions ride the ``lax.while_loop`` carry:
+
+    - **staged-slot swap** (in-graph admission): each row may carry ONE
+      pre-staged request — a prompt the host already prefilled and
+      reserved blocks for (``stage_tokens``/``stage_pos``/``stage_rem``
+      its pending input, position and budget; ``stage_keys`` its
+      sampling key row; ``stage_tables`` its block-table row;
+      ``stage_valid`` whether a stage is attached). The instant a row's
+      current occupant terminates (EOS or budget), the staged request
+      is swapped in by an activity-mask swap — token/position/budget/
+      key/table row all replaced in-graph — so a finished slot refills
+      INSIDE the compiled loop instead of forcing a host-side operand
+      rebuild. ``epoch`` [B] counts swaps per row, letting the host
+      attribute ring tokens to the right occupant after the fact.
+      ``block_tables`` and ``row_keys`` join the carry to make the swap
+      possible (they are loop-invariant in :func:`fused_decode_loop`).
+
+    - **output ring**: sampled tokens land in ``ring`` [B, cap] at
+      column ``ring_ptr + step`` with the emitting occupant's epoch in
+      ``ring_epochs``; the updated ring and pointer come back as device
+      arrays, so a chain of dispatches accumulates into one buffer and
+      the host performs ONE device->host read per chain. ``cap`` must
+      cover the whole chain (``chain_len * num_steps <= cap`` —
+      enforced by the host driver).
+
+    Returns ``(ring, ring_epochs, ring_ptr', tokens, pos, active,
+    remaining, row_keys, block_tables, epoch, stage_valid, pools)`` —
+    everything a chained dispatch needs arrives as committed device
+    arrays; the stage operands are loop-invariant within a chain and
+    are re-passed by the host.
+    """
+    from ...ops import sampling
+
+    eos = -1 if eos_id is None else int(eos_id)
+
+    def cond(st):
+        step, active = st[0], st[3]
+        return (step < num_steps) & jnp.any(active)
+
+    def body(st):
+        (step, tokens, pos, active, remaining, row_keys, tables, epoch,
+         s_valid, ring, ring_ep, ring_ptr, pools) = st
+        tl = active.astype(jnp.int32)   # inactive rows write nothing
+        logits, pools = paged_forward(
+            model, params, pools, tokens[:, None], pos, tables,
+            tl, use_kernel=use_kernel)
+        keys = sampling.position_keys(row_keys, pos + 1)
+        nxt = sampling.sample_tokens_batched(
+            logits, keys, temperature=temperature, top_k=top_k,
+            top_p=top_p)
+        col = ring_ptr + step
+        ring = ring.at[:, col].set(jnp.where(active, nxt, -1))
+        ring_ep = ring_ep.at[:, col].set(jnp.where(active, epoch, -1))
+        pos = pos + tl
+        remaining = remaining - tl
+        alive = active & (remaining > 0) & (nxt != eos)
+        tokens = jnp.where(active, nxt, tokens)
+        # in-graph admission: a row whose occupant just terminated and
+        # that carries a staged request swaps it in for the NEXT step
+        swap = active & ~alive & s_valid
+        tokens = jnp.where(swap, stage_tokens, tokens)
+        pos = jnp.where(swap, stage_pos, pos)
+        remaining = jnp.where(swap, stage_rem, remaining)
+        row_keys = jnp.where(swap[:, None], stage_keys, row_keys)
+        tables = jnp.where(swap[:, None], stage_tables, tables)
+        epoch = epoch + swap.astype(jnp.int32)
+        alive = alive | swap
+        s_valid = s_valid & ~swap
+        return (step + 1, tokens, pos, alive, remaining, row_keys,
+                tables, epoch, s_valid, ring, ring_ep, ring_ptr, pools)
+
+    (step, tokens, pos, active, remaining, row_keys, tables, epoch,
+     stage_valid, ring, ring_epochs, ring_ptr, pools) = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), tokens, pos, active,
+                     remaining, row_keys, block_tables, epoch,
+                     stage_valid, ring, ring_epochs, ring_ptr, pools))
+    return (ring, ring_epochs, ring_ptr + step, tokens, pos, active,
+            remaining, row_keys, tables, epoch, stage_valid, pools)

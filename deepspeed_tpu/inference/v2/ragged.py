@@ -461,6 +461,23 @@ class DSStateManager:
         if seq is not None:
             self._release_blocks(seq.blocks)
 
+    def park(self, uid: int) -> list[int]:
+        """Preemption swap-out (ISSUE 6): release a LIVE sequence's KV
+        blocks and return its full token history for host-side
+        retention. With the prefix cache enabled the sequence's
+        PUBLISHED full blocks stay indexed (refcount-zero blocks park
+        in the LRU rather than freeing), so a later restore —
+        re-admitting ``prompt + generated`` as a fresh prompt — re-pins
+        the cached chain and recomputes only the unpublished tail.
+        Restores are position-exact: greedy and position-keyed
+        stochastic decode both resume bit-identically."""
+        seq = self.seqs.get(uid)
+        if seq is None:
+            return []
+        tokens = list(seq.tokens)
+        self.flush(uid)
+        return tokens
+
     def block_table(self, seq: SequenceDescriptor) -> np.ndarray:
         """Padded [max_blocks_per_seq] table; unused entries point past the
         pool (scatter mode='drop' discards writes through them)."""

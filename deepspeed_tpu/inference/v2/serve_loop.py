@@ -1,0 +1,785 @@
+"""Open-loop continuous-batching driver over the fused decode loop
+(ISSUE 6 tentpole).
+
+``FusedServeLoop`` is the admission/enqueue/drain scheduler that used to
+live inside ``InferenceEngineV2.generate_fused`` (``_drive_fused``),
+factored out and generalized so ONE driver serves both callers:
+
+- **closed-loop** (``generate_fused``): a fixed prompt list is submitted
+  up front and ``step()`` is called until ``has_work()`` is False —
+  token-for-token the behavior of the PR 1 driver (the parity tests in
+  tests/test_inference_v2.py run through this path);
+- **open-loop** (``deepspeed_tpu.serving.AsyncInferenceServer``):
+  requests arrive over time with priority tiers, stream their tokens
+  through :class:`TokenEvent`, can be cancelled mid-flight, and may be
+  PREEMPTED — a low-priority sequence's KV blocks are swapped out
+  (parked; with the prefix cache enabled its full blocks stay warm in
+  the LRU) to admit a higher-priority prompt, and restored later from
+  its host-retained token history.
+
+Two dispatch disciplines, selected by ``RaggedInferenceEngineConfig``:
+
+- **chain mode** (default): up to ``max_inflight_dispatches`` fused
+  dispatches in flight (PR 1 hard-coded 2); the host drains the oldest
+  dispatch's ring buffer while newer ones run. Byte-identical to the
+  PR 1 driver at the default depth.
+- **ring mode** (``fused_admission=True``): dispatches chain through
+  :func:`~.paged.fused_serve_loop` — waiting prompts are PRE-STAGED
+  (prefilled, blocks reserved, one stage per row) and swapped into a
+  finished row's slot INSIDE the compiled loop, and sampled tokens
+  accumulate in a device-side ring the host reads ONCE per chain
+  instead of once per dispatch. Host-blocking syncs per token drop by
+  the chain depth on top of the 1/K the fused loop already bought.
+
+The loop is single-threaded by design: callers marshal ``submit``/
+``cancel`` onto the thread that runs ``step()`` (the async server does
+this with a mailbox; see serving/server.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.telemetry_probe import (NULL_CM as _NULLCM,
+                                      active_telemetry as _telemetry)
+
+# scheduler-level counters surfaced through serving_metrics() /
+# AsyncInferenceServer.metrics() — one schema for both consumers
+LOOP_COUNTER_KEYS = ("preemptions", "restores", "cancellations",
+                     "admitted", "chain_drains")
+
+
+@dataclass
+class ServeRequest:
+    """One in-flight generation request. ``generated`` accumulates
+    across preemptions: on restore the full ``prompt + generated``
+    history is re-admitted (prefix-cache warm where published), so the
+    continuation is position-exact — greedy and position-keyed
+    stochastic decode both resume bit-identically."""
+    uid: int
+    prompt: list[int]
+    max_new_tokens: int
+    priority: int = 1
+    order: int = 0
+    generated: list[int] = field(default_factory=list)
+    preemptions: int = 0
+
+    @property
+    def budget(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def admission_tokens(self) -> list[int]:
+        return self.prompt + self.generated
+
+
+@dataclass
+class TokenEvent:
+    """One emission from :meth:`FusedServeLoop.step`: ``tokens`` newly
+    decoded for ``uid`` (may be empty on a pure state change), with
+    ``finished`` set on the request's last event. ``error`` carries the
+    failure reason for requests that can never run (e.g. a prompt that
+    cannot fit the KV pool) in non-strict mode."""
+    uid: int
+    tokens: list[int]
+    finished: bool = False
+    error: Optional[str] = None
+
+
+class FusedServeLoop:
+    """See module docstring. Construct against a live
+    :class:`~.engine_v2.InferenceEngineV2`; sampling parameters default
+    to the engine config and are fixed for the loop's lifetime (one
+    compiled executable family per loop)."""
+
+    def __init__(self, engine, *, k_steps: Optional[int] = None,
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 strict: bool = False, preemption: bool = True):
+        cfg = engine._config
+        self.e = engine
+        self.k = max(1, int(k_steps if k_steps is not None
+                            else (cfg.fused_decode_steps or 8)))
+        (self.temperature, self.top_k, self.top_p,
+         self.eos) = engine._sampling_args(temperature, top_k, top_p,
+                                           eos_id)
+        self.seed = int(seed)
+        self.strict = bool(strict)
+        self.preemption = bool(preemption)
+        self.depth = max(1, int(cfg.max_inflight_dispatches))
+        self.ring_mode = bool(cfg.fused_admission)
+        if self.ring_mode:
+            self.fn = engine._serve_fn(self.k, self.temperature,
+                                       self.top_k, self.top_p, self.eos)
+            self._fn_key = ("serve", self.k, self.temperature, self.top_k,
+                            self.top_p, self.eos)
+            self.ring_cap = self.k * self.depth
+        else:
+            self.fn = engine._fused_fn(self.k, self.temperature,
+                                       self.top_k, self.top_p, self.eos)
+            self._fn_key = (self.k, self.temperature, self.top_k,
+                            self.top_p, self.eos)
+
+        self.waiting: list[ServeRequest] = []
+        self.live: dict[int, ServeRequest] = {}
+        self.staged: dict[int, ServeRequest] = {}   # ring mode only
+        self.infl: deque = deque()
+        self.to_flush: list[int] = []
+        self.counters = dict.fromkeys(LOOP_COUNTER_KEYS, 0)
+        # (seconds since previous drain, decode steps drained) — the
+        # bench's tick-percentile source (wall per decode step with the
+        # chain's host syncs amortized in)
+        self.drain_stats: list[tuple[float, int]] = []
+        self._cancelled: set[int] = set()
+        self._order = itertools.count()
+        self._uid = itertools.count()
+        self._last_drain_t = time.perf_counter()
+        # chain-mode rebuild state (mirrors the PR 1 closure variables)
+        self._carry = None
+        self._rowset: list[int] = []
+        self._budgets: dict[int, int] = {}
+        self._tables = self._row_keys = None
+        self._n_enq = 0
+        # telemetry (resolved once; every probe is per-admission /
+        # per-dispatch / per-drain — never per token)
+        self._tel = _telemetry()
+        reg = (self._tel.get_registry() if self._tel is not None
+               else None)
+        from .engine_v2 import _LatencyProbe
+        self._lat = _LatencyProbe(reg) if reg is not None else None
+
+    # ------------------------------------------------------------------
+    # request intake (single-threaded with step(); see module docstring)
+    def submit(self, prompt, max_new_tokens: int = 32, *,
+               priority: int = 1, uid: Optional[int] = None) -> int:
+        """Queue one prompt; returns its uid. Lower ``priority`` values
+        run first; ties admit in submission order."""
+        toks = [int(t) for t in prompt]
+        if not toks:
+            raise ValueError("submit() needs at least one prompt token")
+        if uid is None:
+            uid = next(self._uid)
+        self.waiting.append(ServeRequest(
+            uid=int(uid), prompt=toks,
+            max_new_tokens=max(1, int(max_new_tokens)),
+            priority=int(priority), order=next(self._order)))
+        return int(uid)
+
+    def cancel(self, uid: int) -> None:
+        """Drop a request mid-stream; its KV blocks are released at the
+        next dispatch boundary (the leak-regression contract)."""
+        self._cancelled.add(int(uid))
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.live or self.staged or self.infl
+                    or self.to_flush or self._cancelled)
+
+    # ------------------------------------------------------------------
+    def step(self) -> list[TokenEvent]:
+        """One scheduler iteration: boundary housekeeping (flush /
+        cancel / preempt / admit / prefill), then enqueue up to the
+        configured chain depth and drain. Returns the tokens decoded
+        this iteration; an empty list means the loop is idle (or
+        waiting on admission headroom)."""
+        ev: list[TokenEvent] = []
+        if not self.has_work():
+            return ev
+        try:
+            if self.ring_mode:
+                self._step_ring(ev)
+            else:
+                self._step_chain(ev)
+        except BaseException:
+            self._emergency_flush()
+            raise
+        return ev
+
+    def close(self) -> None:
+        """Release every request's KV state (server shutdown)."""
+        self._emergency_flush()
+        self.waiting.clear()
+        self._cancelled.clear()
+
+    def _emergency_flush(self) -> None:
+        """Block-leak guard (PR 4): drain what's in flight (commits are
+        lost, but the device must stop referencing the tables before
+        the blocks recycle), then release every scheduled-but-unfinished
+        sequence's KV blocks."""
+        try:
+            jax.block_until_ready([f[1] for f in self.infl])
+        except Exception:   # noqa: BLE001 — best-effort drain
+            pass
+        self.infl.clear()
+        self._carry = None
+        for u in (set(self.live) | set(self.staged) | set(self.to_flush)):
+            self.e.flush(u)
+        self.live.clear()
+        self.staged.clear()
+        self.to_flush.clear()
+
+    # ------------------------------------------------------------------
+    # boundary housekeeping: runs only with nothing in flight
+    def _boundary(self, ev: list[TokenEvent]) -> list[int]:
+        assert not self.infl
+        for u in self.to_flush:
+            self.e.flush(u)
+        self.to_flush.clear()
+        self._apply_cancels(ev)
+        ids = self._admit(ev)
+        if ids:
+            self._carry = None
+            self._prefill(ids, ev)
+        return ids
+
+    def _apply_cancels(self, ev: list[TokenEvent]) -> None:
+        if not self._cancelled:
+            return
+        for uid in sorted(self._cancelled):
+            req = self.live.pop(uid, None) or self.staged.pop(uid, None)
+            if req is None:
+                before = len(self.waiting)
+                self.waiting = [r for r in self.waiting if r.uid != uid]
+                if len(self.waiting) == before:
+                    continue        # unknown/already-finished uid
+            else:
+                self.e.flush(uid)
+                self._carry = None  # membership changed mid-rowset
+            self.counters["cancellations"] += 1
+            ev.append(TokenEvent(uid, [], finished=True,
+                                 error="cancelled"))
+        self._cancelled.clear()
+
+    def _finish(self, uid: int, ev: list[TokenEvent],
+                staged: bool = False) -> None:
+        (self.staged if staged else self.live).pop(uid, None)
+        self.to_flush.append(uid)
+        if self._lat is not None:
+            self._lat.finished(uid)
+        if uid in self._cancelled:
+            self._cancelled.discard(uid)
+            self.counters["cancellations"] += 1
+            ev.append(TokenEvent(uid, [], finished=True,
+                                 error="cancelled"))
+        else:
+            ev.append(TokenEvent(uid, [], finished=True))
+
+    # ------------------------------------------------------------------
+    # admission (+ preemption) — port of the PR 1 generate_fused admit():
+    # the FULL worst-case block budget (history + remaining new tokens)
+    # is allocated up front, because fused dispatches write KV in-graph
+    # through tables fixed at build time.
+    def _admit(self, ev: list[TokenEvent]) -> list[int]:
+        e, mgr = self.e, self.e.state_manager
+        bs = mgr.block_size
+        max_live = e._config.max_ragged_sequence_count
+        self.waiting.sort(key=lambda r: (r.priority, r.order))
+        batch: list[ServeRequest] = []
+        free = mgr.available_blocks
+        while self.waiting:
+            # ring mode additionally admits PRE-STAGED requests beyond
+            # max_live — at most one per decode row; they join the
+            # batch (prefilled + blocks reserved) and are swapped into
+            # a finished row's slot in-graph. Recomputed every
+            # iteration: preemption frees rows mid-pass.
+            stage_from = max_live - len(self.live)
+            n_to_live = min(len(batch), stage_from)
+            n_to_stage = len(batch) - n_to_live
+            n_rows_after = len(self.live) + n_to_live
+            req = self.waiting[0]
+            if n_to_live >= stage_from and not (
+                    self.ring_mode
+                    and len(self.staged) + n_to_stage < n_rows_after):
+                # decode ROWS, not blocks, are the binding constraint:
+                # a high-priority arrival may still park a lower-
+                # priority occupant to free its row
+                if self._try_preempt(req, 0, ev, free_rows=True):
+                    free = mgr.available_blocks - sum(
+                        mgr.admission_cost(r.admission_tokens,
+                                           -(-(len(r.admission_tokens)
+                                               + r.budget) // bs))
+                        for r in batch)
+                    continue
+                break
+            toks = req.admission_tokens
+            need = -(-(len(toks) + req.budget) // bs)
+            if need > mgr.max_blocks_per_seq or \
+                    need > mgr.allocator.num_blocks:
+                msg = (f"prompt {req.uid}: {len(toks)} tokens + "
+                       f"{req.budget} new can never fit the KV pool "
+                       f"(needs {need} blocks)")
+                if self.strict:
+                    raise ValueError(msg)
+                self.waiting.pop(0)
+                ev.append(TokenEvent(req.uid, [], finished=True,
+                                     error=msg))
+                continue
+            cost = mgr.admission_cost(toks, need)
+            if cost > free:
+                if self._try_preempt(req, cost - free, ev):
+                    free = mgr.available_blocks - sum(
+                        mgr.admission_cost(r.admission_tokens,
+                                           -(-(len(r.admission_tokens)
+                                               + r.budget) // bs))
+                        for r in batch)
+                    continue        # re-check the same request
+                break
+            self.waiting.pop(0)
+            free -= cost
+            batch.append(req)
+        if self._lat is not None:
+            self._lat.admitted([r.uid for r in batch],
+                               waiting=len(self.waiting))
+        if not batch:
+            return []
+        e.schedule([r.uid for r in batch],
+                   [r.admission_tokens for r in batch])
+        # the whole batch joins the tracked sets BEFORE reserving: a
+        # reserve failure mid-batch must leave every scheduled uid
+        # visible to the block-leak guard
+        for i, r in enumerate(batch):
+            if self.ring_mode and i >= stage_from:
+                self.staged[r.uid] = r
+            else:
+                self.live[r.uid] = r
+        for r in batch:
+            mgr.reserve(r.uid, r.budget)
+        self.counters["admitted"] += len(batch)
+        self.counters["restores"] += sum(1 for r in batch
+                                         if r.preemptions > 0
+                                         and r.generated)
+        return [r.uid for r in batch]
+
+    def _try_preempt(self, req: ServeRequest, short_blocks: int,
+                     ev: list[TokenEvent],
+                     free_rows: bool = False) -> bool:
+        """Park strictly-lower-priority requests (KV swap-out: blocks
+        released — prefix-cached full blocks stay parked in the LRU for
+        a warm restore — token history retained host-side) until
+        ``req`` fits. ``free_rows`` parks ONE victim to free a decode
+        row when rows, not blocks, are the binding constraint. Only
+        called at a dispatch boundary, so no victim is referenced by an
+        in-flight dispatch."""
+        if not self.preemption or (short_blocks <= 0 and not free_rows):
+            return False
+        victims = sorted(
+            (r for r in (*self.staged.values(), *self.live.values())
+             if r.priority > req.priority),
+            key=lambda r: (-r.priority, -r.order))
+        if not victims:
+            return False
+        parked = False
+        mgr = self.e.state_manager
+        for v in victims:
+            freed_before = mgr.available_blocks
+            # KV swap-out: blocks dec-ref'd (published full blocks park
+            # in the prefix-cache LRU for a warm restore); the token
+            # history lives on in v.prompt/v.generated
+            mgr.park(v.uid)
+            self.staged.pop(v.uid, None)
+            self.live.pop(v.uid, None)
+            v.preemptions += 1
+            self.waiting.append(v)
+            self.counters["preemptions"] += 1
+            if self._lat is not None:
+                self._lat.finished(v.uid)
+            self._carry = None
+            parked = True
+            short_blocks -= mgr.available_blocks - freed_before
+            if free_rows or short_blocks <= 0:
+                break
+        if parked:
+            # keep the pass priority-ordered: a parked victim must
+            # outrank lower-priority waiters for the blocks it just
+            # freed (its original `order` keeps FIFO resume within its
+            # tier), or the next head would steal them and the victim
+            # would preempt it right back — churn
+            self.waiting.sort(key=lambda r: (r.priority, r.order))
+        return parked
+
+    # ------------------------------------------------------------------
+    def _prefill(self, uids_new: list[int], ev: list[TokenEvent]) -> None:
+        """Chunked prefill of newly admitted prompts, then the first
+        generated token — sampled with the same op and position keying
+        as the in-graph loop, so it belongs to the same stochastic
+        stream (port of the PR 1 closure)."""
+        from ...ops import sampling
+        e, mgr, tel = self.e, self.e.state_manager, self._tel
+        filling = list(uids_new)
+        firsts: dict[int, jnp.ndarray] = {}
+        with (tel.span("v2/prefill", rows=len(filling))
+              if tel is not None else _NULLCM):
+            while filling:
+                run = [u for u in filling if mgr.seqs[u].pending]
+                logits = e._run(run)
+                for i, u in enumerate(run):
+                    if not mgr.seqs[u].pending:
+                        firsts[u] = logits[i]
+                        filling.remove(u)
+        if not firsts:
+            return
+        uids_f = list(firsts)
+        base = e._base_key(self.seed)
+        row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(
+            jnp.asarray(np.asarray(uids_f, np.uint32)))
+        keys = sampling.position_keys(
+            row_keys,
+            jnp.asarray(np.asarray([mgr.seqs[u].seen for u in uids_f])))
+        toks_dev = sampling.sample_tokens_batched(
+            jnp.stack([firsts[u] for u in uids_f]).astype(jnp.float32),
+            keys, temperature=self.temperature, top_k=self.top_k,
+            top_p=self.top_p)
+        for u, tok in zip(uids_f, jax.device_get(toks_dev)):
+            tok = int(tok)
+            req = self.live.get(u) or self.staged.get(u)
+            req.generated.append(tok)
+            e.serving_stats["decoded_tokens"] += 1
+            ev.append(TokenEvent(u, [tok]))
+            if self._lat is not None:
+                self._lat.tokens(u, 1, first=len(req.generated) == 1)
+            if req.budget <= 0 or (self.eos is not None
+                                   and tok == self.eos):
+                self._finish(u, ev, staged=u in self.staged)
+            else:
+                # the first token becomes the pending input of the
+                # first fused dispatch (blocks preallocated)
+                mgr.extend(u, [tok])
+
+    # ------------------------------------------------------------------
+    # chain mode: the PR 1 _drive_fused loop with a configurable depth
+    def _step_chain(self, ev: list[TokenEvent]) -> None:
+        e, mgr = self.e, self.e.state_manager
+        stats = e.serving_stats
+        tel = self._tel
+        if not self.live and not self.infl:
+            self._carry = None
+            ids = self._boundary(ev)
+            if (not self.live and self.waiting and not self.staged
+                    and not ids):
+                self._handle_stuck(ev)
+            return
+
+        while self.live and len(self.infl) < self.depth:
+            if self._carry is None and self.infl:
+                # rebuild needs the in-flight dispatch's commits first —
+                # rebuilding from stale host state would replay its
+                # decode steps
+                break
+            if self._carry is None:
+                self._rowset = sorted(self.live)
+                self._budgets = {u: self.live[u].budget
+                                 for u in self._rowset}
+                (tok_a, pos_a, self._tables, act_a, rem_a,
+                 self._row_keys) = e._fused_operands(
+                     self._rowset, self.k, self._budgets, self.seed)
+                self._n_enq = 0
+            else:
+                tok_a, pos_a, act_a, rem_a = self._carry
+            # the first dispatch after a rebuild always goes; a chained
+            # one only when no admission is waiting and some row's
+            # budget can outlast the chain
+            if self._n_enq > 0 and (self.waiting
+                                    or max(self._budgets.values())
+                                    <= self.k * self._n_enq):
+                break
+            ops = (tok_a, pos_a, self._tables, act_a, rem_a,
+                   self._row_keys)
+            if tel is not None:
+                e._device_truth_observe(tel, "v2/fused_dispatch",
+                                        self.fn, ops)
+            with (tel.span("v2/fused_enqueue",
+                           dispatch_id=stats["fused_dispatches"] + 1,
+                           rows=len(self._rowset), k=self.k)
+                  if tel is not None else _NULLCM):
+                with e._fused_dispatch_scope(
+                        self._fn_key, ops,
+                        variant="carry" if self._n_enq > 0 else "host"):
+                    out, steps, t2, p2, a2, r2, e.pools = self.fn(
+                        e.params, e.pools, *ops)
+            self._carry = (t2, p2, a2, r2)
+            self._n_enq += 1
+            if not self.infl:
+                # chain start: clock drain intervals from here, so the
+                # first sample measures the chain, not the admission/
+                # prefill (or open-loop idle) time that preceded it
+                self._last_drain_t = time.perf_counter()
+            self.infl.append((list(self._rowset), out, steps))
+            stats["host_dispatches"] += 1
+            stats["fused_dispatches"] += 1
+
+        if not self.infl:       # chain declined to enqueue: rebuild
+            self._carry = None
+            return
+        # drain the OLDEST dispatch's ring buffer (device may still be
+        # running a newer chained one — that's the overlap)
+        rows, out, steps = self.infl.popleft()
+        t_drain = time.perf_counter() if tel is not None else 0.0
+        with (tel.span("v2/fused_drain", rows=len(rows))
+              if tel is not None else _NULLCM):
+            # the ONE sanctioned host read of the decode loop; under
+            # the sentinel it runs inside transfer_guard("disallow")
+            with (e._hot_guard() if e._hot_guard is not None
+                  else _NULLCM):
+                toks = np.asarray(out)
+                n_exec = int(steps)
+        stats["fused_steps"] += n_exec
+        stats["fused_slots"] += n_exec * len(rows)
+        now = time.perf_counter()
+        self.drain_stats.append((now - self._last_drain_t, n_exec))
+        self._last_drain_t = now
+        self.counters["chain_drains"] += 1
+        membership_changed = False
+        for i, u in enumerate(rows):
+            req = self.live.get(u)
+            if req is None:       # finished in an earlier dispatch
+                continue
+            row = [int(t) for t in toks[i] if t >= 0]
+            if not row:
+                continue
+            mgr.commit_device_tokens(u, row)
+            req.generated.extend(row)
+            stats["decoded_tokens"] += len(row)
+            stats["fused_slot_tokens"] += len(row)
+            if self._lat is not None:
+                self._lat.tokens(u, len(row))
+            if u not in self._cancelled:
+                ev.append(TokenEvent(u, row))
+            if (req.budget <= 0
+                    or (self.eos is not None and row[-1] == self.eos)
+                    or u in self._cancelled):
+                self._finish(u, ev)
+                membership_changed = True
+        if tel is not None:
+            e._record_dispatch_telemetry(tel, time.perf_counter()
+                                         - t_drain)
+        if membership_changed or self.waiting:
+            # a finished row's slot should go to a waiting prompt;
+            # rebuild operands once the in-flight chain drains
+            self._carry = None
+        if not self.infl:
+            # nothing in flight references the old tables/blocks: safe
+            # to recycle KV blocks and admit
+            self._boundary(ev)
+
+    def _handle_stuck(self, ev: list[TokenEvent]) -> None:
+        """Nothing live/in-flight and the head request did not admit."""
+        if self.strict:
+            raise RuntimeError(
+                "continuous-batching deadlock: pending prompts but "
+                "nothing admissible")
+        mgr = self.e.state_manager
+        if not mgr.seqs and self.waiting:
+            # the engine is empty and the head request STILL does not
+            # fit: it never will — fail it instead of spinning
+            req = self.waiting.pop(0)
+            ev.append(TokenEvent(
+                req.uid, [], finished=True,
+                error=f"request {req.uid} cannot fit the KV pool even "
+                      "with the engine idle"))
+
+    # ------------------------------------------------------------------
+    # ring mode: in-graph admission + one host read per chain
+    def _step_ring(self, ev: list[TokenEvent]) -> None:
+        e, mgr = self.e, self.e.state_manager
+        stats = e.serving_stats
+        tel = self._tel
+        ids = self._boundary(ev)
+        if not self.live and self.staged:
+            # every decode row finished while stage slots survived
+            # (e.g. the whole live set hit EOS in one chain): promote
+            # the staged requests — they are prefilled and reserved,
+            # i.e. valid decode rows
+            for uid in sorted(self.staged):
+                self.live[uid] = self.staged.pop(uid)
+        if not self.live:
+            if self.waiting and not ids:
+                self._handle_stuck(ev)
+            return
+        rowset = sorted(self.live)
+        budgets = {u: self.live[u].budget for u in rowset}
+        # one stage per row, bound to the rows most likely to free
+        # first (smallest remaining budget)
+        stage_map: dict[int, int] = {}
+        if self.staged:
+            by_budget = sorted(range(len(rowset)),
+                               key=lambda i: budgets[rowset[i]])
+            for i, su in zip(by_budget, sorted(self.staged)):
+                stage_map[i] = su
+        ops = self._serve_operands(rowset, budgets, stage_map)
+        (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
+         s_tok, s_pos, s_rem, s_keys, s_tab, s_valid,
+         ring, ring_ep, ring_ptr) = ops
+        # chain length from the max remaining budget (staged occupant
+        # included). With eos_id set, rows may terminate early and the
+        # tail dispatches of a chain become device no-ops (the
+        # while_loop exits at step 0) — the launches still count in
+        # host_dispatches, the honest price of speculative chaining;
+        # EOS-heavy traffic should run a smaller chain depth. Checking
+        # liveness before each launch would cost the per-dispatch host
+        # sync this path exists to remove.
+        eff = max(budgets[rowset[i]]
+                  + (self.staged[stage_map[i]].budget
+                     if i in stage_map else 0)
+                  for i in range(len(rowset)))
+        chain_len = max(1, min(self.depth, -(-eff // self.k)))
+        if self.waiting:
+            # un-staged prompts are waiting for a host-side admission:
+            # keep the chain short so they are not starved
+            chain_len = 1
+        carry = (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
+                 s_valid)
+        # chain start: clock the drain interval from the first enqueue
+        # (admission/prefill/idle time must not pollute tick stats)
+        self._last_drain_t = time.perf_counter()
+        for j in range(chain_len):
+            (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
+             s_valid) = carry
+            dis_ops = (tok_a, pos_a, tables, act_a, rem_a, row_keys,
+                       epoch, s_tok, s_pos, s_rem, s_keys, s_tab,
+                       s_valid, ring, ring_ep, ring_ptr)
+            if tel is not None:
+                e._device_truth_observe(tel, "v2/fused_dispatch",
+                                        self.fn, dis_ops)
+            with (tel.span("v2/fused_enqueue",
+                           dispatch_id=stats["fused_dispatches"] + 1,
+                           rows=len(rowset), k=self.k)
+                  if tel is not None else _NULLCM):
+                with e._fused_dispatch_scope(
+                        self._fn_key, dis_ops,
+                        variant="carry" if j > 0 else "host"):
+                    (ring, ring_ep, ring_ptr, t2, p2, a2, r2, k2, tb2,
+                     ep2, sv2, e.pools) = self.fn(
+                        e.params, e.pools, *dis_ops)
+            carry = (t2, p2, tb2, a2, r2, k2, ep2, sv2)
+            stats["host_dispatches"] += 1
+            stats["fused_dispatches"] += 1
+        self._drain_ring(ev, rowset, stage_map, ring, ring_ep, ring_ptr,
+                         carry[6])
+
+    def _drain_ring(self, ev, rowset, stage_map, ring, ring_ep,
+                    ring_ptr, epoch_final) -> None:
+        """ONE host read for the whole chain: ring tokens + epochs +
+        final per-row epoch, attributed to each row's occupant
+        timeline (epoch 0 = the row's original uid, epoch 1 = its
+        staged request, swapped in in-graph)."""
+        e, mgr, tel = self.e, self.e.state_manager, self._tel
+        stats = e.serving_stats
+        t_drain = time.perf_counter() if tel is not None else 0.0
+        with (tel.span("v2/fused_drain", rows=len(rowset))
+              if tel is not None else _NULLCM):
+            with (e._hot_guard() if e._hot_guard is not None
+                  else _NULLCM):
+                # ONE blocking pull for the whole chain (four separate
+                # np.asarray calls would pay the host<->device RTT
+                # once each — exactly the cost this path removes)
+                toks, eps, n_cols, ep_fin = jax.device_get(
+                    (ring, ring_ep, ring_ptr, epoch_final))
+                n_cols = int(n_cols)
+        stats["fused_steps"] += n_cols
+        stats["fused_slots"] += n_cols * len(rowset)
+        now = time.perf_counter()
+        self.drain_stats.append((now - self._last_drain_t, n_cols))
+        self._last_drain_t = now
+        self.counters["chain_drains"] += 1
+        for i, u0 in enumerate(rowset):
+            owners = [u0] + ([stage_map[i]] if i in stage_map else [])
+            for e_idx, uid in enumerate(owners):
+                seg = [int(t) for t, ep in zip(toks[i, :n_cols],
+                                               eps[i, :n_cols])
+                       if ep == e_idx and t >= 0]
+                staged = e_idx > 0
+                req = (self.staged if staged else self.live).get(uid)
+                if req is None or not seg:
+                    continue
+                mgr.commit_device_tokens(uid, seg)
+                req.generated.extend(seg)
+                stats["decoded_tokens"] += len(seg)
+                stats["fused_slot_tokens"] += len(seg)
+                if self._lat is not None:
+                    self._lat.tokens(uid, len(seg))
+                if uid not in self._cancelled:
+                    ev.append(TokenEvent(uid, seg))
+                if staged and int(ep_fin[i]) >= 1:
+                    # the stage was consumed in-graph: the request now
+                    # owns the row
+                    self.live[uid] = self.staged.pop(uid)
+                if (req.budget <= 0
+                        or (self.eos is not None and seg[-1] == self.eos)
+                        or uid in self._cancelled):
+                    self._finish(uid, ev)
+        if tel is not None:
+            e._record_dispatch_telemetry(tel,
+                                         time.perf_counter() - t_drain)
+
+    def _serve_operands(self, rowset: list[int],
+                        budgets: dict[int, int],
+                        stage_map: dict[int, int]):
+        """Host-side build of a ring-mode chain's operands: the PR 1
+        fused operands (via the engine's own ``_fused_operands`` —
+        pending==1 checks, reserve, bucketing, sentinel-padded key rows
+        all shared) plus per-row staged token/position/budget/key/table
+        operands and the zeroed output ring. Block tables are widened
+        to ONE joint power-of-two width covering live AND staged rows
+        (a staged table truncated below its own block count would
+        silently clamp in-graph KV writes)."""
+        from .engine_v2 import _bucket
+        e, mgr, k = self.e, self.e.state_manager, self.k
+        (tok_a, pos_a, tables, act_a, rem_a,
+         row_keys) = e._fused_operands(rowset, k, budgets, self.seed)
+        seqs = [mgr.seqs[u] for u in rowset]
+        bb = int(tok_a.shape[0])
+        epoch = np.zeros((bb,), np.int32)
+        s_tok = np.zeros((bb,), np.int32)
+        s_pos = np.zeros((bb,), np.int32)
+        s_rem = np.zeros((bb,), np.int32)
+        s_valid = np.zeros((bb,), bool)
+        max_blocks = max(len(s.blocks) for s in seqs)
+        stage_tables: dict[int, np.ndarray] = {}
+        for i, su in stage_map.items():
+            sq = mgr.seqs[su]
+            if sq.pending != 1:
+                raise RuntimeError(
+                    f"fused serve: staged sequence {su} must have "
+                    f"exactly one pending token, got {sq.pending}")
+            s_tok[i] = sq.tokens[-1]
+            s_pos[i] = sq.seen
+            s_rem[i] = self.staged[su].budget
+            s_valid[i] = s_rem[i] > 0
+            stage_tables[i] = mgr.block_table(sq)
+            max_blocks = max(max_blocks, len(sq.blocks))
+        kb = min(_bucket(max(max_blocks, 1)), mgr.max_blocks_per_seq)
+        if kb > tables.shape[1]:
+            # a staged sequence holds more blocks than the live rows:
+            # re-stack at the joint width (narrower would clamp its
+            # in-graph writes onto the wrong block)
+            t_np = np.stack([mgr.block_table(s) for s in seqs]
+                            + [mgr.block_table(seqs[0])]
+                            * (bb - len(seqs)))
+            tables = jnp.asarray(t_np[:, :kb])
+        else:
+            kb = tables.shape[1]
+        fallback = np.full((mgr.max_blocks_per_seq,),
+                           mgr.allocator.num_blocks, np.int32)
+        s_tab = np.stack([stage_tables.get(i, fallback)
+                          for i in range(bb)])[:, :kb]
+        base = e._base_key(self.seed)
+        s_ids = jnp.asarray(np.asarray(
+            [stage_map.get(i, (1 << 30) + bb + i) for i in range(bb)],
+            np.uint32))
+        s_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(s_ids)
+        ring = np.full((bb, self.ring_cap), -1, np.int32)
+        return (tok_a, pos_a, tables, act_a, rem_a, row_keys,
+                jnp.asarray(epoch), jnp.asarray(s_tok),
+                jnp.asarray(s_pos), jnp.asarray(s_rem), s_keys,
+                jnp.asarray(s_tab), jnp.asarray(s_valid),
+                jnp.asarray(ring), jnp.asarray(ring),
+                jnp.asarray(0, jnp.int32))

@@ -131,6 +131,7 @@ class CometMonitor(Monitor):
 # give the denominators; the prefix_* set (ISSUE 4) charts cache
 # hit rate, prefill tokens saved, and eviction/occupancy pressure
 SERVING_METRIC_KEYS = ("dispatches_per_token", "fused_occupancy",
+                       "max_inflight_dispatches",
                        "decoded_tokens", "host_dispatches",
                        "fused_dispatches", "fused_steps",
                        "prefix_hit_rate", "prefix_hits", "prefix_misses",

@@ -1,0 +1,7 @@
+"""Async continuous-batching serving layer (ISSUE 6) — the
+FastGen/DeepSpeed-MII front end over inference v2 (see
+docs/serving.md)."""
+
+from .config import ServingConfig  # noqa: F401
+from .server import (AsyncInferenceServer, RequestCancelled,  # noqa: F401
+                     RequestFailed, RequestHandle)

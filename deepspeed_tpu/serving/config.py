@@ -1,0 +1,49 @@
+"""Serving front-end configuration (ISSUE 6; the deepspeed_tpu
+analogue of DeepSpeed-MII's serving deployment config)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class ServingConfig(DeepSpeedConfigModel):
+    """Async continuous-batching server over ``InferenceEngineV2``
+    (``deepspeed_tpu.serving.AsyncInferenceServer``). Engine-level
+    scheduling knobs — fused K, dispatch-chain depth
+    (``max_inflight_dispatches``), in-graph admission
+    (``fused_admission``), KV pool sizing, prefix caching — live on
+    ``RaggedInferenceEngineConfig``; this block configures the request
+    front end sitting above it. See docs/serving.md."""
+
+    # per-request default when submit() does not specify one
+    default_max_new_tokens: int = Field(128, ge=1)
+    # default priority tier for submit(); LOWER values run first.
+    # Tiers are relative — any ints work (0 = interactive, 1 = default,
+    # 2 = batch is the documented convention).
+    default_priority: int = 1
+    # upper bound on requests open at once (queued + running);
+    # submit() past it raises. 0 = unbounded.
+    max_queue: int = Field(0, ge=0)
+    # preemption: a higher-priority prompt that cannot be admitted may
+    # PARK strictly-lower-priority running requests — KV blocks swap
+    # out (prefix-cached full blocks stay warm in the LRU), the token
+    # history is retained host-side, and the victim resumes later
+    # position-exactly.
+    preemption: bool = True
+    # fused decode steps per dispatch for the serving loop; None =
+    # the engine config's fused_decode_steps
+    k_steps: Optional[int] = None
+    # sampling overrides for the whole server; None = engine defaults
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    eos_token_id: Optional[int] = None
+    # base PRNG seed for stochastic sampling (position-keyed, so
+    # restarts/preemptions resume the same stream)
+    seed: int = 0
+    # worker-thread sleep while idle or waiting on admission headroom
+    idle_poll_s: float = Field(0.002, gt=0.0)

@@ -1,0 +1,313 @@
+"""Async continuous-batching serving front end (ISSUE 6 tentpole b) —
+the FastGen/MII serving layer over ``InferenceEngineV2``.
+
+``AsyncInferenceServer`` runs the shared scheduler
+(:class:`~..inference.v2.serve_loop.FusedServeLoop` — the same driver
+``generate_fused`` uses closed-loop) on a dedicated worker thread and
+exposes an asyncio surface:
+
+- ``await server.submit(prompt, ...)`` returns a
+  :class:`RequestHandle` that async-iterates the request's tokens as
+  the drain thread lands them (per-request streaming);
+- priority tiers (lower value = runs first) with optional PREEMPTION:
+  a high-priority prompt that cannot be admitted parks
+  strictly-lower-priority running requests — their KV blocks swap out
+  through the ref-counted allocator (prefix-cached full blocks stay
+  warm in the LRU), their token history stays host-side, and they
+  resume position-exactly;
+- ``handle.cancel()`` mid-stream releases the request's KV blocks at
+  the next dispatch boundary (no leak);
+- TTFT/ITL histograms, queue-depth gauges and scheduler counters flow
+  through the telemetry registry, and each scheduler step heartbeats
+  the flight recorder, so a wedged serving loop leaves a dump behind.
+
+The worker thread owns every engine/JAX call; asyncio-side methods only
+exchange messages with it (a mailbox + wake event), so the event loop
+never blocks on device work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Optional, Sequence
+
+from ..inference.v2.serve_loop import (LOOP_COUNTER_KEYS, FusedServeLoop,
+                                       TokenEvent)
+from ..utils.logging import log_dist
+from ..utils.telemetry_probe import active_telemetry as _telemetry
+from .config import ServingConfig
+
+_DONE = object()
+
+
+class RequestCancelled(Exception):
+    """Raised by the stream iterator of a cancelled request."""
+
+
+class RequestFailed(Exception):
+    """Raised by the stream iterator when the scheduler rejected the
+    request (e.g. a prompt that can never fit the KV pool)."""
+
+
+class RequestHandle:
+    """Per-request streaming handle: ``async for tok in handle`` yields
+    int token ids as they decode; ``await handle.tokens()`` collects
+    the full generation. Created by
+    :meth:`AsyncInferenceServer.submit`."""
+
+    def __init__(self, uid: int, server: "AsyncInferenceServer"):
+        self.uid = uid
+        self._server = server
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._buf: deque = deque()
+        self._finished = False
+        self.error: Optional[str] = None
+        self.submitted_at = time.perf_counter()
+
+    # worker -> event loop (always via call_soon_threadsafe)
+    def _push(self, evt: TokenEvent) -> None:
+        if evt.tokens:
+            self._q.put_nowait(list(evt.tokens))
+        if evt.finished:
+            self.error = evt.error
+            self._q.put_nowait(_DONE)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> int:
+        while not self._buf:
+            if self._finished:
+                raise StopAsyncIteration
+            item = await self._q.get()
+            if item is _DONE:
+                self._finished = True
+                if self.error == "cancelled":
+                    raise RequestCancelled(f"request {self.uid}")
+                if self.error:
+                    raise RequestFailed(self.error)
+                raise StopAsyncIteration
+            self._buf.extend(item)
+        return self._buf.popleft()
+
+    async def tokens(self) -> list[int]:
+        """Collect the remaining stream into one list."""
+        return [t async for t in self]
+
+    def cancel(self) -> None:
+        """Drop the request; its KV blocks are released at the next
+        dispatch boundary. The stream raises
+        :class:`RequestCancelled`."""
+        self._server._post(("cancel", self.uid))
+
+
+class AsyncInferenceServer:
+    """See module docstring. Typical use::
+
+        engine = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            fused_admission=True, max_inflight_dispatches=4, ...))
+        async with AsyncInferenceServer(engine) as server:
+            h = await server.submit(prompt_ids, max_new_tokens=256)
+            async for tok in h:
+                ...
+    """
+
+    def __init__(self, engine, config=None):
+        if config is None:
+            config = ServingConfig()
+        elif isinstance(config, dict):
+            config = ServingConfig(**config)
+        self.engine = engine
+        self.config = config
+        self._uid = itertools.count()
+        self._handles: dict[int, RequestHandle] = {}
+        self._mailbox: list[tuple] = []
+        self._mail_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._aloop: Optional[asyncio.AbstractEventLoop] = None
+        self._accepting = False
+        self._stopping = False
+        self._open = 0          # queued + running requests
+        self._worker_error: Optional[BaseException] = None
+        self.session: Optional[FusedServeLoop] = None
+
+    # ------------------------------------------------------------------
+    async def __aenter__(self):
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.stop(drain=exc[0] is None)
+
+    async def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        cfg = self.config
+        self._aloop = asyncio.get_running_loop()
+        self.session = FusedServeLoop(
+            self.engine, k_steps=cfg.k_steps,
+            temperature=cfg.temperature, top_k=cfg.top_k,
+            top_p=cfg.top_p, eos_id=cfg.eos_token_id, seed=cfg.seed,
+            strict=False, preemption=cfg.preemption)
+        self._accepting = True
+        self._stopping = False
+        self._thread = threading.Thread(target=self._work, daemon=True,
+                                        name="ds-serving-loop")
+        self._thread.start()
+        log_dist("AsyncInferenceServer: serving loop started "
+                 f"(k={self.session.k}, chain depth "
+                 f"{self.session.depth}, "
+                 f"{'ring' if self.session.ring_mode else 'chain'} mode)")
+
+    async def stop(self, drain: bool = True) -> None:
+        """Shut the serving loop down. ``drain=True`` finishes the
+        requests already submitted first; ``drain=False`` cancels
+        them."""
+        if self._thread is None:
+            return
+        self._accepting = False
+        if not drain:
+            for h in list(self._handles.values()):
+                h.cancel()
+        self._post(("stop",))
+        await self._aloop.run_in_executor(None, self._thread.join)
+        self._thread = None
+        if self._worker_error is not None:
+            raise self._worker_error
+
+    async def submit(self, prompt: Sequence[int], *,
+                     max_new_tokens: Optional[int] = None,
+                     priority: Optional[int] = None) -> RequestHandle:
+        """Queue one generation request; returns its streaming handle.
+        Raises when the server is stopped or ``max_queue`` is hit."""
+        if not self._accepting:
+            raise RuntimeError("server is not accepting requests")
+        if self._worker_error is not None:
+            raise RuntimeError(
+                "serving loop died") from self._worker_error
+        cfg = self.config
+        if cfg.max_queue and self._open >= cfg.max_queue:
+            raise RuntimeError(
+                f"serving queue full ({self._open} open requests >= "
+                f"max_queue {cfg.max_queue})")
+        uid = next(self._uid)
+        handle = RequestHandle(uid, self)
+        self._handles[uid] = handle
+        self._open += 1
+        self._post(("submit", uid, [int(t) for t in prompt],
+                    int(max_new_tokens if max_new_tokens is not None
+                        else cfg.default_max_new_tokens),
+                    int(priority if priority is not None
+                        else cfg.default_priority)))
+        return handle
+
+    async def generate(self, prompt: Sequence[int], **kw) -> list[int]:
+        """submit() + collect the full stream."""
+        h = await self.submit(prompt, **kw)
+        return await h.tokens()
+
+    def metrics(self) -> dict:
+        """Engine serving counters merged with the scheduler's
+        (preemptions/restores/cancellations/admitted/chain_drains) and
+        the open-request gauge."""
+        m = dict(self.engine.serving_metrics())
+        if self.session is not None:
+            m.update(self.session.counters)
+        m["open_requests"] = self._open
+        return m
+
+    # ------------------------------------------------------------------
+    def _post(self, msg: tuple) -> None:
+        with self._mail_lock:
+            self._mailbox.append(msg)
+        self._wake.set()
+
+    def _emit(self, events: list[TokenEvent]) -> None:
+        """Worker -> event loop handoff (one call per step). All
+        ``_open``/handle mutation happens on the event-loop thread
+        (submit() runs there too), so the counter needs no lock."""
+
+        def deliver(evts=list(events)):
+            for e in evts:
+                h = self._handles.get(e.uid)
+                if h is not None:
+                    h._push(e)
+                if e.finished:
+                    self._handles.pop(e.uid, None)
+                    self._open -= 1
+
+        self._aloop.call_soon_threadsafe(deliver)
+
+    def _work(self) -> None:
+        """Worker thread: owns the session and every engine/JAX call."""
+        s = self.session
+        cfg = self.config
+        try:
+            while True:
+                stop = self._drain_mailbox(s)
+                if stop and not s.has_work():
+                    break
+                if not s.has_work():
+                    self._wake.wait(timeout=0.1)
+                    self._wake.clear()
+                    continue
+                events = s.step()
+                self._observe(s)
+                if events:
+                    self._emit(events)
+                elif s.has_work():
+                    # waiting on admission headroom (or another engine
+                    # user): back off instead of spinning
+                    time.sleep(cfg.idle_poll_s)
+        except BaseException as e:   # noqa: BLE001 — surfaced on stop()
+            self._worker_error = e
+            self._accepting = False
+            fail = [TokenEvent(uid, [], finished=True,
+                               error=f"serving loop died: {e}")
+                    for uid in list(self._handles)]
+            if fail:
+                self._emit(fail)
+        finally:
+            try:
+                s.close()
+            except Exception:   # noqa: BLE001 — shutdown best-effort
+                pass
+
+    def _drain_mailbox(self, s: FusedServeLoop) -> bool:
+        with self._mail_lock:
+            msgs, self._mailbox = self._mailbox, []
+        stop = self._stopping
+        for m in msgs:
+            if m[0] == "submit":
+                _, uid, prompt, max_new, prio = m
+                s.submit(prompt, max_new, priority=prio, uid=uid)
+            elif m[0] == "cancel":
+                s.cancel(m[1])
+            elif m[0] == "stop":
+                stop = self._stopping = True
+        return stop
+
+    def _observe(self, s: FusedServeLoop) -> None:
+        """Per-step telemetry: scheduler counters -> registry, plus a
+        flight-recorder heartbeat so a wedged loop leaves forensics."""
+        tel = _telemetry()
+        if tel is None:
+            return
+        fr = tel.get_flight_recorder()
+        if fr is not None:
+            fr.progress("serving_loop")
+        reg = tel.get_registry()
+        if reg is None:
+            return
+        for key in LOOP_COUNTER_KEYS:
+            reg.counter(f"ds_serving_{key}_total",
+                        f"serving scheduler counter {key}").set_total(
+                s.counters[key], engine="v2")
+        reg.gauge("ds_serving_open_requests",
+                  "requests open on the async server "
+                  "(queued + running)").set(self._open, engine="v2")

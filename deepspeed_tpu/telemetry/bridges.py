@@ -134,6 +134,7 @@ def collect_comms(reg: MetricsRegistry, comms_logger=None) -> None:
 _SERVING_COUNTERS_BASE = ("decoded_tokens", "host_dispatches",
                           "fused_dispatches", "fused_steps")
 _SERVING_GAUGES = ("dispatches_per_token", "fused_occupancy",
+                   "max_inflight_dispatches",
                    "prefix_hit_rate", "prefix_cached_blocks",
                    "prefix_evictable_blocks")
 

@@ -145,6 +145,12 @@ _SLOW = {
     # regression stay tier-1; these engine-heavy variants have cheaper
     # siblings there (the fused parity test covers the same cache
     # admission path as the per-tick one)
+    # serving (ISSUE 6): the server-vs-generate_fused parity, priority,
+    # preemption, cancel-leak and ring greedy-parity tests stay tier-1;
+    # these multi-engine ring-mode wrinkle sweeps are the heavy tail
+    ("test_serving.py",
+     "test_ring_mode_eos_swap_constrained_and_stochastic"),
+    ("test_serving.py", "test_ring_mode_in_graph_swap_occupies_slot"),
     ("test_prefix_cache.py",
      "test_schedule_admission_counts_only_uncached_blocks"),
     ("test_prefix_cache.py", "test_serving_metrics_schema_and_reset"),

@@ -17,6 +17,14 @@ Usage::
     python tools/telemetry_report.py --diff A.json B.json \
         [--threshold 0.05]
 
+    # serving regression gate (ISSUE 6 CI wiring): compare the current
+    # bench artifact against the previous one, gating ONLY the serving
+    # SLO families (tick_p50_ms, dispatches_per_token, TTFT/ITL p99,
+    # tokens_per_sec, fused_occupancy) under per-metric direction-aware
+    # thresholds; exit 1 on regression
+    python tools/telemetry_report.py --diff BENCH_prev.json \
+        BENCH_curr.json --gate serving
+
 Reads the Chrome-trace JSON written by
 ``telemetry.export_artifacts()`` (or any Chrome-trace file with ``X``
 events) and prints a per-span-name table — count, total/mean/max ms,
@@ -264,6 +272,48 @@ _HIGHER_IS_BETTER = ("tokens_per_sec", "samples_per_second", "mfu",
                      "tflops", "hit_rate", "occupancy", "throughput",
                      "headroom", "/value")
 
+# --gate serving (ISSUE 6): the serving regression gate CI runs against
+# the previous bench artifact (BENCH_r*.json or a telemetry
+# .metrics.json snapshot). Only metrics matching these substrings
+# participate, each with its own direction (+1 higher-is-better) and
+# relative threshold — the serving-SLO numbers get tighter gates than
+# the generic --threshold sweep.
+_GATES = {
+    "serving": (
+        ("fused_tick_p50_ms", -1, 0.10),
+        ("tick_p50_ms", -1, 0.10),
+        ("tick_vs_compute_ratio", -1, 0.10),
+        ("dispatches_per_token", -1, 0.05),
+        ("ttft_p99", -1, 0.15),
+        ("ttft_seconds", -1, 0.15),
+        ("itl_p99", -1, 0.15),
+        ("itl_seconds", -1, 0.15),
+        ("tokens_per_sec", +1, 0.05),
+        ("fused_occupancy", +1, 0.05),
+    ),
+}
+
+# metric families a gate must NOT touch even though a stem matches by
+# substring: the host-in-loop per-tick scheduler figures ride the dev
+# tunnel RTT (serve7b `per_tick_p50_ms`, serving `v2_tick_p50_ms`) and
+# would flap the gate on dispatch-path jitter unrelated to the engine.
+_GATE_EXCLUDE = {
+    "serving": ("per_tick", "v2_tick"),
+}
+
+
+def _gate_rule(name: str, gate: str):
+    """(direction, threshold) for a gated metric, or None when the
+    metric does not participate in this gate. First match wins —
+    order the table most-specific-first."""
+    low = name.lower()
+    if any(excl in low for excl in _GATE_EXCLUDE.get(gate, ())):
+        return None
+    for stem, direction, threshold in _GATES[gate]:
+        if stem in low:
+            return direction, threshold
+    return None
+
 
 def _flatten_numeric(obj, prefix="") -> dict[str, float]:
     """Any JSON document -> {path: number} over numeric leaves (bool
@@ -295,10 +345,13 @@ def _direction(name: str) -> int:
 
 
 def diff_snapshots(path_a: str, path_b: str,
-                   threshold: float = 0.05) -> dict:
+                   threshold: float = 0.05,
+                   gate: str | None = None) -> dict:
     """Compare two metric snapshots (A = baseline, B = candidate).
     Returns {rows, regressions, added, removed}; a row regresses when
-    its direction-aware relative change exceeds ``threshold``."""
+    its direction-aware relative change exceeds ``threshold``. With
+    ``gate`` (e.g. ``"serving"``) only the gate's metric families
+    participate, each under its own per-metric threshold."""
     with open(path_a) as f:
         a = _flatten_numeric(json.load(f))
     with open(path_b) as f:
@@ -306,21 +359,28 @@ def diff_snapshots(path_a: str, path_b: str,
     rows, regressions = [], []
     for name in sorted(set(a) & set(b)):
         va, vb = a[name], b[name]
+        if gate is not None:
+            rule = _gate_rule(name, gate)
+            if rule is None:
+                continue
+            direction, row_threshold = rule
+        else:
+            direction, row_threshold = _direction(name), threshold
         rel = (vb - va) / abs(va) if va else (0.0 if vb == va
                                              else float("inf"))
-        direction = _direction(name)
         regressed = bool(
-            direction == +1 and rel < -threshold
-            or direction == -1 and rel > threshold)
+            direction == +1 and rel < -row_threshold
+            or direction == -1 and rel > row_threshold)
         row = {"metric": name, "a": va, "b": vb, "rel": rel,
-               "direction": direction, "regressed": regressed}
+               "direction": direction, "threshold": row_threshold,
+               "regressed": regressed}
         rows.append(row)
         if regressed:
             regressions.append(row)
     return {"rows": rows, "regressions": regressions,
             "added": sorted(set(b) - set(a)),
             "removed": sorted(set(a) - set(b)),
-            "threshold": threshold}
+            "threshold": threshold, "gate": gate}
 
 
 def print_diff(diff: dict) -> None:
@@ -338,7 +398,10 @@ def print_diff(diff: dict) -> None:
     for name in diff["added"]:
         print(f"{name[:57]:<58}{'-':>13}{'':>13}{'':>9}  added")
     n = len(diff["regressions"])
-    print(f"\n{n} regression(s) past ±{diff['threshold'] * 100:.1f}% "
+    scope = (f"gate '{diff['gate']}' (per-metric thresholds)"
+             if diff.get("gate")
+             else f"±{diff['threshold'] * 100:.1f}%")
+    print(f"\n{n} regression(s) past {scope} "
           f"over {len(diff['rows'])} shared metrics")
 
 
@@ -361,6 +424,12 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative regression threshold for --diff "
                          "(default 0.05)")
+    ap.add_argument("--gate", choices=sorted(_GATES), default=None,
+                    help="restrict --diff to a named gate's metric "
+                         "families with per-metric direction-aware "
+                         "thresholds (e.g. 'serving': tick_p50_ms, "
+                         "dispatches_per_token, TTFT/ITL p99, "
+                         "tokens_per_sec); exit 1 on regression")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")
     args = ap.parse_args(argv)
@@ -377,7 +446,7 @@ def main(argv=None) -> int:
         if len(args.paths) != 2:
             ap.error("--diff needs exactly two snapshot paths: A B")
         diff = diff_snapshots(args.paths[0], args.paths[1],
-                              threshold=args.threshold)
+                              threshold=args.threshold, gate=args.gate)
         if args.json:
             json.dump(diff, sys.stdout)
             print()
