@@ -1305,6 +1305,90 @@ def offload_smoke(ds, on_tpu: bool):
     return out
 
 
+def autotune_bench(ds, on_tpu: bool):
+    """Planner stage (ISSUE 7): run the ledger-driven autotuner on the
+    headline training config — calibrate effective FLOPs/s on the
+    hand-tuned base, AOT-rank the mesh x microbatch x ZeRO x remat grid
+    without dispatching a step, measure the top-3, and report the
+    chosen plan next to its prediction error and the baseline
+    throughput. Plan artifact: artifacts/autotune_plan.json (render
+    with tools/autotune_report.py); gate with
+    ``telemetry_report --diff --gate autotune``."""
+    import gc
+
+    from deepspeed_tpu.autotuning import (AutotuningConfig, Planner,
+                                          summarize)
+    from deepspeed_tpu.models import GPT2
+
+    seq = 1024 if on_tpu else 64
+    mb = 8 if on_tpu else 2
+    model = (GPT2(size="125m", vocab_size=50304,
+                  remat_policy="segments", attn_impl="flash")
+             if on_tpu else GPT2(size="tiny", max_seq_len=seq))
+    # the hand-tuned headline-stage config is the baseline the chosen
+    # plan must beat (or match: it is itself a grid point)
+    base = {
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,
+    }
+
+    def make_batch(total):
+        tokens = jax.random.randint(jax.random.PRNGKey(0),
+                                    (total, seq + 1), 0,
+                                    model.config.vocab_size)
+        return tokens[:, :-1], tokens[:, 1:]
+
+    cfg = AutotuningConfig(
+        enabled=True,
+        min_train_micro_batch_size_per_gpu=mb,
+        num_tuning_micro_batch_sizes=3,
+        zero_stages=[0, 1, 2, 3],
+        calibration_steps=4 if on_tpu else 3,
+        start_step=2, end_step=5,
+        measure_windows=3,
+        measure_top_k=3)
+    planner = Planner(model, base, cfg, make_batch=make_batch)
+    plan = planner.plan()
+    os.makedirs("artifacts", exist_ok=True)
+    path = plan.save(os.path.join("artifacts", "autotune_plan.json"))
+    out = summarize(plan)
+    # the acceptance metric is prediction error over the measured
+    # TOP-K; the base candidate is also measured (for the baseline
+    # ratio below) but its short mb-2 steps are the noisiest — keep
+    # its error in the _all figure, not the gated one
+    errs_top = [c["prediction_rel_err"] for c in plan.ranked()
+                if c.get("prediction_rel_err") is not None
+                and c.get("rank", 99) <= cfg.measure_top_k]
+    if errs_top:
+        if "prediction_rel_err" in out:
+            out["prediction_rel_err_all"] = out["prediction_rel_err"]
+        out["prediction_rel_err"] = round(max(errs_top), 4)
+    out["plan_path"] = path
+    out["calibration_flops_per_s"] = round(
+        plan.calibration.get("flops_per_s", 0.0), 1)
+    # calibration point 1 IS the hand-tuned base config: its measured
+    # throughput is the baseline the chosen plan is compared against
+    log = planner.trial_log
+    if log:
+        out["baseline_tokens_per_sec"] = round(log[0]["tokens_per_sec"],
+                                               1)
+        if out.get("plan_tokens_per_sec"):
+            out["plan_vs_baseline"] = round(
+                out["plan_tokens_per_sec"]
+                / out["baseline_tokens_per_sec"], 4)
+    out["config_diff"] = {k: v for k, v in plan.diff().items()
+                          if not k.startswith("train_batch_size")}
+    del planner, plan
+    gc.collect()
+    return out
+
+
 def headline_bench(ds, on_tpu: bool):
     """The stdout-JSON stage: GPT-2 125M training throughput."""
     from deepspeed_tpu.models import GPT2
@@ -1470,6 +1554,7 @@ STAGES = [("headline", headline_bench),
           ("serve_openloop", serve_openloop_bench),
           ("moe_serving", moe_serving_bench),
           ("offload", offload_smoke),
+          ("autotune", autotune_bench),
           ("domino", domino_bench),
           ("kernel_smoke", lambda *_: kernel_smoke()),
           ("serve7b", serve7b_int8),

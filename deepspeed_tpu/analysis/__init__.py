@@ -21,5 +21,6 @@ paying a jax import; reach sentinels via
 
 from .core import Finding  # noqa: F401
 from .linter import (apply_baseline, diff_against_baseline,  # noqa: F401
-                     format_text, lint_paths, load_baseline, save_baseline)
+                     format_text, lint_paths, load_baseline,
+                     save_baseline, traced_roots)
 from .rules import ALL_RULES, RULES_BY_ID  # noqa: F401

@@ -138,6 +138,48 @@ def lint_paths(paths: Sequence[str], *,
     return result
 
 
+def traced_roots(paths: Sequence[str], *,
+                 root: Optional[str] = None) -> list[dict]:
+    """Host-only-package audit (ISSUE 7 satellite): every function in
+    the given files/trees that is jit-REACHABLE from tracing inside
+    those same files — ``[{path, name, line}]``, empty when the code is
+    pure host. Planner/cost-model packages (``autotuning/``) must stay
+    empty: a planner that traces its own scoring code would bake
+    wall-clock-dependent host state into an executable and break the
+    deterministic-ranking contract (see docs/static-analysis.md,
+    GL041 catalog notes). The traced-name registry is built over the
+    AUDITED file set only (a jit in module A of functions defined in
+    sibling module B counts) — unlike :func:`lint_paths`'s repo-wide
+    pass, names jitted *elsewhere* in the repo are not violations of
+    this package's contract, only tracing the package does itself."""
+    import ast
+    sources: dict[str, str] = {}
+    traced_names: set[str] = set()
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[path] = f.read()
+        except OSError:
+            continue
+        try:
+            traced_names |= collect_traced_names(
+                ast.parse(sources[path]))
+        except SyntaxError:
+            continue
+    out: list[dict] = []
+    for path, source in sources.items():
+        try:
+            index = ModuleIndex(_relpath(path, root), source,
+                                external_traced_names=traced_names)
+        except SyntaxError:
+            continue
+        for info in index.reachable_functions():
+            out.append({"path": index.path, "name": info.name,
+                        "line": getattr(info.node, "lineno", 0)})
+    out.sort(key=lambda r: (r["path"], r["line"]))
+    return out
+
+
 # --------------------------------------------------------------------
 # baseline
 # --------------------------------------------------------------------

@@ -1,7 +1,16 @@
-"""Autotuning (reference: deepspeed/autotuning/)."""
+"""Autotuning (reference: deepspeed/autotuning/), rebuilt as the
+ledger-driven planner subsystem (ISSUE 7): device-truth cost model
+(:mod:`.cost_model`), deterministic candidate search with AOT ranking
+(:mod:`.planner`), and the plan artifact + apply (:mod:`.plan`). The
+reference-shaped measured-trial :class:`Autotuner` and tuners remain
+for the classic stage x microbatch grid."""
 
 from .autotuner import (Autotuner, ResourceManager,  # noqa: F401
                         memory_per_device, model_info_profile)
 from .config import AutotuningConfig  # noqa: F401
+from .cost_model import (AOTFacts, Calibration, CostModel,  # noqa: F401
+                         MemoryModel, hbm_headroom_bytes)
+from .plan import Plan, summarize  # noqa: F401
+from .planner import Candidate, Planner, mesh_factorizations  # noqa: F401
 from .tuner import (BaseTuner, GridSearchTuner, ModelBasedTuner,  # noqa: F401
                     RandomTuner)

@@ -34,10 +34,12 @@ from ..utils.logging import logger
 from .config import (METRIC_FLOPS, METRIC_LATENCY, METRIC_THROUGHPUT,
                      TUNER_GRIDSEARCH, TUNER_MODELBASED, TUNER_RANDOM,
                      AutotuningConfig)
+from .cost_model import ADAM_STATE_BYTES, MemoryModel  # noqa: F401
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
-ADAM_STATE_BYTES = 16  # fp32 master + 2 fp32 moments per param
-OVERHEAD = 1.3         # activation/fragmentation headroom factor
+OVERHEAD = 1.1         # fragmentation safety factor; activations are
+#                        modeled explicitly now (MemoryModel), not
+#                        absorbed into a fudge factor
 
 
 def model_info_profile(model) -> dict[str, Any]:
@@ -51,16 +53,30 @@ def model_info_profile(model) -> dict[str, Any]:
 
 
 def memory_per_device(num_params: int, stage: int, world: int,
-                      bytes_per_el: int = 2) -> int:
-    """Bytes/device for a ZeRO stage (see module docstring table)."""
-    p, b, n = num_params, bytes_per_el, max(world, 1)
-    if stage == 0:
-        return p * b + p * b + ADAM_STATE_BYTES * p
-    if stage == 1:
-        return p * b + p * b + ADAM_STATE_BYTES * p // n
-    if stage == 2:
-        return p * b + (p * b + ADAM_STATE_BYTES * p) // n
-    return (p * b + p * b + ADAM_STATE_BYTES * p) // n
+                      bytes_per_el: int = 2, *, micro_batch: int = 0,
+                      seq_len: int = 0, hidden: int = 0,
+                      num_layers: int = 0,
+                      remat_policy: str = "nothing_saveable",
+                      offload_ratio: float = 0.0,
+                      vocab_size: int = 0) -> int:
+    """Bytes/device for a ZeRO stage (see module docstring table),
+    delegating to the audited :class:`~.cost_model.MemoryModel`.
+
+    Two fixes over the original table (ISSUE 7 satellite): sharded
+    terms use per-term CEILING division — the old expressions floored
+    ``(P * bytes) // N`` and under-reported per-device bytes by up to
+    N-1 elements per term — and the activation term (microbatch x seq
+    x hidden x remat policy) is modeled explicitly when the caller
+    passes the shape keywords, instead of hiding inside an overhead
+    fudge factor."""
+    mm = MemoryModel(num_params=num_params, bytes_per_el=bytes_per_el,
+                     world=max(world, 1))
+    return mm.total_bytes(stage, micro_batch=micro_batch,
+                          seq_len=seq_len, hidden=hidden,
+                          num_layers=num_layers,
+                          remat_policy=remat_policy,
+                          offload_ratio=offload_ratio,
+                          vocab_size=vocab_size)
 
 
 class ResourceManager:
@@ -122,8 +138,22 @@ class Autotuner:
         if self.cfg.zero_stages:
             return sorted(set(self.cfg.zero_stages))
         p = self.model_info["num_params"]
+        # activation term at the smallest candidate micro-batch: a
+        # stage that can't even fit the min batch is infeasible
+        from .cost_model import model_dims
+        dims = model_dims(getattr(self.model, "config", None))
+        mb = max(self.cfg.min_train_micro_batch_size_per_gpu, 1)
+        mcfg = getattr(self.model, "config", None)
+        remat = (str(getattr(mcfg, "remat_policy", "nothing_saveable"))
+                 if getattr(mcfg, "remat", True) else "none")
         out = [s for s in (0, 1, 2, 3)
-               if memory_per_device(p, s, self.world) * OVERHEAD
+               if memory_per_device(
+                   p, s, self.world, micro_batch=mb,
+                   seq_len=dims.get("seq_len", 0),
+                   hidden=dims.get("hidden", 0),
+                   num_layers=dims.get("num_layers", 0),
+                   remat_policy=remat,
+                   vocab_size=dims.get("vocab_size", 0)) * OVERHEAD
                < self.device_memory]
         return out or [3]
 

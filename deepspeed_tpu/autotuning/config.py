@@ -1,5 +1,8 @@
 """Autotuning config (reference: deepspeed/autotuning/config.py
-DeepSpeedAutotuningConfig + constants.py)."""
+DeepSpeedAutotuningConfig + constants.py), extended with the
+ledger-driven planner's search-space knobs (ISSUE 7). The block is
+parsed by ``DeepSpeedConfig.autotuning`` and consumed by
+:class:`~.planner.Planner` / :class:`~.autotuner.Autotuner`."""
 
 from __future__ import annotations
 
@@ -20,6 +23,13 @@ TUNER_MODELBASED = "model_based"
 
 
 class AutotuningConfig(DeepSpeedConfigModel):
+    """Search + trial-measurement knobs. The reference fields
+    (metric/tuner/micro-batch bounds/zero_stages) drive both the legacy
+    measured-trial :class:`Autotuner` and the planner's grid; the
+    planner-specific fields below them widen the space to mesh shape,
+    remat policy, optimizer-offload ratio, and the overlap ratio the
+    cost model assumes (see docs/autotuning.md)."""
+
     enabled: bool = False
     fast: bool = True
     metric: str = METRIC_THROUGHPUT
@@ -38,3 +48,43 @@ class AutotuningConfig(DeepSpeedConfigModel):
     results_dir: str = "autotuning_results"
     exps_dir: str = "autotuning_exps"
     arg_mappings: dict[str, Any] = Field(default_factory=dict)
+
+    # --- planner search space (ISSUE 7) ------------------------------
+    # mesh axes enumerated over the devices the base config leaves
+    # free; every ordered factorization is a candidate. ["fsdp"] keeps
+    # the classic ZeRO-style search; add "tp"/"sp" for models with
+    # partition rules.
+    mesh_axes: list[str] = Field(default_factory=lambda: ["fsdp"])
+    # jax.checkpoint policy names to try ("none" disables remat); the
+    # engine plumbs the winner into the model via
+    # activation_checkpointing.policy
+    remat_policies: list[str] = Field(
+        default_factory=lambda: ["nothing_saveable"])
+    # optimizer-state offload ratios (0 = all on device; >0 moves that
+    # fraction to host via zero_optimization.offload_optimizer)
+    offload_ratios: list[float] = Field(default_factory=lambda: [0.0])
+    # overlap ratios the cost model assumes for collective hiding
+    # (BENCH_r05 measured the domino chunked-overlap at 0.71); extra
+    # values re-score the same trial config under different overlap
+    # assumptions, they do not change the emitted config
+    overlap_ratios: list[float] = Field(default_factory=lambda: [0.71])
+    # always add the base config itself as a grid point so a measured
+    # plan can never choose something worse than the hand-tuned start
+    include_base: bool = True
+    # memory-model fragmentation safety factor for headroom pruning
+    memory_safety_factor: float = 1.1
+    # measured steps per calibration point (the short run that fits
+    # effective FLOPs/s + per-step overhead)
+    calibration_steps: int = 3
+    # timing windows per measurement; the BEST (min seconds/step)
+    # window is kept — the steady-state convention bench.py uses,
+    # which shields short CPU windows from scheduler jitter
+    measure_windows: int = 2
+    # run the calibration measurement when no explicit Calibration is
+    # passed (False falls back to the accelerator peak-FLOPs table)
+    calibrate: bool = True
+    # measure the top-K AOT-ranked candidates with hermetic in-process
+    # trials (0 = prediction-only plan)
+    measure_top_k: int = 0
+    # write the plan artifact here ("" = don't write)
+    plan_path: str = ""

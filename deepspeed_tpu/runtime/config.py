@@ -111,14 +111,18 @@ class SchedulerConfig(DeepSpeedConfigModel):
 
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
-    """reference: runtime/activation_checkpointing/config.py"""
+    """reference: runtime/activation_checkpointing/config.py. When
+    ``policy`` is set EXPLICITLY the engine plumbs it into the model's
+    ``remat_policy`` (``"none"`` disables remat entirely) — the knob
+    the autotuning planner's chosen plan patches, so a plan ``apply()``
+    reproduces the remat decision through config alone."""
     partition_activations: bool = False
     cpu_checkpointing: bool = False
     contiguous_memory_optimization: bool = False
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
-    # TPU-native: jax.checkpoint policy name
+    # TPU-native: jax.checkpoint policy name ("none" = remat off)
     policy: str = "nothing_saveable"
 
 
@@ -339,6 +343,12 @@ class CheckpointConfig(DeepSpeedConfigModel):
 # subsystem; re-exported here so DeepSpeedConfig.elasticity parses it.
 from ..elasticity.config import ElasticityConfig  # noqa: E402
 
+# Autotuning block lives with its subsystem too (ISSUE 7: the
+# ledger-driven planner's search-space knobs); re-exported so
+# DeepSpeedConfig.autotuning parses it and the generated config doc
+# includes it.
+from ..autotuning.config import AutotuningConfig  # noqa: E402
+
 
 class DeepSpeedConfig(DeepSpeedConfigModel):
     train_batch_size: Optional[int] = None
@@ -381,6 +391,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     hybrid_engine: HybridEngineConfig = Field(
         default_factory=HybridEngineConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
 
     @classmethod
     def from_any(cls, config: "str | dict | DeepSpeedConfig | None") -> "DeepSpeedConfig":

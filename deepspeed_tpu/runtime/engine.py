@@ -124,6 +124,20 @@ class DeepSpeedEngine:
             # LoRA-style modules shard their frozen base over the mesh
             self.module.place_frozen(self.mesh)
         self.model_config: ModelConfig | None = getattr(self.module, "config", None)
+        # activation_checkpointing.policy -> model remat (ISSUE 7): an
+        # EXPLICITLY-set policy overrides the model's remat_policy so
+        # the autotuner's chosen plan reproduces its remat decision
+        # through config alone ("none" disables remat). Must happen
+        # before the train step traces the module's loss.
+        ac_cfg = self.config.activation_checkpointing
+        if (self.model_config is not None
+                and "policy" in ac_cfg.model_fields_set
+                and hasattr(self.model_config, "remat_policy")):
+            if ac_cfg.policy == "none":
+                self.model_config.remat = False
+            else:
+                self.model_config.remat = True
+                self.model_config.remat_policy = ac_cfg.policy
         self.compute_dtype = self.config.compute_dtype
         self._mixed = self.compute_dtype != jnp.float32
         self.fp16_enabled = bool(self.config.fp16.enabled)

@@ -231,6 +231,47 @@ def traffic_matrix(records: list[dict], calls: int = 1) -> dict:
     return out
 
 
+def bandwidth_bounds(traffic: dict, window_s: float) -> dict:
+    """Per-(axis, op) algorithm/bus bandwidth LOWER bounds over a
+    measured window: ``{(axis, op): {bytes, group_size, algbw_bytes_
+    per_s, busbw_bytes_per_s}}``. Every dispatched byte moved somewhere
+    inside the window, so bytes/window is an honest floor; the busbw
+    column applies the reference ``get_bw`` op factors. Empty window
+    -> empty result (no invented bandwidth). Calibration query for the
+    autotuning cost model (ISSUE 7)."""
+    if window_s <= 0:
+        return {}
+    from ..utils.comms_logging import get_bw
+    out: dict = {}
+    for (axis, op), row in traffic.items():
+        if row["bytes"] <= 0:
+            continue
+        algbw, busbw = get_bw(op, row["bytes"], window_s,
+                              max(row["group_size"], 2))
+        out[(axis, op)] = {"bytes": row["bytes"],
+                           "group_size": row["group_size"],
+                           "algbw_bytes_per_s": algbw * 1e9,
+                           "busbw_bytes_per_s": busbw * 1e9}
+    return out
+
+
+def axis_bandwidth_bounds(traffic: dict, window_s: float) -> dict:
+    """Per-axis fold of :func:`bandwidth_bounds`: total payload bytes
+    on the axis over the window — the single-number algbw floor the
+    cost model divides candidate traffic by."""
+    if window_s <= 0:
+        return {}
+    out: dict = {}
+    for (axis, _op), row in traffic.items():
+        if row["bytes"] <= 0:
+            continue
+        dst = out.setdefault(axis, {"bytes": 0})
+        dst["bytes"] += row["bytes"]
+    for axis, dst in out.items():
+        dst["algbw_bytes_per_s"] = dst["bytes"] / window_s
+    return out
+
+
 def merge_traffic(*matrices: dict) -> dict:
     """Fold several per-executable traffic matrices into one."""
     out: dict = {}

@@ -226,6 +226,66 @@ class ExecutableLedger:
             out[name] = avg * tot[1] / tot[0] / peak_flops
         return out
 
+    # -- calibration queries (ISSUE 7: consumed by autotuning) ---------
+    def step_seconds_by_name(self, span_totals: dict) -> dict:
+        """{name: {"seconds_per_call", "calls", "flops_per_call"}}
+        joining ledger dispatch counts against measured span seconds
+        (pass ``SpanTracer.totals_trimmed()`` so the warmup span's XLA
+        compile doesn't pollute the rate). Names with no measured
+        window are omitted."""
+        calls = self.calls_by_name()
+        flops = self.dispatched_flops()
+        out: dict = {}
+        for name, n in calls.items():
+            tot = span_totals.get(name)
+            if not tot or tot[0] <= 0 or tot[1] <= 0:
+                continue
+            seconds, count = float(tot[0]), int(tot[1])
+            out[name] = {
+                "seconds_per_call": seconds / count,
+                "calls": n,
+                "flops_per_call": flops.get(name, 0.0) / max(n, 1),
+            }
+        return out
+
+    def effective_flops_per_s(self, span_totals: dict) -> dict:
+        """{name: measured FLOPs/s} — the autotuner's calibration rate:
+        per-dispatch executable FLOPs over per-dispatch measured span
+        seconds. A lower bound on device throughput (span time includes
+        host overhead around the device work)."""
+        out: dict = {}
+        for name, row in self.step_seconds_by_name(span_totals).items():
+            if row["flops_per_call"] > 0 and row["seconds_per_call"] > 0:
+                out[name] = row["flops_per_call"] / row["seconds_per_call"]
+        return out
+
+    def axis_algbw_bounds(self, window_s: float) -> dict:
+        """{axis: {"bytes", "algbw_bytes_per_s"}} lower bounds from the
+        dispatch-weighted HLO traffic matrix over a measured window:
+        every dispatched byte moved somewhere inside the window, so
+        bytes/window is an honest floor on per-axis achieved algorithm
+        bandwidth (see :func:`.collectives.bandwidth_bounds`)."""
+        return _collectives.axis_bandwidth_bounds(self.traffic(),
+                                                  window_s)
+
+    def collective_bytes_by_axis(self, name: str) -> dict:
+        """{axis: per-DISPATCH collective payload bytes} for one jit
+        name, call-weighted across its live signatures — the comm
+        baseline a calibration fitted on this executable's measured
+        rate already contains (the cost model charges only excess)."""
+        totals: dict[str, float] = {}
+        calls = 0
+        for e in self.entries():
+            if e.name != name or e.calls <= 0:
+                continue
+            calls += e.calls
+            for (axis, _op), row in _collectives.traffic_matrix(
+                    e.collectives, e.calls).items():
+                totals[axis] = totals.get(axis, 0.0) + row["bytes"]
+        if calls <= 0:
+            return {}
+        return {axis: b / calls for axis, b in totals.items()}
+
     def snapshot(self) -> dict:
         rows = sorted((e.to_dict() for e in self.entries()),
                       key=lambda r: (-r["flops"] * r["calls"],

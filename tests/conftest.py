@@ -111,6 +111,15 @@ _SLOW = {
     ("test_tensor_fragment.py", "test_get_set_full_fp32_param"),
     ("test_launcher_multiprocess.py", "test_elastic_agent_restart_loop"),
     ("test_autotuning.py", "test_autotuner_end_to_end"),
+    # planner (ISSUE 7): the pure host-side tests (memory/cost model,
+    # synthetic-ledger calibration queries, rank determinism + apply
+    # roundtrip) stay tier-1; every engine-building variant is the
+    # heavy tail — the AOT-compile acceptance path also runs in the
+    # bench `autotune` stage on every bench invocation
+    ("test_autotuning.py", "test_planner_measured_top_k_chooses_best"),
+    ("test_autotuning.py", "test_planner_aot_ranks_without_dispatch"),
+    ("test_autotuning.py",
+     "test_activation_checkpointing_policy_plumbs_to_model"),
     ("test_sparse_attention.py",
      "test_block_sparse_kernel_matches_dense_mask"),
     ("test_inference.py", "test_quantize_weights_int8_serving"),

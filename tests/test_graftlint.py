@@ -489,6 +489,61 @@ def test_cli_fails_on_new_violation(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# host-only package audit (ISSUE 7 satellite): the planner/cost-model
+# package must contain no jit-reachable code — its deterministic-
+# ranking contract forbids tracing its own scoring logic. The gate
+# assertion runs over the real package; the fixtures prove the audit
+# actually detects a violation (and stays quiet on host-only code).
+# ---------------------------------------------------------------------
+
+def test_autotuning_package_is_host_only():
+    from deepspeed_tpu.analysis import traced_roots
+    roots = traced_roots([os.path.join(PACKAGE, "autotuning")],
+                         root=REPO)
+    assert roots == [], (
+        "autotuning/ must stay host-only (no jit-reachable code); "
+        "traced functions found:\n"
+        + "\n".join(f"{r['path']}:{r['line']}: {r['name']}"
+                    for r in roots))
+    # and the regular rule set is clean over the package too
+    res = lint_paths([os.path.join(PACKAGE, "autotuning")], root=REPO)
+    assert res.findings == [] and not res.errors
+
+
+def test_traced_roots_fixture_detects_traced_planner(tmp_path):
+    bad = tmp_path / "planner_bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax, jax.numpy as jnp
+        def score_candidate(flops, bw):
+            return flops / 1e12 + jnp.sum(bw)
+        score_jit = jax.jit(score_candidate)
+        """))
+    good = tmp_path / "planner_good.py"
+    good.write_text(textwrap.dedent("""
+        def score_candidate(flops, bw):
+            return flops / 1e12 + sum(bw)
+        def rank(cands):
+            return sorted(cands, key=lambda c: c["score"])
+        """))
+    from deepspeed_tpu.analysis import traced_roots
+    roots = traced_roots([str(bad)], root=str(tmp_path))
+    assert any(r["name"] == "score_candidate" for r in roots)
+    assert traced_roots([str(good)], root=str(tmp_path)) == []
+    # cross-module within the audited set: a sibling module jitting
+    # the host-only scorer makes it reachable too
+    other = tmp_path / "planner_jits_sibling.py"
+    other.write_text(textwrap.dedent("""
+        import jax
+        from planner_good import score_candidate
+        score_jit = jax.jit(score_candidate)
+        """))
+    roots2 = traced_roots([str(good), str(other)], root=str(tmp_path))
+    assert any(r["name"] == "score_candidate"
+               and r["path"].endswith("planner_good.py")
+               for r in roots2)
+
+
+# ---------------------------------------------------------------------
 # runtime sentinels
 # ---------------------------------------------------------------------
 

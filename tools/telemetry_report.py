@@ -291,6 +291,16 @@ _GATES = {
         ("tokens_per_sec", +1, 0.05),
         ("fused_occupancy", +1, 0.05),
     ),
+    # autotune stage (ISSUE 7): the planner's cost model must not get
+    # less accurate (prediction_rel_err: worst relative error over the
+    # measured top-K), and the chosen plan's measured throughput must
+    # not regress — neither absolutely nor against the hand-tuned
+    # baseline config measured in the same stage (plan_vs_baseline).
+    "autotune": (
+        ("prediction_rel_err", -1, 0.30),
+        ("plan_vs_baseline", +1, 0.05),
+        ("plan_tokens_per_sec", +1, 0.05),
+    ),
 }
 
 # metric families a gate must NOT touch even though a stem matches by
@@ -299,6 +309,9 @@ _GATES = {
 # would flap the gate on dispatch-path jitter unrelated to the engine.
 _GATE_EXCLUDE = {
     "serving": ("per_tick", "v2_tick"),
+    # the all-measured error includes the short-step base candidate,
+    # the noisiest row — informational, the top-K figure gates
+    "autotune": ("rel_err_all",),
 }
 
 
