@@ -68,6 +68,17 @@ class AutotuningConfig(DeepSpeedConfigModel):
     # values re-score the same trial config under different overlap
     # assumptions, they do not change the emitted config
     overlap_ratios: list[float] = Field(default_factory=lambda: [0.71])
+    # qwZ/qgZ wire formats to grid over for the sharded-DP collectives
+    # (ISSUE 8): "fp32" = XLA's implicit full-precision wire,
+    # "int8"/"fp8" = the ZeRO++ quantized protocol. Quantized entries
+    # only pair with ZeRO stage >= 2 (the wire is a shard feature).
+    wire_dtypes: list[str] = Field(default_factory=lambda: ["fp32"])
+    # score quantized-wire variants analytically from the fp32
+    # sibling's compiled facts (cost_model.quantized_wire_facts)
+    # instead of compiling each variant config — one engine build per
+    # mesh/batch/stage point instead of one per wire entry; turn off
+    # for compiler-truth facts on the quantized configs themselves
+    analytic_wire: bool = True
     # always add the base config itself as a grid point so a measured
     # plan can never choose something worse than the hand-tuned start
     include_base: bool = True

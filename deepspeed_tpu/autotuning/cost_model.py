@@ -58,6 +58,52 @@ def ceil_div(a: int, b: int) -> int:
     return -(-int(a) // max(int(b), 1))
 
 
+# mesh axes the ZeRO++ wire protocol quantizes traffic on (the sharded
+# data-parallel extent; runtime/zeropp.py scope) — axis labels from the
+# HLO walk may be combinations like "fsdp+zps"
+WIRE_SHARD_AXES = ("fsdp", "zps")
+
+
+def wire_dtype_bytes(wire_dtype: str) -> float:
+    """Effective wire bytes per payload element for a qwZ/qgZ wire
+    format, per-block fp32 scale overhead included (delegates to the
+    kernel module's single source of truth — including its QBLOCK
+    default, so a block-size retune can't silently diverge the cost
+    model from the actual wire)."""
+    from ..ops.pallas.quantization import wire_bytes_per_element
+    return wire_bytes_per_element(wire_dtype)
+
+
+def quantized_wire_facts(facts: "AOTFacts", wire_dtype: str,
+                         axes: tuple[str, ...] = WIRE_SHARD_AXES) -> \
+        "AOTFacts":
+    """Analytic wire-dtype transform of fp32-wire AOT facts: the
+    sharded-DP axes' collective payload scales by the wire ratio
+    (int8 + scales ~ 0.25x), and the quantize/dequantize bracket is
+    charged as two extra HBM passes over the moved payload in
+    ``bytes_accessed`` (that term participates in the memory-bandwidth
+    roofline, so compute-bound calibrations penalize the bracket while
+    bandwidth-bound ones are dominated by the comm credit). Used by
+    the planner to score ``wire_dtype`` grid variants without a second
+    AOT compile; a real compile of the variant config supersedes it."""
+    if wire_dtype in ("fp32", "f32", "none"):
+        return facts
+    ratio = wire_dtype_bytes(wire_dtype) / 4.0
+    by_axis: dict[str, float] = {}
+    moved = 0.0
+    for axis, nbytes in facts.collective_bytes_by_axis.items():
+        parts = set(axis.split("+"))
+        if parts and parts <= set(axes):
+            by_axis[axis] = nbytes * ratio
+            moved += nbytes
+        else:
+            by_axis[axis] = nbytes
+    return dataclasses.replace(
+        facts,
+        bytes_accessed=facts.bytes_accessed + 2.0 * moved,
+        collective_bytes_by_axis=by_axis)
+
+
 def hbm_headroom_bytes(device=None) -> int:
     """Schedulable device-memory headroom (bytes_limit minus bytes in
     use) from the backend's memory_stats — the same source as the
@@ -178,6 +224,14 @@ class Calibration:
         default_factory=dict)
     overlap_ratio: float = 0.71    # measured domino chunked-overlap ratio
     headroom_bytes: int = 0
+    # observed wire width per axis (bytes/element, min over the axis's
+    # collectives) from the HLO walk's dtype records — 4.0 on an
+    # fp32-wire run, ~1.0 once qwZ/qgZ carry int8/fp8 payloads; report-
+    # only (the byte-denominated terms above already use observed wire
+    # bytes), kept so plan artifacts show WHICH wire the bounds were
+    # measured at
+    axis_wire_bytes_per_el: dict[str, float] = dataclasses.field(
+        default_factory=dict)
     source: str = "synthetic"
 
     @classmethod
@@ -226,7 +280,18 @@ class Calibration:
         ledger's per-name dispatched FLOPs joined against the span
         tracer's measured seconds (``SpanTracer.totals_trimmed()``)
         give effective FLOPs/s; the HLO collective traffic over the
-        window gives per-axis algbw lower bounds."""
+        window gives per-axis algbw lower bounds.
+
+        Wire-dtype awareness (ISSUE 8 satellite): every byte figure
+        here — the algbw floors, the per-axis comm baseline — comes
+        from the HLO walk's decoded payload shapes, NOT from element
+        counts at an assumed fp32 width. When the calibration run used
+        quantized collectives (qwZ/qgZ), the bounds are measured in the
+        int8/fp8 bytes that actually moved, so predict()'s
+        excess-vs-baseline comparison stays unit-consistent against
+        candidate facts (also HLO-observed bytes) regardless of which
+        wire either side ran. The observed per-axis wire width is
+        recorded in ``axis_wire_bytes_per_el`` for plan artifacts."""
         rates = ledger.effective_flops_per_s(span_totals)
         if name not in rates:
             raise ValueError(
@@ -234,6 +299,9 @@ class Calibration:
                 f"have {sorted(rates)}")
         axis_bw = {axis: row["algbw_bytes_per_s"] for axis, row
                    in ledger.axis_algbw_bounds(window_s).items()}
+        wire = getattr(ledger, "axis_wire_bytes_per_el", None)
+        if wire is not None:
+            kw.setdefault("axis_wire_bytes_per_el", dict(wire()))
         kw.setdefault("headroom_bytes", hbm_headroom_bytes())
         # the fitted rate contains this executable's own exposed comm:
         # record its per-dispatch payload as the baseline so predict()
@@ -290,12 +358,17 @@ class CostModel:
     def predict(self, facts: AOTFacts,
                 overlap_ratio: Optional[float] = None) -> dict:
         """{step_s, compute_s, comm_s, comm_exposed_s}. ``comm_s`` sums
-        per-axis payload — only the bytes in EXCESS of the calibration
-        baseline's (whose exposure the fitted FLOPs rate already
-        contains) — over that axis's measured algbw lower bound; axes
-        with no bandwidth estimate contribute 0 (the bound is honest:
-        unknown bandwidth must not invent slowness). The overlap ratio
-        hides that fraction of collective time under compute."""
+        per-axis payload relative to the calibration baseline's (whose
+        exposure the fitted FLOPs rate already contains) over that
+        axis's measured algbw lower bound: bytes in EXCESS charge time,
+        bytes BELOW the baseline credit it back (a quantized-wire
+        candidate moving a quarter of the calibration run's payload is
+        honestly faster — the fitted rate paid for bytes this candidate
+        never sends). Axes with no bandwidth estimate contribute 0 (the
+        bound is honest: unknown bandwidth must not invent slowness or
+        speed). The overlap ratio hides that fraction of collective
+        time under compute; the credited step never drops below the
+        fixed per-step overhead."""
         cal = self.calibration
         ov = cal.overlap_ratio if overlap_ratio is None else overlap_ratio
         ov = min(max(float(ov), 0.0), 1.0)
@@ -304,14 +377,20 @@ class CostModel:
             compute = max(compute, cal.overhead_s
                           + facts.bytes_accessed / cal.mem_bw_bytes_per_s)
         comm = 0.0
-        for axis, nbytes in sorted(facts.collective_bytes_by_axis.items()):
+        # union of candidate and baseline axes: an axis the candidate
+        # eliminated entirely (absent from its HLO) must credit its
+        # full baseline payload, not silently contribute 0
+        axes = set(facts.collective_bytes_by_axis) | set(
+            cal.baseline_comm_bytes_by_axis)
+        for axis in sorted(axes):
             bw = cal.algbw(axis)
+            nbytes = facts.collective_bytes_by_axis.get(axis, 0.0)
             excess = nbytes - cal.baseline_comm_bytes_by_axis.get(axis,
                                                                   0.0)
-            if bw > 0 and excess > 0:
+            if bw > 0 and excess != 0:
                 comm += excess / bw
         exposed = (1.0 - ov) * comm
-        step = compute + exposed
+        step = max(compute + exposed, cal.overhead_s)
         return {"step_s": step, "compute_s": compute, "comm_s": comm,
                 "comm_exposed_s": exposed, "overlap_ratio": ov}
 

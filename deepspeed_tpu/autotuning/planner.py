@@ -62,6 +62,11 @@ class Candidate:
     remat_policy: str
     offload_ratio: float
     overlap_ratio: float
+    # qwZ/qgZ wire format for the sharded-DP collectives: "fp32" = XLA's
+    # implicit full-precision wire, "int8"/"fp8" = the ZeRO++ quantized
+    # protocol (runtime/zeropp.py). Joins the grid via
+    # AutotuningConfig.wire_dtypes.
+    wire_dtype: str = "fp32"
 
     @property
     def mesh_sizes(self) -> dict[str, int]:
@@ -71,8 +76,10 @@ class Candidate:
         mesh = "x".join(f"{a}{s}" for a, s in self.mesh if s > 1) or "1dev"
         off = (f" off={self.offload_ratio:g}" if self.offload_ratio > 0
                else "")
+        wire = (f" wire={self.wire_dtype}" if self.wire_dtype != "fp32"
+                else "")
         return (f"{mesh} mb{self.micro_batch} z{self.zero_stage} "
-                f"remat={self.remat_policy}{off}")
+                f"remat={self.remat_policy}{off}{wire}")
 
     def config_patch(self, grad_accum: int = 1) -> dict:
         """The ds-config diff this candidate applies on the base
@@ -84,6 +91,15 @@ class Candidate:
                                          "ratio": self.offload_ratio}
         else:
             zero["offload_optimizer"] = {"device": "none"}
+        if self.wire_dtype != "fp32":
+            zero["zero_quantized_weights"] = True
+            zero["zero_quantized_gradients"] = True
+            zero["zero_quantized_dtype"] = self.wire_dtype
+        else:
+            # explicit off: the patch must override a base config that
+            # had quantization on, or plan replay diverges
+            zero["zero_quantized_weights"] = False
+            zero["zero_quantized_gradients"] = False
         return {
             "mesh": {a: s for a, s in self.mesh},
             "train_micro_batch_size_per_gpu": self.micro_batch,
@@ -254,6 +270,7 @@ class Planner:
                   else [0, 1, 2, 3])
         mbs = self._micro_batches()
         out: list[Candidate] = []
+        wires = cfg.wire_dtypes or ["fp32"]
         for mesh in meshes:
             for mb in mbs:
                 for st in stages:
@@ -261,11 +278,19 @@ class Planner:
                                   or ["nothing_saveable"]):
                         for off in (cfg.offload_ratios or [0.0]):
                             for ov in (cfg.overlap_ratios or [0.71]):
-                                out.append(Candidate(
-                                    mesh=mesh, micro_batch=mb,
-                                    zero_stage=st, remat_policy=remat,
-                                    offload_ratio=float(off),
-                                    overlap_ratio=float(ov)))
+                                for wire in wires:
+                                    # quantized wire is a ZeRO-3 shard
+                                    # feature: nothing to quantize
+                                    # below stage 2
+                                    if wire != "fp32" and st < 2:
+                                        continue
+                                    out.append(Candidate(
+                                        mesh=mesh, micro_batch=mb,
+                                        zero_stage=st,
+                                        remat_policy=remat,
+                                        offload_ratio=float(off),
+                                        overlap_ratio=float(ov),
+                                        wire_dtype=str(wire)))
         if cfg.include_base:
             base = self._base_candidate()
             if base is not None and base not in out:
@@ -335,10 +360,14 @@ class Planner:
         remat = (self._base_remat_policy if self._base_remat_on
                  else "none")
         ovs = self.cfg.overlap_ratios or [0.71]
+        wire = (str(zero.get("zero_quantized_dtype", "int8"))
+                if zero.get("zero_quantized_weights")
+                or zero.get("zero_quantized_gradients") else "fp32")
         return Candidate(mesh=mesh, micro_batch=mb,
                          zero_stage=int(zero.get("stage", 0)),
                          remat_policy=remat,
-                         offload_ratio=ratio, overlap_ratio=float(ovs[0]))
+                         offload_ratio=ratio, overlap_ratio=float(ovs[0]),
+                         wire_dtype=wire)
 
     # -- memory pruning ------------------------------------------------
     def prune(self, candidates: list[Candidate]) -> \
@@ -427,11 +456,26 @@ class Planner:
         """AOT cost/memory/collective truth for one candidate — never
         dispatches a step. Cached per trial config, so candidates whose
         configs coincide (e.g. overlap-ratio-only variants) share one
-        engine build."""
+        engine build.
+
+        Quantized-wire variants: with ``cfg.analytic_wire`` the
+        fp32-wire sibling's compiled facts are transformed analytically
+        (:func:`~.cost_model.quantized_wire_facts` — sharded-DP bytes
+        scale by the wire ratio, the quantize/dequant bracket charges
+        bytes_accessed), saving one engine build + compile per wire
+        variant; otherwise the variant's own config is compiled and the
+        facts are compiler truth end to end."""
         key = json.dumps(self.trial_config(cand), sort_keys=True)
         cached = self._aot_cache.get(key)
         if cached is not None:
             return cached
+        if cand.wire_dtype != "fp32" and self.cfg.analytic_wire:
+            from .cost_model import quantized_wire_facts
+            base = self.aot_facts(
+                dataclasses.replace(cand, wire_dtype="fp32"))
+            facts = quantized_wire_facts(base, cand.wire_dtype)
+            self._aot_cache[key] = facts
+            return facts
         engine = self._build_engine(cand)
         try:
             facts = self._collect_facts(
@@ -691,7 +735,8 @@ class Planner:
                          zero_stage=row["zero_stage"],
                          remat_policy=row["remat_policy"],
                          offload_ratio=row["offload_ratio"],
-                         overlap_ratio=row["overlap_ratio"])
+                         overlap_ratio=row["overlap_ratio"],
+                         wire_dtype=row.get("wire_dtype", "fp32"))
 
     @staticmethod
     def _choose(rows: list[dict]) -> int:

@@ -45,10 +45,29 @@ def _to_blocks(x):
     return flat.reshape(-1, QBLOCK), n
 
 
-def quantize_int8(x, use_pallas: bool | None = None):
-    """-> (q int8 [nblocks, QBLOCK], scales f32 [nblocks, 1], meta)."""
+def stochastic_round(y, key):
+    """Unbiased round-to-integer: ``floor(y + u)``, u ~ U[0, 1).
+    E[result] = y, so quantization noise averages out across steps —
+    the accuracy knob ZeRO++/EQuARX lean on for the gradient wire
+    (nearest rounding biases each block toward its own grid)."""
+    u = jax.random.uniform(key, y.shape, jnp.float32)
+    return jnp.floor(y + u)
+
+
+def quantize_int8(x, use_pallas: bool | None = None,
+                  rounding: str = "nearest", key=None):
+    """-> (q int8 [nblocks, QBLOCK], scales f32 [nblocks, 1], meta).
+
+    ``rounding="stochastic"`` (requires ``key``) uses unbiased
+    floor-plus-uniform rounding on the jnp path — the gradient-wire
+    mode; the Pallas kernel keeps nearest rounding (weight gathers,
+    where the bias is squashed by the optimizer update anyway)."""
     blocks, n = _to_blocks(x)
     rows = blocks.shape[0]
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        use_pallas = False
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
@@ -70,7 +89,10 @@ def quantize_int8(x, use_pallas: bool | None = None):
         x32 = blocks.astype(jnp.float32)
         amax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
         s = jnp.maximum(amax / 127.0, 1e-12)
-        q = jnp.clip(jnp.round(x32 / s), -127, 127).astype(jnp.int8)
+        y = x32 / s
+        rounded = (stochastic_round(y, key) if rounding == "stochastic"
+                   else jnp.round(y))
+        q = jnp.clip(rounded, -127, 127).astype(jnp.int8)
     return q, s, (x.shape, x.dtype, n)
 
 
@@ -115,10 +137,27 @@ def dequantize_fp8(q, s, meta):
                          dtype=dtype)
 
 
-def _wire_quantizer(wire_dtype: str):
+def wire_bytes_per_element(wire_dtype: str, block: int = QBLOCK) -> float:
+    """Effective wire bytes per payload element, per-block fp32 scales
+    included — the single number the autotuning cost model and the
+    telemetry wire accounting share. fp32 wire = 4 exactly (no scales);
+    int8/fp8 = 1 + 4/block."""
+    if wire_dtype in ("fp32", "f32", "none"):
+        return 4.0
+    if wire_dtype in ("bf16", "f16"):
+        return 2.0 + 4.0 / block
+    if wire_dtype in ("int8", "s8", "fp8", "f8"):
+        return 1.0 + 4.0 / block
+    raise ValueError(f"unknown wire dtype {wire_dtype!r}")
+
+
+def _wire_quantizer(wire_dtype: str, rounding: str = "nearest",
+                    key=None):
     if wire_dtype == "fp8":
+        # fp8 codes round via the native dtype cast; stochastic mode is
+        # int8-only (documented in docs/zeropp.md accuracy knobs)
         return quantize_fp8, dequantize_fp8
-    return (lambda x: quantize_int8(x, use_pallas=False),
+    return (lambda x: quantize_int8(x, rounding=rounding, key=key),
             lambda q, s, m: dequantize_int8(q, s, m, use_pallas=False))
 
 
@@ -126,14 +165,24 @@ def quantized_all_gather(x, axes, dim: int = 0, wire_dtype: str = "int8"):
     """ZeRO++ qwZ: quantize the local shard, all-gather int8/fp8 codes +
     scales along mesh ``axes``, dequantize, and reassemble on ``dim``.
     Must run inside shard_map (reference: partition_parameters.py:761
-    CUDAQuantizer bracketing the param all-gather)."""
+    CUDAQuantizer bracketing the param all-gather). The quantize side
+    uses the Pallas kernel on TPU (single HBM pass before the
+    collective); the dequantize side is plain jnp so XLA fuses it into
+    the gathered tensor's first consumer."""
     from jax import lax
 
     quant, dequant = _wire_quantizer(wire_dtype)
-    q, s, meta = quant(x)                       # inside shard_map: jnp
+    q, s, meta = quant(x)
     qg = lax.all_gather(q, axes, axis=0, tiled=False)
     sg = lax.all_gather(s, axes, axis=0, tiled=False)
-    pieces = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qg, sg)
+    if wire_dtype == "fp8":
+        pieces = jax.vmap(lambda qq, ss: dequant(qq, ss, meta))(qg, sg)
+    else:
+        shape, dtype, n = meta
+        deq = qg.astype(jnp.float32) * sg       # [world, nblocks, QBLOCK]
+        world = deq.shape[0]
+        pieces = deq.reshape(world, -1)[:, :n].reshape(
+            (world,) + shape).astype(dtype)
     world = pieces.shape[0]
     out = jnp.moveaxis(pieces, 0, dim)          # [..., world, shard, ...]
     shape = list(x.shape)

@@ -1,34 +1,197 @@
-"""Coalesced / quantized collectives (reference:
-runtime/comm/coalesced_collectives.py — reduce_scatter_coalesced:81
-batches many tensors into one reduce-scatter; all_to_all_quant_reduce:31
-is ZeRO++ qgZ's int8 hierarchical gradient exchange; the compressed
-1-bit allreduce lives in runtime/comm/nccl.py:51).
+"""Quantized / coalesced gradient collectives — the qgZ wire protocol
+(reference: runtime/comm/coalesced_collectives.py —
+all_to_all_quant_reduce:31 is ZeRO++ qgZ's int8 hierarchical gradient
+exchange; reduce_scatter_coalesced:81 batches many tensors into one
+reduce-scatter; the compressed 1-bit allreduce lives in
+runtime/comm/nccl.py:51).
 
-TPU translation: "coalescing" exists so NCCL launch overhead is paid once
-per bucket; XLA already fuses adjacent collectives, so these wrappers are
-semantic parity — they apply the collective leaf-wise over a tensor list
-inside shard_map, with the quantized variants delegating to the
-block-int8 primitives in runtime/zeropp.py. The error-compensated 1-bit
-path is the optimizers' job (runtime/onebit.py)."""
+This module is the single implementation of the quantized gradient
+exchange the production training step runs when
+``zero_quantized_gradients`` is on (runtime/zeropp.py delegates here):
+
+- :func:`quantized_reduce_scatter` — one-hop qgZ: chunk the full-size
+  local gradient along the shard dim, block-quantize each chunk
+  (int8/fp8 payload + per-block fp32 scales, optionally with unbiased
+  stochastic rounding), exchange with a single all-to-all, dequantize
+  and SUM the received chunks. A reduce-scatter at int8 wire width.
+- :func:`hierarchical_quantized_reduce_scatter` — two-hop qgZ over an
+  fsdp×zps-split mesh (the reference's swizzled intra/inter-node
+  exchange, csrc/quantization/swizzled_quantize.cu): exchange + reduce
+  over the fast inner ``zps`` links first, then exchange the
+  already-reduced (1/zps-sized) partials over the slow outer ``fsdp``
+  links — slow-link traffic drops by the zps factor AND the payload is
+  re-quantized between hops so scales never compound.
+
+"Coalescing" exists in the reference so NCCL launch overhead is paid
+once per bucket; XLA already fuses adjacent collectives, so the
+list-wise wrappers here are thin loops. The error-compensated 1-bit
+path is the optimizers' job (runtime/onebit.py).
+
+Everything here must run inside ``shard_map``.
+"""
 
 from __future__ import annotations
 
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-from ..zeropp import quantized_reduce_scatter
+from ...ops.pallas.quantization import (QBLOCK, quantize_fp8,
+                                        quantize_int8, stochastic_round)
 
 
-def _flat_padded(t: jax.Array, world: int) -> jax.Array:
-    """Flatten and zero-pad to a multiple of the group size — the
-    reference's contract (it flattens + pads every tensor before the
-    collective, coalesced_collectives.py:95), so arbitrary shapes work."""
-    import jax.numpy as jnp
+def _flat_padded(t: jax.Array, world: int, block: int = 1) -> jax.Array:
+    """Flatten and zero-pad to a multiple of ``world * block`` (the
+    exact multiple of lcm(world, block) that also BLOCK-ALIGNS every
+    rank's chunk: a plain lcm pad still leaves size/world indivisible
+    by the block whenever gcd(world, block) > 1).
+
+    The reference pads to the group size only
+    (coalesced_collectives.py:95); with block quantization that lets a
+    quantization block straddle the per-rank chunk/pad boundary — a
+    chunk whose tail block mixes real values with pad zeros gets a
+    scale from the real values but its partner ranks' block layout
+    shifts, so per-rank partitions stop being block-aligned. Padding to
+    world x block keeps every rank's chunk an exact number of blocks
+    (ISSUE 8 satellite; regression: test_comm.py odd sizes)."""
     flat = t.reshape(-1)
-    pad = (-flat.size) % world
+    pad = (-flat.size) % (int(world) * int(block))
     return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def _axis_key(seed, axes: tuple[str, ...], salt: int):
+    """Per-device PRNG key for stochastic wire rounding: ``seed`` (the
+    training step — traced is fine) folded with a static call-site salt
+    and this device's coordinate along ``axes``, so no two devices (and
+    no two collectives in one program) share rounding noise."""
+    key = jax.random.fold_in(jax.random.PRNGKey(jnp.uint32(0)),
+                             jnp.asarray(seed, jnp.uint32))
+    key = jax.random.fold_in(key, np.uint32(salt))
+    for a in axes:
+        key = jax.random.fold_in(key, lax.axis_index(a))
+    return key
+
+
+def _quant_rows(rows, wire_dtype: str, rounding: str, key):
+    """Block-quantize each row of ``rows`` [n, c] independently ->
+    (codes [n, nb, QBLOCK], scales [n, nb, 1]). Rows are padded to a
+    block multiple inside the per-row quantizer; callers that must
+    keep rows block-aligned across ranks pad with _flat_padded
+    first."""
+    if wire_dtype == "fp8":
+        def q1(c):
+            q, s, _ = quantize_fp8(c)
+            return q, s
+        return jax.vmap(q1)(rows)
+    if rounding == "stochastic":
+        # quantize all rows under ONE key: the uniform draw is shaped
+        # like the whole [n, blocks] tensor, so each block still gets
+        # independent noise
+        x32 = rows.astype(jnp.float32)
+        pad = (-rows.shape[1]) % QBLOCK
+        x32 = jnp.pad(x32, ((0, 0), (0, pad)))
+        blocks = x32.reshape(rows.shape[0], -1, QBLOCK)
+        amax = jnp.max(jnp.abs(blocks), axis=-1, keepdims=True)
+        s = jnp.maximum(amax / 127.0, 1e-12)
+        q = jnp.clip(stochastic_round(blocks / s, key),
+                     -127, 127).astype(jnp.int8)
+        return q, s
+
+    def q1(c):
+        q, s, _ = quantize_int8(c, use_pallas=False)
+        return q, s
+    return jax.vmap(q1)(rows)
+
+
+def _exchange_reduce(rows, axes: tuple[str, ...], wire_dtype: str,
+                     rounding: str, key) -> jax.Array:
+    """One hop of qgZ: quantize ``rows`` [world, c] (row i is the chunk
+    destined for group rank i), all-to-all the codes + scales along
+    ``axes``, dequantize and SUM the received chunks -> [c]."""
+    q, s = _quant_rows(rows, wire_dtype, rounding, key)
+    qx = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
+    sx = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
+    deq = qx.astype(jnp.float32) * sx            # [world, nb, QBLOCK]
+    summed = jnp.sum(deq, axis=0).reshape(-1)
+    return summed[: rows.shape[1]]
+
+
+def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
+                             dim: int, wire_dtype: str = "int8",
+                             rounding: str = "nearest",
+                             seed=0) -> jax.Array:
+    """qgZ: chunk `g` (full-size local gradient) along `dim`, quantize
+    each chunk, exchange with one int8/fp8 all-to-all, dequantize + sum
+    received chunks. Returns this device's gradient shard (SUM
+    semantics). Must run inside shard_map.
+
+    ``rounding="stochastic"`` draws unbiased rounding noise keyed on
+    ``seed`` (the training step) + this device's mesh coordinate, so
+    the wire's quantization error averages out over steps instead of
+    biasing each block toward its grid. Per-block scales stay fp32.
+    """
+    world = lax.psum(1, axes)  # mesh axis size: static under jit
+    # chunk along dim: [world, ...chunk...]; quantize each chunk
+    # independently so no block straddles a chunk boundary
+    chunks = jnp.stack(jnp.split(g, world, axis=dim), axis=0)
+    key = (_axis_key(seed, axes, salt=0x9c2)
+           if rounding == "stochastic" else None)
+    rows = chunks.reshape(world, -1)
+    summed = _exchange_reduce(rows, axes, wire_dtype, rounding, key)
+    m = chunks.shape[1:]
+    return summed[: int(np.prod(m))].reshape(m).astype(g.dtype)
+
+
+def hierarchical_quantized_reduce_scatter(
+        g: jax.Array, outer_axes: tuple[str, ...],
+        inner_axes: tuple[str, ...], dim: int,
+        wire_dtype: str = "int8", rounding: str = "nearest",
+        seed=0) -> jax.Array:
+    """Two-hop qgZ over a hierarchically split shard group (outer =
+    slow inter-group links, e.g. ``fsdp``; inner = fast intra-group
+    links, e.g. ``zps``).
+
+    Hop 1 exchanges + reduces the inner-minor chunks over the fast
+    links; hop 2 exchanges the already 1/inner-sized partial sums over
+    the slow links — slow-link payload drops by the inner factor, and
+    the partials are re-quantized between hops so block scales never
+    compound across hops. Chunk order matches the one-hop layout
+    (outer-major, inner-minor), i.e. the shard this device owns under a
+    ``PartitionSpec((*outer, *inner))`` on ``dim``.
+    """
+    n_outer = lax.psum(1, outer_axes)
+    n_inner = lax.psum(1, inner_axes)
+    x = jnp.moveaxis(g, dim, 0)
+    d = x.shape[0]
+    rest = x.shape[1:]
+    c = (d // (n_outer * n_inner)) * int(np.prod(rest))
+    arr = x.reshape(n_outer, n_inner, c)
+    k1 = k2 = None
+    if rounding == "stochastic":
+        all_axes = tuple(outer_axes) + tuple(inner_axes)
+        k1 = _axis_key(seed, all_axes, salt=0x9c3)
+        k2 = _axis_key(seed, all_axes, salt=0x9c4)
+    # hop 1 (fast links): for each outer-major chunk, exchange the
+    # inner-minor pieces and reduce over the inner group
+    rows = arr.reshape(n_outer * n_inner, c)
+    q, s = _quant_rows(rows, wire_dtype, rounding, k1)
+    q = q.reshape((n_outer, n_inner) + q.shape[1:])
+    s = s.reshape((n_outer, n_inner) + s.shape[1:])
+    qx = lax.all_to_all(q, inner_axes, split_axis=1, concat_axis=1,
+                        tiled=True)
+    sx = lax.all_to_all(s, inner_axes, split_axis=1, concat_axis=1,
+                        tiled=True)
+    deq = qx.astype(jnp.float32) * sx    # [outer, inner(src), nb, QB]
+    partial = jnp.sum(deq, axis=1).reshape(n_outer, -1)[:, :c]
+    # hop 2 (slow links): exchange the reduced partials over the outer
+    # group — 1/inner of the one-hop slow-link payload
+    shard = _exchange_reduce(partial, outer_axes, wire_dtype, rounding,
+                             k2)
+    out = shard.reshape((d // (n_outer * n_inner),) + rest)
+    return jnp.moveaxis(out, 0, dim).astype(g.dtype)
 
 
 def reduce_scatter_coalesced(tensors: Sequence[jax.Array], *,
@@ -45,12 +208,17 @@ def reduce_scatter_coalesced(tensors: Sequence[jax.Array], *,
 
 
 def all_to_all_quant_reduce(tensors: Sequence[jax.Array], *,
-                            group) -> list[jax.Array]:
-    """qgZ: block-int8 all-to-all reduce-scatter per tensor; returns flat
-    partitions like reduce_scatter_coalesced (reference:
-    coalesced_collectives.py:31 all_to_all_quant_reduce). SUM semantics;
-    must run inside shard_map."""
+                            group, wire_dtype: str = "int8",
+                            rounding: str = "nearest",
+                            seed=0) -> list[jax.Array]:
+    """qgZ over a tensor list: block-int8/fp8 all-to-all reduce-scatter
+    per tensor; returns flat partitions like reduce_scatter_coalesced
+    (reference: coalesced_collectives.py:31 all_to_all_quant_reduce).
+    SUM semantics; must run inside shard_map. Inputs are padded to
+    lcm(world, QBLOCK) so every rank's partition is block-aligned."""
     axes = (group,) if isinstance(group, str) else tuple(group)
     world = lax.psum(1, axes)
-    return [quantized_reduce_scatter(_flat_padded(t, world), axes, 0)
+    return [quantized_reduce_scatter(
+                _flat_padded(t, world, block=QBLOCK), axes, 0,
+                wire_dtype=wire_dtype, rounding=rounding, seed=seed)
             for t in tensors]

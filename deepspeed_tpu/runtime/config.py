@@ -94,6 +94,20 @@ class ZeroConfig(DeepSpeedConfigModel):
     # wire format for qwZ/qgZ payloads: int8 (reference CUDAQuantizer) or
     # fp8 e4m3 (native float8 dtype; this build's extension)
     zero_quantized_dtype: Literal["int8", "fp8"] = "int8"
+    # two-hop weight-gather / gradient-exchange over an fsdp×zps-split
+    # mesh (set mesh.zps > 1): intra-zps hop on fast links first, then
+    # the inter-fsdp hop (quantized when qwZ/qgZ are on) — slow-link
+    # traffic drops by the zps factor (ZeRO++ hierarchy over the
+    # MiCS-style full shard; docs/zeropp.md). Validated against the
+    # mesh at engine init.
+    zero_hierarchical_allgather: bool = False
+    # gradient-wire rounding for qgZ: "stochastic" (default) is the
+    # unbiased floor-plus-uniform mode keyed on the step counter —
+    # quantization noise averages out across steps so the loss
+    # trajectory tracks the fp32 wire; "nearest" is deterministic
+    # round-to-nearest (int8 wire only; fp8 rounds via the dtype cast)
+    zero_quantized_rounding: Literal["stochastic", "nearest"] = \
+        "stochastic"
     mics_shard_size: int = -1  # MiCS sub-cluster size (ref zero/config.py)
     mics_hierarchical_params_gather: bool = False
     round_robin_gradients: bool = False

@@ -175,6 +175,13 @@ class DeepSpeedEngine:
             abstract = jax.eval_shape(lambda: params_host)
         else:
             abstract = jax.eval_shape(self.module.init, rng)
+        if zcfg.zero_hierarchical_allgather:
+            from .zeropp import hierarchical_allgather_unsupported_reason
+            why = hierarchical_allgather_unsupported_reason(
+                self.mesh, hpz=zcfg.zero_hpz_partition_size > 1,
+                mics=zcfg.mics_shard_size > 1)
+            if why is not None:
+                raise ValueError(why)
         self.plan = ZeroShardingPlan(
             self.zero_stage, self.mesh, rules, abstract,
             offload_optimizer=zcfg.offload_optimizer.device == "cpu",
@@ -507,24 +514,35 @@ class DeepSpeedEngine:
 
     def _make_grad_fn(self, micro_loss):
         """value_and_grad, or the ZeRO++ explicit-collective version when
-        qwZ/qgZ are enabled (runtime/zeropp.py)."""
+        qwZ/qgZ/the hierarchical two-hop wire are enabled
+        (runtime/zeropp.py)."""
         zcfg = self.config.zero_optimization
         qw, qg = zcfg.zero_quantized_weights, zcfg.zero_quantized_gradients
-        if not (qw or qg):
+        hier = zcfg.zero_hierarchical_allgather
+        if not (qw or qg or hier):
             return jax.value_and_grad(micro_loss, has_aux=True)
-        from .zeropp import (quantized_value_and_grad,
-                             supports_quantized_collectives)
-        if not supports_quantized_collectives(self.mesh):
+        from .zeropp import (quantized_collectives_unsupported_reason,
+                             quantized_value_and_grad)
+        why = quantized_collectives_unsupported_reason(self.mesh)
+        if why is not None:
             logger.warning(
-                "zero_quantized_weights/gradients requested but the mesh "
-                "has tp/sp/pp/ep axes; falling back to XLA's full-precision "
-                "collectives (ZeRO++ is a sharded-DP feature)")
+                f"{why} Falling back to XLA's full-precision implicit "
+                "collectives for this run.")
             return jax.value_and_grad(micro_loss, has_aux=True)
+        if (zcfg.zero_quantized_dtype == "fp8"
+                and zcfg.zero_quantized_rounding == "stochastic"):
+            logger.warning(
+                "zero_quantized_dtype=fp8 rounds via the native float8 "
+                "cast; zero_quantized_rounding=stochastic (the default) "
+                "has no effect on the fp8 wire — set the int8 wire for "
+                "stochastic gradient rounding")
         return quantized_value_and_grad(
             micro_loss, self.mesh, self.plan.param_specs,
             self.plan.grad_specs, self.topology.batch_axes(),
             quantize_weights=qw, quantize_gradients=qg,
-            wire_dtype=zcfg.zero_quantized_dtype)
+            wire_dtype=zcfg.zero_quantized_dtype,
+            hierarchical=hier,
+            rounding=zcfg.zero_quantized_rounding)
 
     def _build_train_step(self):
         ga = self._scan_ga or self.gradient_accumulation_steps_

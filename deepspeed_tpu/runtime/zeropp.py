@@ -1,7 +1,7 @@
-"""ZeRO++ quantized collectives (reference: blogs/zeropp, runtime code in
-``runtime/zero/partition_parameters.py:761`` CUDAQuantizer for qwZ and
-``runtime/comm/coalesced_collectives.py:31`` all_to_all_quant_reduce for
-qgZ).
+"""ZeRO++ quantized + hierarchical collectives (reference: blogs/zeropp,
+runtime code in ``runtime/zero/partition_parameters.py:761`` CUDAQuantizer
+for qwZ and ``runtime/comm/coalesced_collectives.py:31``
+all_to_all_quant_reduce for qgZ).
 
 The reference halves/quarters collective bytes by bracketing NCCL calls
 with CUDA (de)quantization kernels. The TPU build does the same inside the
@@ -11,18 +11,29 @@ become *our* collectives, carrying int8 payloads + per-block scales over
 ICI instead of XLA's implicit bf16/f32 collectives:
 
 - **qwZ** — each device quantizes its local parameter shard to int8
-  (block-wise symmetric, ops/pallas/quantization.py), all-gathers the int8
-  payload and scales along the sharded axes, and dequantizes locally:
-  ~2x fewer all-gather bytes vs bf16.
+  (block-wise symmetric, ops/pallas/quantization.py — the Pallas kernel
+  on TPU, so the quantize is one HBM pass fused against the collective),
+  all-gathers the int8 payload and scales along the sharded axes, and
+  dequantizes locally: ~4x fewer all-gather bytes vs fp32.
 - **qgZ** — full-size local gradients are chunked along the shard dim,
-  each chunk block-quantized, exchanged with a single all-to-all, and the
-  received chunks dequantized and summed: a reduce-scatter at int8 wire
-  width. Remaining pure-DP mesh axes are reduced with a plain psum (they
-  carry no shard structure to scatter over).
+  each chunk block-quantized (optionally with unbiased stochastic
+  rounding keyed on the training step), exchanged with a single
+  all-to-all, and the received chunks dequantized and summed: a
+  reduce-scatter at int8 wire width. The real implementation lives in
+  runtime/comm/coalesced_collectives.py (this module delegates).
+  Remaining pure-DP mesh axes are reduced with a plain psum (they carry
+  no shard structure to scatter over).
+- **hierarchical two-hop** (``hierarchical=True``, fsdp×zps meshes) —
+  weight gathers run intra-``zps`` first (fast links, full precision)
+  then inter-``fsdp`` (slow links, quantized when qwZ is on); gradient
+  exchanges reduce intra-``zps`` first then exchange the 1/zps-sized
+  partials inter-``fsdp``. Slow-link traffic drops by the zps factor on
+  both directions, on top of the 4x from the int8 payload.
 
-hpZ/MiCS are *not* here — they are sharding-plan features (the ``zps``
-mesh sub-axis, see runtime/zero.py): placement alone makes XLA emit the
-hierarchical collectives.
+hpZ/MiCS remain sharding-plan features (the ``zps`` mesh sub-axis, see
+runtime/zero.py): placement alone makes XLA emit their hierarchical
+collectives. The two-hop path here is for the full fsdp×zps shard
+(MiCS-style split with FULL 1/N memory), where both axes carry traffic.
 
 Scope: quantized collectives apply to the pure sharded-DP regime
 (tp=sp=pp=ep=1), matching the reference where ZeRO++ is a feature of the
@@ -31,8 +42,7 @@ ZeRO-3 data-parallel path.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,8 +51,10 @@ from jax import lax
 from ..utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
-from ..ops.pallas.quantization import (QBLOCK, quantize_int8,
-                                       quantized_all_gather)
+from ..ops.pallas.quantization import (QBLOCK, quantized_all_gather,
+                                       wire_bytes_per_element)
+from .comm.coalesced_collectives import (
+    hierarchical_quantized_reduce_scatter, quantized_reduce_scatter)
 
 PyTree = Any
 
@@ -50,6 +62,10 @@ PyTree = Any
 # rounding error aren't worth it (reference keeps small params in the
 # persistence threshold, zero/config.py stage3_param_persistence_threshold).
 MIN_QUANT_SIZE = 2 ** 12
+
+# the inner (fast-link) axis of a hierarchically split shard group — the
+# zps subgroup carved out of fsdp (parallel/mesh.py AXIS_ORDER)
+INNER_AXIS = "zps"
 
 
 def _sharded_dims(spec: PartitionSpec) -> list[tuple[int, tuple[str, ...]]]:
@@ -63,40 +79,14 @@ def _sharded_dims(spec: PartitionSpec) -> list[tuple[int, tuple[str, ...]]]:
     return out
 
 
-def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
-                             dim: int,
-                             wire_dtype: str = "int8") -> jax.Array:
-    """qgZ: chunk `g` (full-size local gradient) along `dim`, quantize each
-    chunk, exchange with one int8/fp8 all-to-all, dequantize + sum received
-    chunks. Returns this device's gradient shard (SUM semantics). Must run
-    inside shard_map.
-
-    The reference's qgZ additionally swizzles chunks for a two-hop
-    intra/inter-node exchange (csrc/quantization/swizzled_quantize.cu); on
-    TPU the single all-to-all already rides ICI neighbor links, and
-    hierarchy comes from the zps mesh split instead.
-    """
-    from ..ops.pallas.quantization import quantize_fp8
-
-    world = lax.psum(1, axes)  # mesh axis size: static under jit
-    # chunk along dim: [world, ...chunk...]; quantize each chunk
-    # independently so no block straddles a chunk boundary
-    chunks = jnp.stack(jnp.split(g, world, axis=dim), axis=0)
-
-    def quant_chunk(c):
-        if wire_dtype == "fp8":
-            q, s, _ = quantize_fp8(c)
-        else:
-            q, s, _ = quantize_int8(c, use_pallas=False)
-        return q, s
-
-    q, s = jax.vmap(quant_chunk)(chunks.reshape(world, -1))
-    qx = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
-    sx = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
-    deq = qx.astype(jnp.float32) * sx                   # [world, bpc, QBLOCK]
-    summed = jnp.sum(deq, axis=0).reshape(-1)
-    m = chunks.shape[1:]
-    return summed[: int(np.prod(m))].reshape(m).astype(g.dtype)
+def _split_hier(axes: tuple[str, ...]) -> \
+        Optional[tuple[tuple[str, ...], tuple[str, ...]]]:
+    """(outer, inner) when ``axes`` contain the inner zps axis plus at
+    least one outer axis — the shape the two-hop collectives need."""
+    if INNER_AXIS not in axes or len(axes) < 2:
+        return None
+    outer = tuple(a for a in axes if a != INNER_AXIS)
+    return outer, (INNER_AXIS,)
 
 
 def _log_wire(op: str, nbytes: int) -> None:
@@ -110,12 +100,54 @@ def _log_wire(op: str, nbytes: int) -> None:
         lg.append(op, int(nbytes))
 
 
-def _gather_param(x, spec, quantized: bool, wire_dtype: str = "int8"):
+def _quant_bytes(n: int, wire_dtype: str) -> int:
+    return int(n * wire_bytes_per_element(wire_dtype, QBLOCK))
+
+
+def hierarchical_all_gather(x, outer_axes: tuple[str, ...],
+                            inner_axes: tuple[str, ...], dim: int,
+                            quantized: bool = False,
+                            wire_dtype: str = "int8"):
+    """Two-hop weight all-gather: gather over the fast inner links
+    first (full precision — intra-group bytes are cheap and the hop
+    feeds the second quantize, so precision is free), then over the
+    slow outer links, quantized when qwZ is on. Bit-equivalent to the
+    one-hop gather at fp32 wire (pure concatenation reordering is the
+    identity here: chunk order stays outer-major/inner-minor). Must run
+    inside shard_map."""
+    x = lax.all_gather(x, inner_axes, axis=dim, tiled=True)
+    if quantized:
+        return quantized_all_gather(x, outer_axes, dim,
+                                    wire_dtype=wire_dtype)
+    return lax.all_gather(x, outer_axes, axis=dim, tiled=True)
+
+
+def _gather_param(x, spec, quantized: bool, wire_dtype: str = "int8",
+                  hierarchical: bool = False):
     """Reassemble a full parameter from its local shard inside shard_map."""
     for dim, axes in _sharded_dims(spec):
-        if quantized and x.size >= MIN_QUANT_SIZE:
+        quant = quantized and x.size >= MIN_QUANT_SIZE
+        hier = _split_hier(axes) if hierarchical else None
+        if hier is not None:
+            outer, inner = hier
+            # hop 1 bytes ride fast links at full precision; hop 2
+            # carries the whole inner-gathered tensor (local shard x
+            # inner group size, static under jit) over slow links
+            inner_world = lax.psum(1, inner)
+            _log_wire("all_gather(inner)", x.size * x.dtype.itemsize)
+            outer_n = x.size * int(inner_world)
+            if quant:
+                _log_wire(f"quantized_all_gather({wire_dtype},outer)",
+                          _quant_bytes(outer_n, wire_dtype))
+            else:
+                _log_wire("all_gather(outer)",
+                          outer_n * x.dtype.itemsize)
+            x = hierarchical_all_gather(x, outer, inner, dim,
+                                        quantized=quant,
+                                        wire_dtype=wire_dtype)
+        elif quant:
             _log_wire(f"quantized_all_gather({wire_dtype})",
-                      x.size * 1 + x.size // QBLOCK * 4)
+                      _quant_bytes(x.size, wire_dtype))
             x = quantized_all_gather(x, axes, dim, wire_dtype=wire_dtype)
         else:
             _log_wire("all_gather", x.size * x.dtype.itemsize)
@@ -124,16 +156,27 @@ def _gather_param(x, spec, quantized: bool, wire_dtype: str = "int8"):
 
 
 def _reduce_grad(g, spec, batch_axes, n_batch, quantized: bool,
-                 wire_dtype: str = "int8"):
+                 wire_dtype: str = "int8", hierarchical: bool = False,
+                 rounding: str = "nearest", seed=0):
     """Reduce a full-size local gradient to its shard inside shard_map."""
     shard_axes: set[str] = set()
     for dim, axes in _sharded_dims(spec):
         shard_axes.update(axes)
-        if quantized and g.size >= MIN_QUANT_SIZE * 4:
+        quant = quantized and g.size >= MIN_QUANT_SIZE * 4
+        hier = _split_hier(axes) if hierarchical else None
+        if quant and hier is not None:
+            outer, inner = hier
+            _log_wire(f"quantized_reduce_scatter({wire_dtype},2hop)",
+                      _quant_bytes(g.size, wire_dtype))
+            g = hierarchical_quantized_reduce_scatter(
+                g, outer, inner, dim, wire_dtype=wire_dtype,
+                rounding=rounding, seed=seed)
+        elif quant:
             _log_wire(f"quantized_reduce_scatter({wire_dtype})",
-                      g.size * 1 + g.size // QBLOCK * 4)
+                      _quant_bytes(g.size, wire_dtype))
             g = quantized_reduce_scatter(g, axes, dim,
-                                         wire_dtype=wire_dtype)
+                                         wire_dtype=wire_dtype,
+                                         rounding=rounding, seed=seed)
         else:
             _log_wire("reduce_scatter", g.size * g.dtype.itemsize)
             g = lax.psum_scatter(g, axes, scatter_dimension=dim, tiled=True)
@@ -149,10 +192,17 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
                              batch_axes: tuple[str, ...], *,
                              quantize_weights: bool,
                              quantize_gradients: bool,
-                             wire_dtype: str = "int8") -> Callable:
+                             wire_dtype: str = "int8",
+                             hierarchical: bool = False,
+                             rounding: str = "nearest") -> Callable:
     """Drop-in for ``jax.value_and_grad(micro_loss, has_aux=True)`` in the
     engine's compiled step, with explicit quantized collectives
     (``wire_dtype``: "int8" or "fp8" e4m3 payloads).
+
+    ``hierarchical`` turns shard-dim collectives over fsdp×zps into the
+    two-hop forms (intra-zps first); ``rounding`` picks the gradient
+    wire's rounding mode ("stochastic" = unbiased floor-plus-uniform
+    keyed on the step counter, "nearest" = round-to-nearest).
 
     ``micro_loss(params, batch, scale, step) -> (scaled_loss, loss)``;
     returns ``fn(params, batch, scale, step) -> ((scaled, loss), grads)``
@@ -161,14 +211,12 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
     """
     batch_axes = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
     n_batch = int(np.prod([mesh.shape[a] for a in batch_axes])) or 1
-    specs_leaves = jax.tree.leaves(
-        param_specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
 
     def fn(params, batch, scale, step):
         def body(params_local, batch_local, scale, step):
             full = jax.tree.map(
                 lambda x, s: _gather_param(x, s, quantize_weights,
-                                           wire_dtype),
+                                           wire_dtype, hierarchical),
                 params_local, _as_tree(param_specs, params_local))
 
             def scaled(p):
@@ -180,7 +228,8 @@ def quantized_value_and_grad(micro_loss: Callable, mesh: Mesh,
             g_shard = jax.tree.map(
                 lambda g, s: _reduce_grad(
                     g.astype(jnp.float32), s, batch_axes, n_batch,
-                    quantize_gradients, wire_dtype),
+                    quantize_gradients, wire_dtype, hierarchical,
+                    rounding, step),
                 g_full, _as_tree(grad_specs, g_full))
             # loss values: mean over the global batch
             sl = lax.pmean(sl, batch_axes)
@@ -258,6 +307,56 @@ def _as_tree(spec_tree, like):
                         is_leaf=lambda x: isinstance(x, PartitionSpec)))
 
 
+def quantized_collectives_unsupported_reason(mesh: Mesh) -> Optional[str]:
+    """None when qwZ/qgZ apply, else a message naming the EXACT mesh
+    constraint that fails (ISSUE 8 satellite: the old boolean forced
+    users to guess which axis broke the pure-sharded-DP requirement)."""
+    bad = {a: int(mesh.shape[a]) for a in ("tp", "sp", "pp", "ep")
+           if mesh.shape.get(a, 1) > 1}
+    if not bad:
+        return None
+    axes = ", ".join(f"{a}={n}" for a, n in sorted(bad.items()))
+    return (
+        "quantized collectives (zero_quantized_weights/gradients) "
+        "require a pure sharded-DP mesh — every model-parallel axis "
+        f"must be 1, but this mesh has {axes}. Those axes' collectives "
+        "live inside the model forward where the explicit-SPMD wire "
+        "protocol cannot intercept them (ZeRO++ is a ZeRO-3 "
+        "data-parallel feature). Drop the quantization flags or set "
+        f"mesh.{{{'/'.join(sorted(bad))}}} to 1.")
+
+
 def supports_quantized_collectives(mesh: Mesh) -> bool:
     """qwZ/qgZ apply in the pure sharded-DP regime (see module docstring)."""
-    return all(mesh.shape.get(a, 1) == 1 for a in ("tp", "sp", "pp", "ep"))
+    return quantized_collectives_unsupported_reason(mesh) is None
+
+
+def hierarchical_allgather_unsupported_reason(
+        mesh: Mesh, hpz: bool = False, mics: bool = False) -> \
+        Optional[str]:
+    """None when the two-hop fsdp×zps collectives apply, else the exact
+    failing constraint. Hierarchy needs BOTH shard axes to carry
+    traffic: a real zps split (zps > 1) with params sharded over the
+    full fsdp×zps extent (hpZ/MiCS replicate params across fsdp — their
+    placement is already hierarchical, the flag adds nothing)."""
+    zps = int(mesh.shape.get("zps", 1))
+    fsdp = int(mesh.shape.get("fsdp", 1))
+    if zps <= 1:
+        return ("zero_hierarchical_allgather requires the mesh's zps "
+                f"axis > 1 (got zps={zps}); set mesh.zps (the MiCS-"
+                "style fsdp×zps split) so the two-hop collectives have "
+                "an inner group to gather over")
+    if fsdp <= 1:
+        return ("zero_hierarchical_allgather requires an outer fsdp "
+                f"axis > 1 alongside zps={zps} (got fsdp={fsdp}); with "
+                "a single outer group there is no slow-link hop to "
+                "save")
+    if hpz or mics:
+        which = "zero_hpz_partition_size" if hpz else "mics_shard_size"
+        return (f"zero_hierarchical_allgather is incompatible with "
+                f"{which}: hpZ/MiCS already replicate parameters "
+                "across fsdp (sharding only over zps), so weight "
+                "gathers never touch the slow links — the two-hop "
+                "gather needs params sharded over the full fsdp×zps "
+                "extent")
+    return None

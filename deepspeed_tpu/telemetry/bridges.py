@@ -220,6 +220,14 @@ def collect_ledger(reg: MetricsRegistry, peak_flops: float = 0.0) -> None:
         for (axis, op), row in traffic.items():
             byts.set_total(row["bytes"], axis=axis, op=op)
             sites.set_total(row["sites"], axis=axis, op=op)
+        wire = reg.gauge(
+            "ds_hlo_wire_bytes_per_el",
+            "observed collective wire width per mesh axis "
+            "(bytes/element; ~1.1 when ZeRO++ qwZ/qgZ int8 payloads "
+            "+ fp32 block scales carry the traffic, 4.0 at fp32)")
+        from .collectives import axis_wire_width
+        for axis, width in axis_wire_width(traffic).items():
+            wire.set(round(width, 4), axis=axis)
 
 
 def collect_throughput(reg: MetricsRegistry, tput_timer) -> None:

@@ -70,17 +70,22 @@ def _dtype_bytes(name: str) -> int:
     return 4
 
 
-def _shapes_bytes(text: str) -> int:
-    """Total bytes of every ``dtype[dims]`` shape token in ``text``
-    (handles variadic tuple results)."""
+def _shapes_bytes(text: str) -> tuple[int, int]:
+    """(total bytes, total elements) of every ``dtype[dims]`` shape
+    token in ``text`` (handles variadic tuple results). The ratio is
+    the instruction's effective wire width — 1.x bytes/element once
+    qwZ/qgZ put int8/fp8 payloads (plus fp32 block scales) on the
+    wire, 4.0 for a plain fp32 collective."""
     total = 0
+    elements = 0
     for dtype, dims in _SHAPE_RE.findall(text):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
         total += n * _dtype_bytes(dtype)
-    return total
+        elements += n
+    return total, elements
 
 
 def _parse_groups(line: str) -> Optional[list[list[int]]]:
@@ -163,12 +168,16 @@ def _permute_axis(pairs: list[tuple[int, int]], mesh) -> Optional[str]:
 def analyze_hlo(hlo_text: str, mesh=None,
                 n_devices: Optional[int] = None) -> list[dict]:
     """Per-collective-instruction records
-    ``{op, hlo_op, bytes, group_size, axis, groups}`` from optimized
-    HLO text. ``bytes`` is the full logical payload per device group
-    participant (the reference comms-logging convention get_bw
-    expects: full tensor for all-reduce / gathered output for
-    all-gather / full input for reduce-scatter). Async ``-start`` ops
-    count once; their ``-done`` halves are ignored."""
+    ``{op, hlo_op, bytes, elements, wire_bytes_per_el, group_size,
+    axis, groups}`` from optimized HLO text. ``bytes`` is the full
+    logical payload per device group participant (the reference
+    comms-logging convention get_bw expects: full tensor for
+    all-reduce / gathered output for all-gather / full input for
+    reduce-scatter), decoded from the actual result dtypes — an int8
+    qwZ/qgZ payload counts 1 byte/element, so the quantized wire's win
+    lands in ``ds_hlo_collective_bytes_total{axis,op}`` without any
+    assumed element width. Async ``-start`` ops count once; their
+    ``-done`` halves are ignored."""
     axis_table = mesh_axis_groups(mesh)
     records: list[dict] = []
     for line in hlo_text.splitlines():
@@ -176,7 +185,7 @@ def analyze_hlo(hlo_text: str, mesh=None,
         if m is None or "-done" in line.split("=", 1)[0]:
             continue
         hlo_op = m.group("op")
-        out_bytes = _shapes_bytes(m.group("shapes"))
+        out_bytes, out_elements = _shapes_bytes(m.group("shapes"))
         groups = _parse_groups(line)
         axis = None
         if hlo_op == "collective-permute":
@@ -203,12 +212,17 @@ def analyze_hlo(hlo_text: str, mesh=None,
         if group_size <= 1:
             continue        # degenerate single-participant group
         payload = out_bytes
+        elements = out_elements
         if hlo_op == "reduce-scatter":
             payload = out_bytes * group_size
+            elements = out_elements * group_size
         records.append({
             "op": HLO_TO_COMM_OP[hlo_op],
             "hlo_op": hlo_op + ("-start" if m.group("start") else ""),
             "bytes": int(payload),
+            "elements": int(elements),
+            "wire_bytes_per_el": (payload / elements if elements
+                                  else 0.0),
             "group_size": int(group_size),
             "axis": axis or f"n{group_size}",
             "groups": len(groups) if groups else 1,
@@ -223,9 +237,11 @@ def traffic_matrix(records: list[dict], calls: int = 1) -> dict:
     out: dict = {}
     for r in records:
         key = (r["axis"], r["op"])
-        row = out.setdefault(key, {"bytes": 0, "sites": 0,
+        row = out.setdefault(key, {"bytes": 0, "elements": 0,
+                                   "sites": 0,
                                    "group_size": r["group_size"]})
         row["bytes"] += r["bytes"] * calls
+        row["elements"] += r.get("elements", 0) * calls
         row["sites"] += 1
         row["group_size"] = max(row["group_size"], r["group_size"])
     return out
@@ -277,10 +293,27 @@ def merge_traffic(*matrices: dict) -> dict:
     out: dict = {}
     for mat in matrices:
         for key, row in mat.items():
-            dst = out.setdefault(key, {"bytes": 0, "sites": 0,
+            dst = out.setdefault(key, {"bytes": 0, "elements": 0,
+                                       "sites": 0,
                                        "group_size": row["group_size"]})
             dst["bytes"] += row["bytes"]
+            dst["elements"] += row.get("elements", 0)
             dst["sites"] += row["sites"]
             dst["group_size"] = max(dst["group_size"],
                                     row["group_size"])
     return out
+
+
+def axis_wire_width(traffic: dict) -> dict[str, float]:
+    """Per-axis effective wire width (bytes/element) over a traffic
+    matrix — the observed number the autotuning calibration records
+    (``Calibration.axis_wire_bytes_per_el``): ~4.0 on an fp32 wire,
+    ~1.1 once qwZ/qgZ carry int8 payloads + fp32 block scales. Axes
+    with no element accounting are omitted."""
+    agg: dict[str, list[float]] = {}
+    for (axis, _op), row in traffic.items():
+        if row.get("elements", 0) > 0:
+            a = agg.setdefault(axis, [0.0, 0.0])
+            a[0] += row["bytes"]
+            a[1] += row["elements"]
+    return {axis: b / e for axis, (b, e) in agg.items() if e > 0}

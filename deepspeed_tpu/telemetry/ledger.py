@@ -268,6 +268,15 @@ class ExecutableLedger:
         return _collectives.axis_bandwidth_bounds(self.traffic(),
                                                   window_s)
 
+    def axis_wire_bytes_per_el(self) -> dict:
+        """{axis: observed wire bytes/element} over every registered
+        executable's collective traffic — 4.0 on an fp32 wire, ~1.1
+        once the ZeRO++ quantized collectives carry int8 payloads +
+        fp32 block scales. Recorded into autotuning calibrations
+        (``Calibration.axis_wire_bytes_per_el``) so plan artifacts
+        show which wire the bandwidth floors were measured at."""
+        return _collectives.axis_wire_width(self.traffic())
+
     def collective_bytes_by_axis(self, name: str) -> dict:
         """{axis: per-DISPATCH collective payload bytes} for one jit
         name, call-weighted across its live signatures — the comm

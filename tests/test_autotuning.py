@@ -283,6 +283,83 @@ def test_planner_rank_determinism_synthetic(monkeypatch):
     assert p.apply() == pl.trial_config(pl._row_candidate(p.chosen))
 
 
+def test_quantized_wire_facts_transform():
+    """Analytic wire transform (ISSUE 8): sharded-DP axis bytes scale
+    by the int8+scales ratio, other axes and flops stay, and the
+    quantize/dequant bracket charges bytes_accessed."""
+    from deepspeed_tpu.autotuning.cost_model import (quantized_wire_facts,
+                                                     wire_dtype_bytes)
+    facts = AOTFacts(flops=1e9, bytes_accessed=1e8,
+                     collective_bytes_by_axis={"fsdp": 4e6,
+                                               "fsdp+zps": 8e6,
+                                               "tp": 2e6})
+    q = quantized_wire_facts(facts, "int8")
+    ratio = wire_dtype_bytes("int8") / 4.0
+    assert q.collective_bytes_by_axis["fsdp"] == pytest.approx(
+        4e6 * ratio)
+    assert q.collective_bytes_by_axis["fsdp+zps"] == pytest.approx(
+        8e6 * ratio)
+    assert q.collective_bytes_by_axis["tp"] == 2e6   # not a DP axis
+    assert q.bytes_accessed == pytest.approx(1e8 + 2 * 12e6)
+    assert q.flops == facts.flops
+    assert quantized_wire_facts(facts, "fp32") is facts
+
+
+def test_planner_selects_quantized_wire_by_regime(monkeypatch):
+    """Acceptance (ISSUE 8): with wire_dtypes in the grid, the planner
+    picks the int8 wire when the calibration says the step is
+    bandwidth-bound, and rejects it (keeps fp32) when compute-bound —
+    deterministic, against synthetic calibrations, no engine builds
+    (the analytic wire transform scores the variants)."""
+    base_facts = AOTFacts(flops=1e12, bytes_accessed=1e9,
+                          peak_hbm_bytes=10**8, memory={"peak": 10**8},
+                          collective_bytes_by_axis={"fsdp": 4e9},
+                          collective_sites=4)
+    monkeypatch.setattr(Planner, "_build_engine",
+                        lambda self, cand: object())
+    monkeypatch.setattr(Planner, "_collect_facts",
+                        lambda self, engine, batch: base_facts)
+    base = {"mesh": {"fsdp": -1},
+            "train_micro_batch_size_per_gpu": 2,
+            "zero_optimization": {"stage": 3}}
+    cfg = AutotuningConfig(enabled=True, zero_stages=[3],
+                           min_train_micro_batch_size_per_gpu=2,
+                           num_tuning_micro_batch_sizes=1,
+                           wire_dtypes=["fp32", "int8"],
+                           measure_top_k=0)
+
+    def plan_with(cal):
+        return Planner(GPT2(size="tiny"), base, cfg,
+                       make_batch=lambda n: None, calibration=cal,
+                       device_memory_bytes=0).plan()
+
+    # bandwidth-bound: slow fsdp links, no mem roofline — the int8
+    # wire's byte credit dominates the bracket cost
+    bw_bound = Calibration(flops_per_s=1e12, overhead_s=1e-3,
+                           axis_algbw_bytes_per_s={"fsdp": 5e9},
+                           baseline_comm_bytes_by_axis={"fsdp": 4e9},
+                           overlap_ratio=0.0)
+    plan = plan_with(bw_bound)
+    assert plan.chosen["wire_dtype"] == "int8"
+    ranked = plan.ranked()
+    by_wire = {r["wire_dtype"]: r for r in ranked}
+    assert by_wire["int8"]["predicted_step_ms"] < \
+        by_wire["fp32"]["predicted_step_ms"]
+    assert "wire=int8" in by_wire["int8"]["label"]
+
+    # compute-bound: fat links hide the byte win, the HBM roofline
+    # charges the quantize/dequant bracket — fp32 wire stays
+    cp_bound = Calibration(flops_per_s=1e12, overhead_s=1e-3,
+                           mem_bw_bytes_per_s=1e9,
+                           axis_algbw_bytes_per_s={"fsdp": 1e15},
+                           baseline_comm_bytes_by_axis={"fsdp": 4e9},
+                           overlap_ratio=0.71)
+    plan2 = plan_with(cp_bound)
+    assert plan2.chosen["wire_dtype"] == "fp32"
+    # determinism: same inputs, byte-identical plan artifact
+    assert plan_with(bw_bound).to_json() == plan.to_json()
+
+
 @pytest.mark.filterwarnings("ignore::UserWarning")
 def test_planner_measured_top_k_chooses_best(devices8):
     """Slow tier: calibration fits from real measured steps, the top-K

@@ -128,3 +128,59 @@ def test_reduce_gather_scatter_send(devices8):
     np.testing.assert_allclose(np.asarray(sca), np.arange(8))
     snt = np.asarray(snt)
     assert snt[3] == 5.0 and snt[0] == 0.0
+
+
+def test_flat_padded_block_alignment():
+    """_flat_padded pads to lcm(world, block), not just the group size:
+    with block quantization a group-size-only pad lets a quantization
+    block straddle the per-rank chunk boundary (ISSUE 8 satellite)."""
+    import math
+
+    from deepspeed_tpu.ops.pallas.quantization import QBLOCK
+    from deepspeed_tpu.runtime.comm.coalesced_collectives import \
+        _flat_padded
+
+    t = jnp.arange(8 * 513 + 5, dtype=jnp.float32)
+    out = _flat_padded(t, 8, block=QBLOCK)
+    assert out.size % math.lcm(8, QBLOCK) == 0
+    assert (out.size // 8) % QBLOCK == 0       # per-rank chunk aligned
+    # a bare lcm pad would NOT chunk-align here (8 divides 512), which
+    # is why the implementation pads to world x block
+    assert math.lcm(8, QBLOCK) == QBLOCK
+    np.testing.assert_allclose(np.asarray(out[: t.size]), np.asarray(t))
+    assert float(jnp.abs(out[t.size:]).sum()) == 0.0
+    # block=1 keeps the reference group-size-only contract
+    assert _flat_padded(t, 8).size == t.size + (-t.size) % 8
+
+
+def test_all_to_all_quant_reduce_odd_sizes(devices8):
+    """qgZ over the tensor-list API: SUM semantics on odd-sized tensors
+    whose flat size is neither a world nor a QBLOCK multiple, nearest
+    and stochastic rounding (ISSUE 8 satellite regression)."""
+    import math
+
+    from deepspeed_tpu.ops.pallas.quantization import QBLOCK
+    from deepspeed_tpu.runtime.comm.coalesced_collectives import \
+        all_to_all_quant_reduce
+
+    topo = _mk_topo()
+    sizes = (8 * 513 + 5, 257)
+    tensors = [jax.random.normal(jax.random.PRNGKey(i), (n,))
+               for i, n in enumerate(sizes)]
+
+    for rounding in ("nearest", "stochastic"):
+        def body(*ts):
+            return tuple(all_to_all_quant_reduce(
+                list(ts), group="fsdp", rounding=rounding, seed=5))
+
+        outs = shard_map(
+            body, mesh=topo.mesh,
+            in_specs=tuple(P() for _ in tensors),
+            out_specs=tuple(P("fsdp") for _ in tensors),
+            check_vma=False)(*tensors)
+        for t, out in zip(tensors, outs):
+            flat = np.asarray(out)
+            padded = t.size + (-t.size) % (8 * QBLOCK)
+            assert flat.size == padded
+            ref = 8 * np.pad(np.asarray(t), (0, padded - t.size))
+            np.testing.assert_allclose(flat, ref, rtol=5e-2, atol=3e-1)

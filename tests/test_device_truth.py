@@ -131,6 +131,50 @@ def test_analyze_hlo_synthetic_text():
     assert mat[key]["bytes"] == 4 * 16 * 4 * 3
 
 
+_WIRE_HLO = """
+HloModule wire
+%q = s8[4,512]{1,0} all-gather(s8[1,512]{1,0} %a), channel_id=1, replica_groups={{0,1,2,3}}, dimensions={0}
+%s = f32[4,1]{1,0} all-gather(f32[1,1]{1,0} %b), channel_id=2, replica_groups={{0,1,2,3}}, dimensions={0}
+%g = f32[2,512]{1,0} reduce-scatter(f32[8,512]{1,0} %c), channel_id=3, replica_groups={{0,1},{2,3}}, dimensions={0}, to_apply=%sum
+"""
+
+
+def test_analyze_hlo_wire_dtype_accounting():
+    """Quantized-wire payloads count at their ACTUAL dtype width
+    (ISSUE 8): an s8 all-gather is 1 byte/element, its fp32 scales 4,
+    and the per-axis wire width folds both — so the qwZ/qgZ win lands
+    in ds_hlo_collective_bytes_total without any assumed element
+    size, and calibration algbw floors stay unit-consistent."""
+    recs = collectives.analyze_hlo(_WIRE_HLO, mesh=None, n_devices=4)
+    codes, scales, grads = recs
+    assert codes["bytes"] == 4 * 512 * 1
+    assert codes["elements"] == 4 * 512
+    assert codes["wire_bytes_per_el"] == 1.0
+    assert scales["bytes"] == 4 * 1 * 4
+    assert scales["wire_bytes_per_el"] == 4.0
+    assert grads["bytes"] == 2 * 512 * 4 * 2   # full input, fp32
+    mat = collectives.traffic_matrix(recs)
+    width = collectives.axis_wire_width(mat)
+    # codes + scales fold on the n4 axis: (2048*1 + 4*4)/(2048 + 4)
+    assert width["n4"] == pytest.approx((2048 + 16) / 2052)
+    assert width["n2"] == 4.0
+    # ledger rollup exposes the same number for calibrations
+    led = ledger.ExecutableLedger(hlo_collectives=False)
+    e = ledger.ExecutableEntry("compiled_step", ())
+    e.collectives, e.calls, e.flops = recs, 2, 1e9
+    led._entries[("compiled_step", ())] = e
+    assert led.axis_wire_bytes_per_el()["n4"] == \
+        pytest.approx((2048 + 16) / 2052)
+    from deepspeed_tpu.autotuning.cost_model import Calibration
+    cal = Calibration.from_telemetry(
+        led, {"compiled_step": (0.5, 2)}, window_s=0.5)
+    assert cal.axis_wire_bytes_per_el["n4"] == \
+        pytest.approx((2048 + 16) / 2052)
+    # algbw floor divides the OBSERVED (1-byte) payload by the window
+    assert cal.axis_algbw_bytes_per_s["n4"] == pytest.approx(
+        2 * (2048 + 16) / 0.5)
+
+
 def test_ledger_attributes_allreduce_to_mesh_axis(devices8):
     """Acceptance: nonzero all-reduce bytes, attributed to the right
     mesh axis, for a dp>1 collective on the virtual multichip mesh."""

@@ -447,6 +447,50 @@ def test_baseline_roundtrip(tmp_path):
 # the package-wide gate (acceptance: exits clean vs committed baseline)
 # ---------------------------------------------------------------------
 
+def test_shard_map_quantized_collective_body_is_clean(tmp_path):
+    """ISSUE 8 satellite: the qgZ wire bodies are jit-reachable
+    shard_map code full of constructs adjacent to GL001/GL012 bait —
+    PRNG key fold-ins over axis indices, floor/clip rounding, vmapped
+    quantizers, all_to_all exchanges. None of it host-syncs or
+    host-effects, and the linter must stay quiet on the pattern (no
+    shard_map-aware carve-out turned out to be needed; this fixture
+    pins that)."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        def _axis_key(seed, axes):
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(jnp.uint32(0)),
+                jnp.asarray(seed, jnp.uint32))
+            for a in axes:
+                key = jax.random.fold_in(key, lax.axis_index(a))
+            return key
+
+        def quantized_reduce_scatter(g, seed):
+            axes = ("fsdp",)
+            world = lax.psum(1, axes)
+            chunks = jnp.stack(jnp.split(g, world, axis=0), axis=0)
+            key = _axis_key(seed, axes)
+            u = jax.random.uniform(key, chunks.shape)
+            q = jnp.clip(jnp.floor(chunks + u), -127, 127)
+            q = q.astype(jnp.int8)
+            qx = lax.all_to_all(q, axes, split_axis=0, concat_axis=0,
+                                tiled=True)
+            return jnp.sum(qx.astype(jnp.float32), axis=0)
+
+        step = jax.jit(lambda g: quantized_reduce_scatter(g, 3))
+    """
+    res = _lint_src(tmp_path, src)
+    assert res.findings == []
+    # and the control: an actual host sync in the same body DOES fire
+    bad = src.replace("return jnp.sum(qx.astype(jnp.float32), axis=0)",
+                      "return float(jnp.sum(qx))")
+    res = _lint_src(tmp_path, bad)
+    assert any(f.rule == "GL001" for f in res.findings)
+
+
 def test_package_gate_no_new_violations():
     res = lint_paths([PACKAGE], root=REPO)
     assert not res.errors, res.errors

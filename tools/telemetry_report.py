@@ -301,6 +301,20 @@ _GATES = {
         ("plan_vs_baseline", +1, 0.05),
         ("plan_tokens_per_sec", +1, 0.05),
     ),
+    # comms gate (ISSUE 8): the ZeRO++ quantized-wire win is CI-checked
+    # against the previous bench artifact / metrics snapshot — HLO-
+    # accounted collective payload must not creep back up (a sharding
+    # or wire-protocol regression shows up as bytes before it shows up
+    # as time, and the static accounting is noise-free so the
+    # threshold is tight), the achieved sharded-DP reduction must not
+    # shrink, and throughput stays within the usual ±5%.
+    "comms": (
+        ("wire_reduction", +1, 0.02),
+        ("wire_bytes_per_el", -1, 0.02),
+        ("wire_bytes", -1, 0.02),
+        ("collective_bytes", -1, 0.02),
+        ("tokens_per_sec", +1, 0.05),
+    ),
 }
 
 # metric families a gate must NOT touch even though a stem matches by
