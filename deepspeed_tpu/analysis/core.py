@@ -205,6 +205,17 @@ def is_device_call(node: ast.AST) -> bool:
         # transform constructors / explicit transfers are not *hidden*
         # device computations at this site
         return False
+    if chain[-1] in ("psum", "pmax", "pmin", "pmean") and node.args \
+            and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, (int, float)) \
+            and not isinstance(node.args[0].value, bool):
+        # axis-size probe: a collective over a LITERAL operand
+        # constant-folds at trace time (``world = lax.psum(1, axis)``
+        # is THE idiom for a static axis size inside shard_map/pmap) —
+        # host metadata, not a device value, so int()/arithmetic on it
+        # is sync-free (surfaced by the ZeRO++ hierarchical gather,
+        # where GL001 false-fired on exactly this probe)
+        return False
     return True
 
 

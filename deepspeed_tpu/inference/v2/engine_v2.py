@@ -22,7 +22,7 @@ from typing import Any, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ...runtime.config_utils import DeepSpeedConfigModel
 from ...utils.logging import log_dist
@@ -32,7 +32,9 @@ from ...utils.logging import log_dist
 from ...utils.telemetry_probe import (NULL_CM as _NULLCM,
                                       active_telemetry as _telemetry)
 from ..config import DeepSpeedInferenceConfig
-from .paged import fused_decode_loop, fused_serve_loop, paged_forward
+from .paged import (fused_decode_loop, fused_serve_loop,
+                    fused_spec_decode_loop, fused_spec_serve_loop,
+                    paged_forward)
 from .ragged import (PrefixCache, DSStateManager, SequenceDescriptor)
 
 PyTree = Any
@@ -41,10 +43,18 @@ PyTree = Any
 # zeroes exactly these); the prefix-cache counters ride alongside via
 # ragged.PREFIX_STAT_KEYS, and derived ratio/occupancy gauges are
 # appended at read time. telemetry.bridges and bench.py consume the
-# same names.
+# same names. The spec_* counters (ISSUE 9) stay zero with speculative
+# decoding off: spec_proposed_tokens/spec_accepted_tokens are the
+# acceptance-rate numerator/denominator, spec_hit_slots counts
+# (row, tick) slots where the prompt-lookup drafter fired at all.
+# fused_live_slots counts scheduled (row, step) slots whose row was
+# still ACTIVE — the occupancy numerator; spec-off it equals
+# fused_slot_tokens (one token per live slot), spec-on the device
+# loops report it (tokens per live slot is then 1..1+draft_len).
 SERVING_COUNTER_KEYS = (
     "host_dispatches", "fused_dispatches", "fused_steps", "fused_slots",
-    "fused_slot_tokens", "decoded_tokens")
+    "fused_slot_tokens", "fused_live_slots", "decoded_tokens",
+    "spec_proposed_tokens", "spec_accepted_tokens", "spec_hit_slots")
 
 
 class _LatencyProbe:
@@ -136,6 +146,42 @@ class PrefixCacheConfig(DeepSpeedConfigModel):
     max_cached_blocks: int = 0
 
 
+class SpeculativeConfig(DeepSpeedConfigModel):
+    """Self-drafting speculative decoding in the fused serving path
+    (ISSUE 9): a device-side prompt-lookup (n-gram) drafter proposes up
+    to ``draft_len`` tokens per row per tick from the row's own recent
+    token history, and the fused loop verifies them in ONE forward over
+    ``1 + draft_len`` positions — committing 1..1+draft_len tokens per
+    tick. No draft model, no extra weights; greedy output is
+    bit-identical to spec-off, stochastic output is bit-identical for
+    the same seed (targets are position-key sampled, drafts only decide
+    how many land per forward). Off by default; the disabled path
+    builds none of the spec executables."""
+    enabled: bool = False
+    # draft tokens proposed (and verified) per decode tick; the verify
+    # forward runs over 1 + draft_len positions
+    draft_len: int = Field(3, ge=1)
+    # shortest trailing n-gram that may match earlier history; longer
+    # = fewer but better-targeted drafts
+    min_ngram: int = Field(2, ge=1)
+    # device-side recent-token window the drafter searches (per row,
+    # int32) — seeded at admission from the sequence's committed
+    # history (prefix-cache-shared prompt tokens included) and
+    # maintained in-graph
+    history_window: int = Field(64, ge=8)
+
+    @model_validator(mode="after")
+    def _window_covers_match(self):
+        need = self.min_ngram + self.draft_len + 1
+        if self.history_window < need:
+            raise ValueError(
+                f"speculative.history_window ({self.history_window}) "
+                f"must be >= min_ngram + draft_len + 1 ({need}): the "
+                "window must hold one n-gram, its full continuation "
+                "and the trailing n-gram it matches against")
+        return self
+
+
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
     (state_manager block/pool sizing knobs + the fused-decode loop)."""
@@ -182,6 +228,11 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     # hash-chained reuse across requests (see docs/serving.md)
     prefix_cache: PrefixCacheConfig = Field(
         default_factory=PrefixCacheConfig)
+    # speculative decoding (ISSUE 9): prompt-lookup drafting + in-graph
+    # K-token verify in the fused decode/serve loops (see
+    # docs/serving.md)
+    speculative: SpeculativeConfig = Field(
+        default_factory=SpeculativeConfig)
 
 
 class InferenceEngineV2:
@@ -544,6 +595,85 @@ class InferenceEngineV2:
                 out_shardings=(None,) * 11 + (pool_sh,))
         return self._fused_cache[key]
 
+    def _spec_fn(self, num_steps: int, temperature: float, top_k: int,
+                 top_p: float, eos_id: Optional[int]):
+        """Speculative-decode executable (ISSUE 9): the fused decode
+        loop with prompt-lookup drafting and the 1+draft_len verify
+        forward (paged.fused_spec_decode_loop). draft_len/min_ngram
+        are static from the config block (one executable family per
+        setting)."""
+        sp = self._config.speculative
+        key = ("spec", num_steps, sp.draft_len, sp.min_ngram,
+               temperature, top_k, top_p, eos_id)
+        if key not in self._fused_cache:
+            tp = self._v1.topology.model_parallel_size
+            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            self._fused_cache[key] = jax.jit(
+                functools.partial(
+                    fused_spec_decode_loop, self.model,
+                    num_steps=num_steps, draft_len=sp.draft_len,
+                    min_ngram=sp.min_ngram, eos_id=eos_id,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    use_kernel=(tp <= 1)),
+                donate_argnums=(1,),
+                out_shardings=(None,) * 9 + (pool_sh,))
+        return self._fused_cache[key]
+
+    def _spec_serve_fn(self, num_steps: int, temperature: float,
+                       top_k: int, top_p: float,
+                       eos_id: Optional[int]):
+        """Ring-mode speculative executable: in-graph admission +
+        per-row device output ring + prompt-lookup verify
+        (paged.fused_spec_serve_loop)."""
+        sp = self._config.speculative
+        key = ("spec_serve", num_steps, sp.draft_len, sp.min_ngram,
+               temperature, top_k, top_p, eos_id)
+        if key not in self._fused_cache:
+            tp = self._v1.topology.model_parallel_size
+            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            self._fused_cache[key] = jax.jit(
+                functools.partial(
+                    fused_spec_serve_loop, self.model,
+                    num_steps=num_steps, draft_len=sp.draft_len,
+                    min_ngram=sp.min_ngram, eos_id=eos_id,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    use_kernel=(tp <= 1)),
+                donate_argnums=(1,),
+                out_shardings=(None,) * 14 + (pool_sh,))
+        return self._fused_cache[key]
+
+    def _history_rows(self, uids: list[int], bb: int) -> np.ndarray:
+        """Right-aligned recent-token history rows [bb, history_window]
+        for the prompt-lookup drafter, -1-filled (pad rows all -1) —
+        the committed history INCLUDING the pending token, so drafts
+        continue from the next dispatch input. Prefix-cache-shared
+        prompt blocks are in ``seq.tokens`` like any other committed
+        token, so a cache-hit admission seeds the same window a cold
+        one would."""
+        hw = int(self._config.speculative.history_window)
+        hist = np.full((bb, hw), -1, np.int32)
+        for i, u in enumerate(uids):
+            hist[i] = self.state_manager.history_tail(u, hw)
+        return hist
+
+    def _spec_operands(self, uids: list[int], k: int,
+                       budgets: dict[int, int], seed: int):
+        """:meth:`_fused_operands` plus the drafter's history window.
+        The reserve horizon grows to ``k * (1 + draft_len)``: a
+        K-step speculative dispatch may commit that many tokens per
+        row (still budget-capped; in-graph drafts are clamped to
+        ``remaining - 1`` so KV writes never pass the reserved
+        blocks)."""
+        el = int(self._config.speculative.draft_len)
+        wide = {u: min(int(budgets[u]), k * (1 + el)) for u in uids}
+        for u in uids:
+            # _fused_operands reserves min(k, budget); top up to the
+            # speculative horizon first (idempotent delta)
+            self.state_manager.reserve(u, max(wide[u], 1))
+        ops = self._fused_operands(uids, k, budgets, seed)
+        hist = jnp.asarray(self._history_rows(uids, int(ops[0].shape[0])))
+        return ops + (hist,)
+
     def _fused_operands(self, uids: list[int], k: int,
                         budgets: dict[int, int], seed: int):
         """Host-side build of one fused dispatch's operands. Every uid
@@ -645,32 +775,53 @@ class InferenceEngineV2:
         b = {u: int(budgets[u]) if budgets is not None else k
              for u in uids}
         st = self.serving_stats
+        spec = self._config.speculative.enabled
         tel = _telemetry()
         t0 = time.perf_counter() if tel is not None else 0.0
         with (tel.span("v2/fused_dispatch",
                        dispatch_id=st["fused_dispatches"] + 1,
                        rows=len(uids), k=k)
               if tel is not None else _NULLCM):
-            ops = self._fused_operands(uids, k, b, seed)
-            fn = self._fused_fn(k, temperature, top_k, top_p, eos)
+            if spec:
+                sp = self._config.speculative
+                ops = self._spec_operands(uids, k, b, seed)
+                fn = self._spec_fn(k, temperature, top_k, top_p, eos)
+                fn_key = ("spec", k, sp.draft_len, sp.min_ngram,
+                          temperature, top_k, top_p, eos)
+            else:
+                ops = self._fused_operands(uids, k, b, seed)
+                fn = self._fused_fn(k, temperature, top_k, top_p, eos)
+                fn_key = (k, temperature, top_k, top_p, eos)
             if tel is not None:
                 self._device_truth_observe(tel, "v2/fused_dispatch",
                                            fn, ops)
             st["host_dispatches"] += 1
             st["fused_dispatches"] += 1
-            with self._fused_dispatch_scope(
-                    (k, temperature, top_k, top_p, eos), ops):
-                out, steps, _, _, _, _, self.pools = fn(
-                    self.params, self.pools, *ops)
+            with self._fused_dispatch_scope(fn_key, ops):
+                if spec:
+                    (out, out_ptr, steps, _, _, _, _, _, spec_stats,
+                     self.pools) = fn(self.params, self.pools, *ops)
+                else:
+                    out, steps, _, _, _, _, self.pools = fn(
+                        self.params, self.pools, *ops)
             toks = np.asarray(out)[:len(uids)]
+            if spec:
+                ptrs = np.asarray(out_ptr)[:len(uids)]
+                self._absorb_spec_stats(np.asarray(spec_stats))
             mgr = self.state_manager
             res: dict[int, list[int]] = {}
             for i, u in enumerate(uids):
-                row = [int(t) for t in toks[i] if t >= 0]
+                row = [int(t) for t in
+                       (toks[i, :ptrs[i]] if spec else toks[i])
+                       if t >= 0]
                 mgr.commit_device_tokens(u, row)
                 res[u] = row
                 st["decoded_tokens"] += len(row)
                 st["fused_slot_tokens"] += len(row)
+                if not spec:
+                    # one token per live slot; the spec path's live-slot
+                    # count arrives in the device stats instead
+                    st["fused_live_slots"] += len(row)
             n_exec = int(steps)
             st["fused_steps"] += n_exec
             st["fused_slots"] += n_exec * len(uids)
@@ -678,6 +829,15 @@ class InferenceEngineV2:
             self._record_dispatch_telemetry(
                 tel, time.perf_counter() - t0)
         return res
+
+    def _absorb_spec_stats(self, stats) -> None:
+        """Fold one dispatch's (or chain's) device spec counters —
+        [proposed, accepted, hit_slots, live_slots] int32 — into
+        serving_stats."""
+        self.serving_stats["spec_proposed_tokens"] += int(stats[0])
+        self.serving_stats["spec_accepted_tokens"] += int(stats[1])
+        self.serving_stats["spec_hit_slots"] += int(stats[2])
+        self.serving_stats["fused_live_slots"] += int(stats[3])
 
     def _device_truth_observe(self, tel, name: str, fn,
                               dev_ops: tuple) -> None:
@@ -722,12 +882,15 @@ class InferenceEngineV2:
         """Decode-loop efficiency counters (monitor/bench surface):
         ``dispatches_per_token`` — host dispatches per decoded token
         (1.0 = per-tick; ~1/K with the fused loop) and
-        ``fused_occupancy`` — fraction of LIVE (row, step) slots in
-        fused dispatches that produced a token (1.0 = every scheduled
-        row decoded every step; rows going EOS/budget-inactive mid-loop
-        lower it). Pad rows added by the batch bucketing are not
-        counted — this measures scheduling efficiency over real
-        sequences, not device utilization of the padded bucket.
+        ``fused_occupancy`` — fraction of scheduled (row, step) slots
+        whose row was still LIVE (1.0 = every scheduled row decoded
+        every step; rows going EOS/budget-inactive mid-loop lower it).
+        Pad rows added by the batch bucketing are not counted — this
+        measures scheduling efficiency over real sequences, not device
+        utilization of the padded bucket. Spec-off the numerator equals
+        the committed-token count; spec-on it comes from the device
+        loops' live-slot counter, so occupancy stays a <= 1.0 fraction
+        while ``tokens_per_dispatch`` carries the multiplier.
 
         With prefix caching the dict additionally carries the cache
         counters (``prefix_hits``/``prefix_misses`` at full-block
@@ -741,7 +904,17 @@ class InferenceEngineV2:
         st["dispatches_per_token"] = (
             st["host_dispatches"] / max(st["decoded_tokens"], 1))
         st["fused_occupancy"] = (
+            st["fused_live_slots"] / max(st["fused_slots"], 1))
+        # speculative decoding (ISSUE 9): tokens_per_dispatch is the
+        # mean tokens COMMITTED per scheduled (row, tick) slot in the
+        # fused loops — <= 1.0 spec-off (then it equals
+        # fused_occupancy), > 1.0 when verified drafts multiply each
+        # forward. spec_acceptance_rate = accepted / proposed drafts.
+        st["tokens_per_dispatch"] = (
             st["fused_slot_tokens"] / max(st["fused_slots"], 1))
+        st["spec_acceptance_rate"] = (
+            st["spec_accepted_tokens"]
+            / max(st["spec_proposed_tokens"], 1))
         # active dispatch-chain depth (ISSUE 6 knob) rides along so
         # consumers can correlate dispatch ratios with the configured
         # chain depth

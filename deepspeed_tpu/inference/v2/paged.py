@@ -258,7 +258,8 @@ def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
                   pos0: jax.Array, block_tables: jax.Array,
-                  true_len: jax.Array, use_kernel: bool = True):
+                  true_len: jax.Array, use_kernel: bool = True,
+                  all_logits: bool = False):
     """Full model pass over a (padded) chunk of new tokens with paged KV.
 
     tokens [B, S]; pos0 [B]; block_tables [B, max_blocks]; true_len [B]
@@ -267,6 +268,13 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     runs only on each sequence's last pending token (the reference's
     logits_gather kernel, fused into the step so continuous-batching
     decode is one dispatch).
+
+    ``all_logits=True`` projects EVERY chunk position instead
+    (returns [B, S, V]) — the speculative verify step needs the
+    next-token distribution after each draft slot, not just the last
+    one. Attention math is unchanged; rows at slots >= ``true_len``
+    carry garbage logits the caller must mask (the accept/reject logic
+    only ever reads slots < true_len).
     """
     b, s = tokens.shape
     positions = pos0[:, None] + jnp.arange(s)[None, :]
@@ -327,6 +335,9 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         "v": pools["v"].at[:, blk, off].set(
             new_v.astype(pools["v"].dtype), mode="drop"),
     }
+    if all_logits:
+        # speculative verify: every slot's next-token distribution
+        return model.unembed(params, x), new_pools
     # logits_gather: project only each row's last valid position
     idx = jnp.clip(true_len - 1, 0, s - 1)
     x_last = x[jnp.arange(b), idx]                      # [B, D]
@@ -417,6 +428,292 @@ def fused_decode_loop(model, params: PyTree, pools: PyTree,
         cond, body, (jnp.asarray(0, jnp.int32), tokens, pos, active,
                      remaining, pools, out0))
     return out, step, tokens, pos, active, remaining, pools
+
+
+def draft_prompt_lookup(hist: jax.Array, *, min_ngram: int,
+                        draft_len: int):
+    """Prompt-lookup (self-speculative n-gram) drafter, fully on device.
+
+    ``hist`` [B, H] int32 is each row's recent committed token history
+    — RIGHT-aligned (newest token, the pending decode input, at column
+    H-1) with ``-1`` filling unused columns on the left. The drafter
+    takes the trailing ``min_ngram`` tokens, finds the MOST RECENT
+    earlier occurrence of that n-gram in the window, and proposes the
+    up-to-``draft_len`` tokens that followed it (PLD / "assisted
+    decoding without a draft model"; the history is seeded host-side
+    from the sequence's full token record — prefix-cache-shared prompt
+    blocks included — and maintained in-graph by the spec loops).
+
+    Returns ``(draft [B, draft_len] int32, eff [B] int32)`` — ``eff``
+    is how many proposed tokens are real; 0 when no n-gram fires (the
+    depth-0 fallback: the verify step then degenerates to plain
+    single-token decode). Real tokens are >= 0, so the ``-1`` fill can
+    never match a genuine n-gram.
+    """
+    b, h = hist.shape
+    n, el = int(min_ngram), int(draft_len)
+    s = h - n                               # candidate window starts
+    tail = hist[:, h - n:]                                   # [B, n]
+    widx = jnp.arange(s)[:, None] + jnp.arange(n)[None, :]   # [S, n]
+    win = hist[:, widx]                                      # [B, S, n]
+    match = jnp.all(win == tail[:, None, :], axis=-1) \
+        & jnp.all(win >= 0, axis=-1)                         # [B, S]
+    # latest match wins (recency bias, the standard PLD heuristic) —
+    # but a match so close to the window edge that fewer than
+    # ``draft_len`` tokens follow it is outranked by the latest match
+    # with a FULL continuation (a period-1 repetition would otherwise
+    # always pick the adjacent match and draft a single token). Start
+    # s == h-n (the tail itself) is excluded by construction.
+    starts = jnp.arange(s)[None, :]
+    best_full = jnp.max(
+        jnp.where(match & (starts <= s - 1 - (el - 1)), starts, -1),
+        axis=-1)
+    best_any = jnp.max(jnp.where(match, starts, -1), axis=-1)
+    best = jnp.where(best_full >= 0, best_full, best_any)    # [B]
+    hit = (best >= 0) & jnp.all(tail >= 0, axis=-1)
+    cont = jnp.maximum(best, 0) + n          # first continuation column
+    avail = jnp.minimum(el, h - cont)        # tokens following the match
+    didx = jnp.clip(cont[:, None] + jnp.arange(el)[None, :], 0, h - 1)
+    draft = jnp.take_along_axis(hist, didx, axis=1)          # [B, el]
+    eff = jnp.where(hit, avail, 0).astype(jnp.int32)
+    return draft.astype(jnp.int32), eff
+
+
+def append_history(hist: jax.Array, emitted: jax.Array,
+                   m: jax.Array) -> jax.Array:
+    """Shift each row of the right-aligned history window left by
+    ``m[b]`` and append the first ``m[b]`` columns of ``emitted``
+    [B, E] at the right edge — a gather over the concatenation, so the
+    traced per-row advance needs no scatter. Rows with ``m == 0`` come
+    back unchanged."""
+    b, h = hist.shape
+    comb = jnp.concatenate([hist, emitted.astype(hist.dtype)], axis=1)
+    gidx = jnp.arange(h)[None, :] + m[:, None]               # [B, H]
+    return jnp.take_along_axis(comb, gidx, axis=1)
+
+
+def _spec_tick(model, params, pools, tokens, pos, tables, active,
+               remaining, row_keys, *, draft_len, min_ngram, eos,
+               temperature, top_k, top_p, use_kernel, hist):
+    """One speculative verify tick shared by the spec decode/serve
+    loops: draft -> one [B, 1+draft_len] forward -> position-keyed
+    sample at every slot -> leading exact-match accept -> commit
+    1..1+draft_len tokens per row.
+
+    The sampled targets are the SAME tokens a plain per-position decode
+    would produce (greedy: argmax; stochastic: the position-keyed
+    categorical draw), so acceptance only decides how many land per
+    forward — the emitted chain is bit-identical to spec-off in both
+    regimes, and invariant to how ticks group into dispatches.
+
+    Returns ``(target [B, 1+L], m [B] emitted counts, tokens', pos',
+    alive, remaining', hist', stats [3] = (proposed, accepted,
+    hit_slots), pools')``. KV for draft slots is written through the
+    block table like any prefill chunk; slots past the accepted run
+    hold stale values that the next tick's fresh chunk overwrites
+    before any query can attend to them (queries never look past their
+    own position), and the block budget already covers them because
+    drafts are clamped to ``remaining - 1``.
+    """
+    from ...ops import sampling
+
+    el = int(draft_len)
+    slots = jnp.arange(1 + el)
+    draft, eff = draft_prompt_lookup(hist, min_ngram=min_ngram,
+                                     draft_len=el)
+    # drafting past the budget is pure waste (acceptance commits at
+    # most `remaining` tokens) AND would write KV beyond the reserved
+    # block horizon — clamp to remaining-1
+    eff = jnp.minimum(eff, jnp.maximum(remaining - 1, 0))
+    eff = jnp.where(active, eff, 0)
+    inputs = jnp.concatenate([tokens[:, None], draft], axis=1)
+    tl = jnp.where(active, 1 + eff, 0)
+    logits, pools = paged_forward(model, params, pools, inputs, pos,
+                                  tables, tl, use_kernel=use_kernel,
+                                  all_logits=True)     # [B, 1+L, V]
+    # slot j samples the token at absolute index pos+1+j — the same
+    # key the non-spec loop folds for that position, so accept/reject
+    # is schedule-invariant and greedy verify is exact-match
+    positions = pos[:, None] + 1 + slots[None, :]
+    keys = jax.vmap(sampling.position_keys)(row_keys, positions)
+    target = sampling.sample_token_grid(
+        logits, keys, temperature=temperature, top_k=top_k, top_p=top_p)
+    ok = (draft == target[:, :el]) & (slots[None, :el] < eff[:, None])
+    acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    m = jnp.minimum(acc + 1, remaining)      # accepted run + correction
+    # EOS truncation: emit up to and including the first eos
+    is_eos = (target == eos) & (slots[None, :] < m[:, None])
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1)
+    m = jnp.where(any_eos, first_eos + 1, m)
+    m = jnp.where(active, m, 0)
+    last = jnp.take_along_axis(target, jnp.maximum(m - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    tokens = jnp.where(active, last, tokens)
+    pos = pos + m
+    remaining = remaining - m
+    alive = active & (remaining > 0) & ~any_eos
+    hist = append_history(hist, target, m)
+    # drafts actually committed: the leading `acc` matches, except that
+    # an EOS-truncated emission may end ON an accepted draft (the
+    # drafted eos matched) — then every committed token was a draft and
+    # `m - 1` would undercount by one
+    used = jnp.minimum(acc, m)
+    stats = jnp.stack([jnp.sum(eff), jnp.sum(used),
+                       jnp.sum((eff > 0).astype(jnp.int32)),
+                       jnp.sum(active.astype(jnp.int32))])
+    return target, m, tokens, pos, alive, remaining, hist, stats, pools
+
+
+def fused_spec_decode_loop(model, params: PyTree, pools: PyTree,
+                           tokens: jax.Array, pos: jax.Array,
+                           block_tables: jax.Array, active: jax.Array,
+                           remaining: jax.Array, row_keys: jax.Array,
+                           hist: jax.Array, *, num_steps: int,
+                           draft_len: int, min_ngram: int,
+                           eos_id: int | None, temperature: float,
+                           top_k: int, top_p: float,
+                           use_kernel: bool = True):
+    """:func:`fused_decode_loop` with speculative decoding (ISSUE 9):
+    each tick drafts up to ``draft_len`` tokens by prompt lookup over
+    the row's device-side history window, verifies them in ONE forward
+    over ``[B, 1 + draft_len]`` positions, and commits
+    ``1..1+draft_len`` tokens — so a K-step dispatch can emit up to
+    ``K * (1 + draft_len)`` tokens per row while paying K forwards.
+
+    Extra carry vs the plain loop: ``hist`` [B, H] (right-aligned
+    recent-token window, maintained in-graph; see
+    :func:`draft_prompt_lookup`) and the per-row output write pointer
+    — rows advance VARIABLE amounts per tick, so the output buffer
+    ``out`` [B, num_steps * (1 + draft_len)] is scattered through
+    per-row pointers instead of a shared step column.
+
+    Returns ``(out, out_ptr [B], steps_run, tokens, pos, active,
+    remaining, hist, spec_stats [4] = (proposed, accepted, hit_slots,
+    live_slots), pools)``. Greedy output is bit-identical to the non-spec loop
+    (targets ARE the argmax chain; drafts only batch them), stochastic
+    output is bit-identical for the same base keys (position-keyed
+    draws)."""
+    b = tokens.shape[0]
+    el = int(draft_len)
+    width = num_steps * (1 + el)
+    out0 = jnp.full((b, width), -1, jnp.int32)
+    eos = -1 if eos_id is None else int(eos_id)
+    slots = jnp.arange(1 + el)
+
+    def cond(st):
+        step, active = st[0], st[3]
+        return (step < num_steps) & jnp.any(active)
+
+    def body(st):
+        (step, tokens, pos, active, remaining, hist, out, out_ptr,
+         stats, pools) = st
+        (target, m, tokens, pos, alive, remaining, hist, tick_stats,
+         pools) = _spec_tick(
+            model, params, pools, tokens, pos, block_tables, active,
+            remaining, row_keys, draft_len=el, min_ngram=min_ngram,
+            eos=eos, temperature=temperature, top_k=top_k, top_p=top_p,
+            use_kernel=use_kernel, hist=hist)
+        cols = jnp.where(slots[None, :] < m[:, None],
+                         out_ptr[:, None] + slots[None, :], width)
+        out = out.at[jnp.arange(b)[:, None], cols].set(
+            target, mode="drop")
+        return (step + 1, tokens, pos, alive, remaining, hist, out,
+                out_ptr + m, stats + tick_stats, pools)
+
+    (step, tokens, pos, active, remaining, hist, out, out_ptr, stats,
+     pools) = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(0, jnp.int32), tokens, pos, active, remaining,
+         hist, out0, jnp.zeros((b,), jnp.int32),
+         jnp.zeros((4,), jnp.int32), pools))
+    return (out, out_ptr, step, tokens, pos, active, remaining, hist,
+            stats, pools)
+
+
+def fused_spec_serve_loop(model, params: PyTree, pools: PyTree,
+                          tokens: jax.Array, pos: jax.Array,
+                          block_tables: jax.Array, active: jax.Array,
+                          remaining: jax.Array, row_keys: jax.Array,
+                          hist: jax.Array, epoch: jax.Array,
+                          stage_tokens: jax.Array, stage_pos: jax.Array,
+                          stage_rem: jax.Array, stage_keys: jax.Array,
+                          stage_tables: jax.Array,
+                          stage_hist: jax.Array, stage_valid: jax.Array,
+                          ring: jax.Array, ring_epochs: jax.Array,
+                          ring_ptr: jax.Array, spec_stats: jax.Array, *,
+                          num_steps: int, draft_len: int, min_ngram: int,
+                          eos_id: int | None, temperature: float,
+                          top_k: int, top_p: float,
+                          use_kernel: bool = True):
+    """:func:`fused_serve_loop` (ring mode, in-graph admission) with
+    speculative decoding. Differences from the non-spec ring loop:
+
+    - ``ring_ptr`` is PER-ROW [B] — rows commit 1..1+draft_len tokens
+      per tick, so each row owns its own ring watermark; the host
+      drains ``ring[b, :ring_ptr[b]]`` once per chain.
+    - ``hist`` [B, H] rides the carry and is REPLACED by
+      ``stage_hist`` on an in-graph slot swap (the staged request's
+      own token history, built host-side at staging).
+    - ``spec_stats`` [4] (proposed, accepted, hit_slots, live_slots)
+      accumulates
+      across the whole chain and is read once at the drain.
+
+    Returns ``(ring, ring_epochs, ring_ptr [B], steps_run, tokens,
+    pos, active, remaining, row_keys, block_tables, hist, epoch,
+    stage_valid, spec_stats, pools)``."""
+    b = tokens.shape[0]
+    el = int(draft_len)
+    eos = -1 if eos_id is None else int(eos_id)
+    slots = jnp.arange(1 + el)
+    cap = ring.shape[1]
+
+    def cond(st):
+        step, active = st[0], st[3]
+        return (step < num_steps) & jnp.any(active)
+
+    def body(st):
+        (step, tokens, pos, active, remaining, row_keys, tables, hist,
+         epoch, s_valid, ring, ring_ep, ring_ptr, stats, pools) = st
+        (target, m, tokens, pos, alive, remaining, hist, tick_stats,
+         pools) = _spec_tick(
+            model, params, pools, tokens, pos, tables, active,
+            remaining, row_keys, draft_len=el, min_ngram=min_ngram,
+            eos=eos, temperature=temperature, top_k=top_k, top_p=top_p,
+            use_kernel=use_kernel, hist=hist)
+        cols = jnp.where(slots[None, :] < m[:, None],
+                         ring_ptr[:, None] + slots[None, :], cap)
+        rows = jnp.arange(b)[:, None]
+        ring = ring.at[rows, cols].set(target, mode="drop")
+        ring_ep = ring_ep.at[rows, cols].set(
+            jnp.broadcast_to(epoch[:, None], (b, 1 + el)), mode="drop")
+        ring_ptr = ring_ptr + m
+        # in-graph admission: a row whose occupant just terminated and
+        # that carries a staged request swaps it in for the NEXT tick
+        swap = active & ~alive & s_valid
+        tokens = jnp.where(swap, stage_tokens, tokens)
+        pos = jnp.where(swap, stage_pos, pos)
+        remaining = jnp.where(swap, stage_rem, remaining)
+        row_keys = jnp.where(swap[:, None], stage_keys, row_keys)
+        tables = jnp.where(swap[:, None], stage_tables, tables)
+        hist = jnp.where(swap[:, None], stage_hist, hist)
+        epoch = epoch + swap.astype(jnp.int32)
+        alive = alive | swap
+        s_valid = s_valid & ~swap
+        return (step + 1, tokens, pos, alive, remaining, row_keys,
+                tables, hist, epoch, s_valid, ring, ring_ep, ring_ptr,
+                stats + tick_stats, pools)
+
+    (step, tokens, pos, active, remaining, row_keys, tables, hist,
+     epoch, stage_valid, ring, ring_epochs, ring_ptr, spec_stats,
+     pools) = jax.lax.while_loop(
+        cond, body,
+        (jnp.asarray(0, jnp.int32), tokens, pos, active, remaining,
+         row_keys, block_tables, hist, epoch, stage_valid, ring,
+         ring_epochs, ring_ptr, spec_stats, pools))
+    return (ring, ring_epochs, ring_ptr, step, tokens, pos, active,
+            remaining, row_keys, tables, hist, epoch, stage_valid,
+            spec_stats, pools)
 
 
 def fused_serve_loop(model, params: PyTree, pools: PyTree,

@@ -428,13 +428,32 @@ class DSStateManager:
         seq.blocks.extend(self.allocator.allocate(need))
         return need
 
+    def history_tail(self, uid: int, window: int) -> np.ndarray:
+        """The last ``window`` committed tokens (pending token
+        included), RIGHT-aligned in a [window] int32 row with -1
+        filling unused leading columns — the prompt-lookup drafter's
+        seed (ISSUE 9). Prefix-cache-matched prompt blocks are part of
+        ``seq.tokens`` like any other committed token, so a cache-hit
+        admission seeds the same drafting window a cold one would."""
+        row = np.full((window,), -1, np.int32)
+        toks = self.seqs[uid].tokens[-window:]
+        if toks:
+            row[window - len(toks):] = toks
+        return row
+
     def commit_device_tokens(self, uid: int, tokens: list[int]) -> None:
         """Append tokens a fused dispatch generated ON DEVICE. Their KV
         entries (all but the last token's) were already written in-graph,
         so ``seen`` advances with the history: afterwards exactly the
         last generated token is pending — it is the next dispatch's
         input. Blocks must have been preallocated via :meth:`reserve`
-        (the device wrote through them)."""
+        (the device wrote through them).
+
+        The commit length is VARIABLE (ISSUE 9): a speculative dispatch
+        lands 1..1+draft_len tokens per row per tick, so callers pass
+        whatever the device's per-row write pointer says — the only
+        invariants are the single pending input before the call and
+        the reserved block horizon covering the advance."""
         if not tokens:
             return
         seq = self.seqs[uid]

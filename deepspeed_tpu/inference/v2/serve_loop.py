@@ -118,12 +118,33 @@ class FusedServeLoop:
         self.preemption = bool(preemption)
         self.depth = max(1, int(cfg.max_inflight_dispatches))
         self.ring_mode = bool(cfg.fused_admission)
+        # speculative decoding (ISSUE 9): swap in the spec executables;
+        # every scheduling decision below sizes advances by
+        # k * (1 + draft_len) instead of k
+        self.spec = bool(cfg.speculative.enabled)
+        self.draft_len = int(cfg.speculative.draft_len) if self.spec \
+            else 0
+        sp_key = (cfg.speculative.draft_len, cfg.speculative.min_ngram)
         if self.ring_mode:
-            self.fn = engine._serve_fn(self.k, self.temperature,
-                                       self.top_k, self.top_p, self.eos)
-            self._fn_key = ("serve", self.k, self.temperature, self.top_k,
-                            self.top_p, self.eos)
-            self.ring_cap = self.k * self.depth
+            if self.spec:
+                self.fn = engine._spec_serve_fn(
+                    self.k, self.temperature, self.top_k, self.top_p,
+                    self.eos)
+                self._fn_key = ("spec_serve", self.k, *sp_key,
+                                self.temperature, self.top_k,
+                                self.top_p, self.eos)
+            else:
+                self.fn = engine._serve_fn(self.k, self.temperature,
+                                           self.top_k, self.top_p,
+                                           self.eos)
+                self._fn_key = ("serve", self.k, self.temperature,
+                                self.top_k, self.top_p, self.eos)
+            self.ring_cap = self.k * self.depth * (1 + self.draft_len)
+        elif self.spec:
+            self.fn = engine._spec_fn(self.k, self.temperature,
+                                      self.top_k, self.top_p, self.eos)
+            self._fn_key = ("spec", self.k, *sp_key, self.temperature,
+                            self.top_k, self.top_p, self.eos)
         else:
             self.fn = engine._fused_fn(self.k, self.temperature,
                                        self.top_k, self.top_p, self.eos)
@@ -478,21 +499,33 @@ class FusedServeLoop:
                 self._rowset = sorted(self.live)
                 self._budgets = {u: self.live[u].budget
                                  for u in self._rowset}
-                (tok_a, pos_a, self._tables, act_a, rem_a,
-                 self._row_keys) = e._fused_operands(
-                     self._rowset, self.k, self._budgets, self.seed)
+                if self.spec:
+                    (tok_a, pos_a, self._tables, act_a, rem_a,
+                     self._row_keys, hist_a) = e._spec_operands(
+                         self._rowset, self.k, self._budgets, self.seed)
+                else:
+                    (tok_a, pos_a, self._tables, act_a, rem_a,
+                     self._row_keys) = e._fused_operands(
+                         self._rowset, self.k, self._budgets, self.seed)
+                    hist_a = None
                 self._n_enq = 0
+            elif self.spec:
+                tok_a, pos_a, act_a, rem_a, hist_a = self._carry
             else:
                 tok_a, pos_a, act_a, rem_a = self._carry
             # the first dispatch after a rebuild always goes; a chained
             # one only when no admission is waiting and some row's
-            # budget can outlast the chain
+            # budget can outlast the chain (a spec dispatch can advance
+            # up to k*(1+draft_len) tokens per row)
+            adv = self.k * (1 + self.draft_len)
             if self._n_enq > 0 and (self.waiting
                                     or max(self._budgets.values())
-                                    <= self.k * self._n_enq):
+                                    <= adv * self._n_enq):
                 break
             ops = (tok_a, pos_a, self._tables, act_a, rem_a,
                    self._row_keys)
+            if self.spec:
+                ops = ops + (hist_a,)
             if tel is not None:
                 e._device_truth_observe(tel, "v2/fused_dispatch",
                                         self.fn, ops)
@@ -503,16 +536,23 @@ class FusedServeLoop:
                 with e._fused_dispatch_scope(
                         self._fn_key, ops,
                         variant="carry" if self._n_enq > 0 else "host"):
-                    out, steps, t2, p2, a2, r2, e.pools = self.fn(
-                        e.params, e.pools, *ops)
-            self._carry = (t2, p2, a2, r2)
+                    if self.spec:
+                        (out, optr, steps, t2, p2, a2, r2, h2, sstat,
+                         e.pools) = self.fn(e.params, e.pools, *ops)
+                        self._carry = (t2, p2, a2, r2, h2)
+                    else:
+                        out, steps, t2, p2, a2, r2, e.pools = self.fn(
+                            e.params, e.pools, *ops)
+                        optr = sstat = None
+                        self._carry = (t2, p2, a2, r2)
             self._n_enq += 1
             if not self.infl:
                 # chain start: clock drain intervals from here, so the
                 # first sample measures the chain, not the admission/
                 # prefill (or open-loop idle) time that preceded it
                 self._last_drain_t = time.perf_counter()
-            self.infl.append((list(self._rowset), out, steps))
+            self.infl.append((list(self._rowset), out, optr, steps,
+                              sstat))
             stats["host_dispatches"] += 1
             stats["fused_dispatches"] += 1
 
@@ -521,7 +561,7 @@ class FusedServeLoop:
             return
         # drain the OLDEST dispatch's ring buffer (device may still be
         # running a newer chained one — that's the overlap)
-        rows, out, steps = self.infl.popleft()
+        rows, out, optr, steps, sstat = self.infl.popleft()
         t_drain = time.perf_counter() if tel is not None else 0.0
         with (tel.span("v2/fused_drain", rows=len(rows))
               if tel is not None else _NULLCM):
@@ -531,6 +571,9 @@ class FusedServeLoop:
                   else _NULLCM):
                 toks = np.asarray(out)
                 n_exec = int(steps)
+                ptrs = np.asarray(optr) if optr is not None else None
+                if sstat is not None:
+                    e._absorb_spec_stats(np.asarray(sstat))
         stats["fused_steps"] += n_exec
         stats["fused_slots"] += n_exec * len(rows)
         now = time.perf_counter()
@@ -542,13 +585,19 @@ class FusedServeLoop:
             req = self.live.get(u)
             if req is None:       # finished in an earlier dispatch
                 continue
-            row = [int(t) for t in toks[i] if t >= 0]
+            row = [int(t) for t in
+                   (toks[i, :ptrs[i]] if ptrs is not None else toks[i])
+                   if t >= 0]
             if not row:
                 continue
             mgr.commit_device_tokens(u, row)
             req.generated.extend(row)
             stats["decoded_tokens"] += len(row)
             stats["fused_slot_tokens"] += len(row)
+            if ptrs is None:
+                # one token per live slot; the spec path's live-slot
+                # count arrived in the absorbed device stats
+                stats["fused_live_slots"] += len(row)
             if self._lat is not None:
                 self._lat.tokens(u, len(row))
             if u not in self._cancelled:
@@ -617,7 +666,7 @@ class FusedServeLoop:
         ops = self._serve_operands(rowset, budgets, stage_map)
         (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
          s_tok, s_pos, s_rem, s_keys, s_tab, s_valid,
-         ring, ring_ep, ring_ptr) = ops
+         ring, ring_ep, ring_ptr) = ops[:16]
         # chain length from the max remaining budget (staged occupant
         # included). With eos_id set, rows may terminate early and the
         # tail dispatches of a chain become device no-ops (the
@@ -630,49 +679,93 @@ class FusedServeLoop:
                   + (self.staged[stage_map[i]].budget
                      if i in stage_map else 0)
                   for i in range(len(rowset)))
-        chain_len = max(1, min(self.depth, -(-eff // self.k)))
+        adv = self.k * (1 + self.draft_len)
+        chain_len = max(1, min(self.depth, -(-eff // adv)))
         if self.waiting:
             # un-staged prompts are waiting for a host-side admission:
             # keep the chain short so they are not starved
             chain_len = 1
-        carry = (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
-                 s_valid)
         # chain start: clock the drain interval from the first enqueue
         # (admission/prefill/idle time must not pollute tick stats)
         self._last_drain_t = time.perf_counter()
+        if self.spec:
+            hist_a, s_hist, sstat = ops[16:]
+            carry = (tok_a, pos_a, tables, act_a, rem_a, row_keys,
+                     hist_a, epoch, s_valid, ring, ring_ep, ring_ptr,
+                     sstat)
+            step_handles = []
+            for j in range(chain_len):
+                (tok_a, pos_a, tables, act_a, rem_a, row_keys, hist_a,
+                 epoch, s_valid, ring, ring_ep, ring_ptr,
+                 sstat) = carry
+                dis_ops = (tok_a, pos_a, tables, act_a, rem_a,
+                           row_keys, hist_a, epoch, s_tok, s_pos,
+                           s_rem, s_keys, s_tab, s_hist, s_valid,
+                           ring, ring_ep, ring_ptr, sstat)
+                (ring, ring_ep, ring_ptr, steps, t2, p2, a2, r2, k2,
+                 tb2, h2, ep2, sv2, sstat,
+                 e.pools) = self._enqueue_chained(j, dis_ops, rowset,
+                                                  tel)
+                carry = (t2, p2, tb2, a2, r2, k2, h2, ep2, sv2, ring,
+                         ring_ep, ring_ptr, sstat)
+                step_handles.append(steps)
+            self._drain_ring(ev, rowset, stage_map, ring, ring_ep,
+                             ring_ptr, carry[7], step_handles, sstat)
+            return
+        carry = (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
+                 s_valid)
         for j in range(chain_len):
             (tok_a, pos_a, tables, act_a, rem_a, row_keys, epoch,
              s_valid) = carry
             dis_ops = (tok_a, pos_a, tables, act_a, rem_a, row_keys,
                        epoch, s_tok, s_pos, s_rem, s_keys, s_tab,
                        s_valid, ring, ring_ep, ring_ptr)
-            if tel is not None:
-                e._device_truth_observe(tel, "v2/fused_dispatch",
-                                        self.fn, dis_ops)
-            with (tel.span("v2/fused_enqueue",
-                           dispatch_id=stats["fused_dispatches"] + 1,
-                           rows=len(rowset), k=self.k)
-                  if tel is not None else _NULLCM):
-                with e._fused_dispatch_scope(
-                        self._fn_key, dis_ops,
-                        variant="carry" if j > 0 else "host"):
-                    (ring, ring_ep, ring_ptr, t2, p2, a2, r2, k2, tb2,
-                     ep2, sv2, e.pools) = self.fn(
-                        e.params, e.pools, *dis_ops)
+            (ring, ring_ep, ring_ptr, t2, p2, a2, r2, k2, tb2, ep2,
+             sv2, e.pools) = self._enqueue_chained(j, dis_ops, rowset,
+                                                   tel)
             carry = (t2, p2, tb2, a2, r2, k2, ep2, sv2)
-            stats["host_dispatches"] += 1
-            stats["fused_dispatches"] += 1
         self._drain_ring(ev, rowset, stage_map, ring, ring_ep, ring_ptr,
                          carry[6])
 
+    def _enqueue_chained(self, j: int, dis_ops: tuple, rowset, tel):
+        """One chained ring-mode enqueue, shared by the spec and
+        non-spec loops so the per-dispatch discipline cannot drift:
+        device-truth observation BEFORE the call (pools are donated),
+        the enqueue span, the recompile-sentinel scope (``host``
+        operands on the chain's first link, device ``carry``
+        afterwards), and the dispatch counters. Returns ``self.fn``'s
+        raw result tuple — arity differs between the executables, so
+        unpacking stays at the call site."""
+        e = self.e
+        stats = e.serving_stats
+        if tel is not None:
+            e._device_truth_observe(tel, "v2/fused_dispatch", self.fn,
+                                    dis_ops)
+        with (tel.span("v2/fused_enqueue",
+                       dispatch_id=stats["fused_dispatches"] + 1,
+                       rows=len(rowset), k=self.k)
+              if tel is not None else _NULLCM):
+            with e._fused_dispatch_scope(
+                    self._fn_key, dis_ops,
+                    variant="carry" if j > 0 else "host"):
+                res = self.fn(e.params, e.pools, *dis_ops)
+        stats["host_dispatches"] += 1
+        stats["fused_dispatches"] += 1
+        return res
+
     def _drain_ring(self, ev, rowset, stage_map, ring, ring_ep,
-                    ring_ptr, epoch_final) -> None:
+                    ring_ptr, epoch_final, step_handles=None,
+                    sstat=None) -> None:
         """ONE host read for the whole chain: ring tokens + epochs +
         final per-row epoch, attributed to each row's occupant
         timeline (epoch 0 = the row's original uid, epoch 1 = its
-        staged request, swapped in in-graph)."""
+        staged request, swapped in in-graph). In spec mode
+        ``ring_ptr`` is per-row [B] (variable advance), the executed
+        tick counts arrive via ``step_handles`` and the chain's device
+        spec counters via ``sstat``."""
         e, mgr, tel = self.e, self.e.state_manager, self._tel
         stats = e.serving_stats
+        spec = step_handles is not None
         t_drain = time.perf_counter() if tel is not None else 0.0
         with (tel.span("v2/fused_drain", rows=len(rowset))
               if tel is not None else _NULLCM):
@@ -681,20 +774,29 @@ class FusedServeLoop:
                 # ONE blocking pull for the whole chain (four separate
                 # np.asarray calls would pay the host<->device RTT
                 # once each — exactly the cost this path removes)
-                toks, eps, n_cols, ep_fin = jax.device_get(
-                    (ring, ring_ep, ring_ptr, epoch_final))
-                n_cols = int(n_cols)
-        stats["fused_steps"] += n_cols
-        stats["fused_slots"] += n_cols * len(rowset)
+                if spec:
+                    toks, eps, ptrs, ep_fin, n_steps, st_arr = \
+                        jax.device_get((ring, ring_ep, ring_ptr,
+                                        epoch_final, step_handles,
+                                        sstat))
+                    e._absorb_spec_stats(st_arr)
+                    n_exec = int(sum(int(s) for s in n_steps))
+                else:
+                    toks, eps, n_cols, ep_fin = jax.device_get(
+                        (ring, ring_ep, ring_ptr, epoch_final))
+                    n_cols = n_exec = int(n_cols)
+        stats["fused_steps"] += n_exec
+        stats["fused_slots"] += n_exec * len(rowset)
         now = time.perf_counter()
-        self.drain_stats.append((now - self._last_drain_t, n_cols))
+        self.drain_stats.append((now - self._last_drain_t, n_exec))
         self._last_drain_t = now
         self.counters["chain_drains"] += 1
         for i, u0 in enumerate(rowset):
             owners = [u0] + ([stage_map[i]] if i in stage_map else [])
+            cols = int(ptrs[i]) if spec else n_cols
             for e_idx, uid in enumerate(owners):
-                seg = [int(t) for t, ep in zip(toks[i, :n_cols],
-                                               eps[i, :n_cols])
+                seg = [int(t) for t, ep in zip(toks[i, :cols],
+                                               eps[i, :cols])
                        if ep == e_idx and t >= 0]
                 staged = e_idx > 0
                 req = (self.staged if staged else self.live).get(uid)
@@ -704,6 +806,10 @@ class FusedServeLoop:
                 req.generated.extend(seg)
                 stats["decoded_tokens"] += len(seg)
                 stats["fused_slot_tokens"] += len(seg)
+                if not spec:
+                    # one token per live slot (spec live-slot counts
+                    # came from the chain's device stats)
+                    stats["fused_live_slots"] += len(seg)
                 if self._lat is not None:
                     self._lat.tokens(uid, len(seg))
                 if uid not in self._cancelled:
@@ -733,8 +839,12 @@ class FusedServeLoop:
         silently clamp in-graph KV writes)."""
         from .engine_v2 import _bucket
         e, mgr, k = self.e, self.e.state_manager, self.k
-        (tok_a, pos_a, tables, act_a, rem_a,
-         row_keys) = e._fused_operands(rowset, k, budgets, self.seed)
+        if self.spec:
+            (tok_a, pos_a, tables, act_a, rem_a, row_keys,
+             hist_a) = e._spec_operands(rowset, k, budgets, self.seed)
+        else:
+            (tok_a, pos_a, tables, act_a, rem_a,
+             row_keys) = e._fused_operands(rowset, k, budgets, self.seed)
         seqs = [mgr.seqs[u] for u in rowset]
         bb = int(tok_a.shape[0])
         epoch = np.zeros((bb,), np.int32)
@@ -777,9 +887,20 @@ class FusedServeLoop:
             np.uint32))
         s_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(s_ids)
         ring = np.full((bb, self.ring_cap), -1, np.int32)
-        return (tok_a, pos_a, tables, act_a, rem_a, row_keys,
+        base = (tok_a, pos_a, tables, act_a, rem_a, row_keys,
                 jnp.asarray(epoch), jnp.asarray(s_tok),
                 jnp.asarray(s_pos), jnp.asarray(s_rem), s_keys,
                 jnp.asarray(s_tab), jnp.asarray(s_valid),
-                jnp.asarray(ring), jnp.asarray(ring),
-                jnp.asarray(0, jnp.int32))
+                jnp.asarray(ring), jnp.asarray(ring))
+        if not self.spec:
+            return base + (jnp.asarray(0, jnp.int32),)
+        # spec extras: per-row ring pointers (variable advance), each
+        # staged request's own drafter history, and the chain's device
+        # spec counters (proposed/accepted/hit) zeroed at chain start
+        hw = int(e._config.speculative.history_window)
+        s_hist = np.full((bb, hw), -1, np.int32)
+        for i, su in stage_map.items():
+            s_hist[i] = mgr.history_tail(su, hw)
+        return base + (jnp.asarray(np.zeros((bb,), np.int32)),
+                       hist_a, jnp.asarray(s_hist),
+                       jnp.asarray(np.zeros((4,), np.int32)))

@@ -129,11 +129,16 @@ class CometMonitor(Monitor):
 # ~1/K with the fused loop, 1.0 per-tick; fused_occupancy = live
 # (row, step) slot fraction inside fused dispatches), the raw counters
 # give the denominators; the prefix_* set (ISSUE 4) charts cache
-# hit rate, prefill tokens saved, and eviction/occupancy pressure
+# hit rate, prefill tokens saved, and eviction/occupancy pressure; the
+# spec_* set (ISSUE 9) charts speculative-decoding acceptance and the
+# tokens-per-verify-slot multiplier
 SERVING_METRIC_KEYS = ("dispatches_per_token", "fused_occupancy",
                        "max_inflight_dispatches",
                        "decoded_tokens", "host_dispatches",
                        "fused_dispatches", "fused_steps",
+                       "tokens_per_dispatch", "spec_acceptance_rate",
+                       "spec_proposed_tokens", "spec_accepted_tokens",
+                       "spec_hit_slots",
                        "prefix_hit_rate", "prefix_hits", "prefix_misses",
                        "prefix_evictions", "prefill_tokens_saved",
                        "prefix_cached_blocks", "prefix_evictable_blocks")

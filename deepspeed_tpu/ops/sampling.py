@@ -98,3 +98,23 @@ def sample_tokens_batched(logits: jax.Array, keys: jax.Array, *,
     return jax.vmap(
         lambda k, lg: jax.random.categorical(k, lg, axis=-1))(
             keys, logits).astype(jnp.int32)
+
+
+def sample_token_grid(logits: jax.Array, keys: jax.Array, *,
+                      temperature: float = 1.0, top_k: int = 0,
+                      top_p: float = 0.0) -> jax.Array:
+    """:func:`sample_tokens_batched` over a [B, S, V] slot grid with one
+    key per (row, slot) [B, S, ...] -> int32 [B, S]. Used by the
+    speculative verify step (inference/v2): slot ``j`` samples the
+    token at absolute position ``pos + 1 + j`` with that position's
+    key, so every target is bit-identical to what a per-position decode
+    would have sampled — filters and the categorical draw operate
+    row-wise, so flattening the grid changes nothing."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+            jnp.int32)
+    b, s, v = logits.shape
+    flat = sample_tokens_batched(
+        logits.reshape(b * s, v), keys.reshape(b * s, *keys.shape[2:]),
+        temperature=temperature, top_k=top_k, top_p=top_p)
+    return flat.reshape(b, s)

@@ -132,9 +132,12 @@ def collect_comms(reg: MetricsRegistry, comms_logger=None) -> None:
 # package here would pull jax + the model zoo into every telemetry
 # process, serving or not.
 _SERVING_COUNTERS_BASE = ("decoded_tokens", "host_dispatches",
-                          "fused_dispatches", "fused_steps")
+                          "fused_dispatches", "fused_steps",
+                          "spec_proposed_tokens",
+                          "spec_accepted_tokens", "spec_hit_slots")
 _SERVING_GAUGES = ("dispatches_per_token", "fused_occupancy",
                    "max_inflight_dispatches",
+                   "tokens_per_dispatch", "spec_acceptance_rate",
                    "prefix_hit_rate", "prefix_cached_blocks",
                    "prefix_evictable_blocks")
 

@@ -8,6 +8,8 @@ call sites run on both.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 
 
@@ -30,8 +32,84 @@ def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
     # inputs replicate and compute is redundant along them (correct,
     # incl. transpose: unmentioned-axis grads verified unscaled on
     # 0.4.37); the perf cost only exists on this fallback.
-    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+    replicated = frozenset(mesh.axis_names) - _spec_axes(
+        (in_specs, out_specs))
+
+    def traced(*args, **kw):
+        # record, for the duration of the body trace, which axes THIS
+        # fallback frame replicates — nested code (DistributedAttention)
+        # uses it to decide whether a further shard_map over such an
+        # axis may legally collapse to redundant local compute instead
+        # of crashing the 0.4.x lowering (manual-axes collision)
+        frames = _fallback_frames()
+        frames.append(replicated)
+        try:
+            return f(*args, **kw)
+        finally:
+            frames.pop()
+
+    return _shard_map(traced, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=check_vma)
+
+
+# per-thread: traces may run concurrently (e.g. the async serving
+# worker thread next to the main thread) and one thread's fallback
+# frame must not leak into another's nesting decision
+_FALLBACK_TLS = threading.local()
+
+
+def _fallback_frames() -> list:
+    frames = getattr(_FALLBACK_TLS, "frames", None)
+    if frames is None:
+        frames = _FALLBACK_TLS.frames = []
+    return frames
+
+
+def _spec_axes(specs) -> frozenset:
+    """Mesh axis names mentioned anywhere in a PartitionSpec pytree."""
+    from jax.sharding import PartitionSpec
+    out: set = set()
+
+    def visit(s):
+        if isinstance(s, PartitionSpec):
+            for entry in s:
+                if entry is None:
+                    continue
+                if isinstance(entry, (tuple, list)):
+                    out.update(a for a in entry if a is not None)
+                else:
+                    out.add(entry)
+        elif isinstance(s, (tuple, list)):
+            for e in s:
+                visit(e)
+        elif isinstance(s, dict):
+            for e in s.values():
+                visit(e)
+
+    visit(specs)
+    return frozenset(out)
+
+
+def fallback_replicated_axes() -> frozenset:
+    """Axes guaranteed REPLICATED (unmentioned in the specs, so inputs
+    broadcast and compute is redundant along them) by EVERY enclosing
+    0.4.x full-manual :func:`shard_map` fallback frame. Empty outside
+    the fallback — including on jax >= 0.5, whose partial-manual
+    shard_map nests fine and never pushes a frame. A nested shard_map
+    over one of these axes cannot lower on 0.4.x (its spec'd axes
+    collide with the outer manual set), but because the inputs are
+    replicated along it, running the body's local computation on the
+    full arrays is bit-identical — callers use this to take that exit
+    ONLY when the replication guarantee actually holds. Frames are
+    per-thread: a trace running on another thread never alters this
+    thread's answer."""
+    frames = _fallback_frames()
+    if not frames:
+        return frozenset()
+    out = frames[0]
+    for s in frames[1:]:
+        out = out & s
+    return out
 
 
 def get_abstract_mesh():

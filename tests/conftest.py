@@ -128,6 +128,15 @@ _SLOW = {
     ("test_autotuning.py", "test_planner_aot_ranks_without_dispatch"),
     ("test_autotuning.py",
      "test_activation_checkpointing_policy_plumbs_to_model"),
+    # speculative decoding (ISSUE 9): config/drafter units + one
+    # all-modes greedy-parity test + the recompile/leak sentinel stay
+    # tier-1; the stochastic/admission-order/EOS/cancel engine sweeps
+    # are the heavy tail (the spec path also runs in the bench `spec`
+    # stage on every bench invocation)
+    ("test_spec_decode.py", "test_spec_stochastic_schedule_invariance"),
+    ("test_spec_decode.py", "test_spec_admission_order_invariance"),
+    ("test_spec_decode.py", "test_spec_eos_and_constrained_ring_parity"),
+    ("test_spec_decode.py", "test_spec_cancel_mid_stream_releases_blocks"),
     ("test_sparse_attention.py",
      "test_block_sparse_kernel_matches_dense_mask"),
     ("test_inference.py", "test_quantize_weights_int8_serving"),

@@ -309,6 +309,32 @@ def test_gl040_probe_and_package_are_exempt(tmp_path):
                      name="telemetry/bridges.py").findings == []
 
 
+def test_psum_of_literal_is_static_axis_size(tmp_path):
+    """``lax.psum(1, axis)`` constant-folds to the static axis size at
+    trace time — int()/arithmetic on it must NOT fire GL001 (the
+    ZeRO++ hierarchical gather false positive), while psum of a REAL
+    device value stays a device call."""
+    ok = """
+    import jax, jax.numpy as jnp
+    from jax import lax
+    def body(x):
+        world = lax.psum(1, "dp")
+        return x * int(world)
+    f = jax.jit(body)
+    """
+    assert _lint_src(tmp_path, ok).findings == []
+    bad = """
+    import jax, jax.numpy as jnp
+    from jax import lax
+    def body(x):
+        total = lax.psum(x, "dp")
+        return x * int(total)
+    f = jax.jit(body)
+    """
+    assert any(f.rule == "GL001"
+               for f in _lint_src(tmp_path, bad).findings)
+
+
 def test_cross_module_jit_marks_defs(tmp_path):
     """engine_v2-style cross-module jit: the module DEFINING the
     function has no jit call, the module USING it does."""

@@ -250,6 +250,25 @@ def test_serving_regression_gate(tmp_path):
     edge.write_text(json.dumps({**a, "tick_p50_ms": 21.5}))
     assert tr.main(["--diff", str(pa), str(edge),
                     "--gate", "serving"]) == 0
+    # speculative-decoding family (ISSUE 9): acceptance_rate /
+    # tokens_per_dispatch gate upward, spec_overhead_ms downward
+    sa = {"acceptance_rate": 0.9, "tokens_per_dispatch": 2.5,
+          "spec_overhead_ms": 40.0}
+    ps = tmp_path / "sa.json"
+    ps.write_text(json.dumps(sa))
+    sbad = tmp_path / "sbad.json"
+    sbad.write_text(json.dumps({"acceptance_rate": 0.8,
+                                "tokens_per_dispatch": 1.2,
+                                "spec_overhead_ms": 60.0}))
+    diff2 = tr.diff_snapshots(str(ps), str(sbad), gate="serving")
+    assert {r["metric"] for r in diff2["regressions"]} == {
+        "acceptance_rate", "tokens_per_dispatch", "spec_overhead_ms"}
+    sok = tmp_path / "sok.json"
+    sok.write_text(json.dumps({"acceptance_rate": 0.92,
+                               "tokens_per_dispatch": 2.6,
+                               "spec_overhead_ms": 39.0}))
+    assert tr.main(["--diff", str(ps), str(sok),
+                    "--gate", "serving"]) == 0
 
 
 def test_bench_default_invocation_always_exits_zero(devices8):

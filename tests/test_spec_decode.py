@@ -1,0 +1,245 @@
+"""Speculative decoding in the fused serving path (ISSUE 9):
+prompt-lookup drafting + the in-graph 1+draft_len verify.
+
+Pinned here: greedy bit-parity spec-on vs spec-off in all three
+serving modes (per-tick, chained, ring), stochastic accept/reject
+schedule-invariance (same seeds -> same tokens under different
+admission schedules), zero steady-state recompiles, and the
+rejected-KV-slot leak regressions (mid-stream rejection + cancel).
+Engine-heavy variants live in conftest._SLOW; the tier-1 tests keep to
+tiny models and short horizons (tier-1 budget is tight)."""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.paged import (append_history,
+                                              draft_prompt_lookup)
+from deepspeed_tpu.models import Llama
+
+PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7]]
+SPEC = {"enabled": True, "draft_len": 3, "min_ngram": 2,
+        "history_window": 64}
+
+
+def _engine(model, **over):
+    kw = dict(dtype="float32", kv_block_size=8, num_kv_blocks=128,
+              max_chunk_size=16)
+    kw.update(over)
+    return InferenceEngineV2(model, RaggedInferenceEngineConfig(**kw))
+
+
+# ---------------------------------------------------------------------
+# config + pure-device drafter units (no engine builds)
+# ---------------------------------------------------------------------
+
+def test_speculative_config_validation():
+    """The speculative block is off by default, validates bounds, and
+    rejects a history window too small to hold one n-gram + its full
+    continuation + the trailing n-gram."""
+    cfg = RaggedInferenceEngineConfig()
+    assert cfg.speculative.enabled is False
+    with pytest.raises(Exception, match="greater than or equal"):
+        RaggedInferenceEngineConfig(speculative={"enabled": True,
+                                                 "draft_len": 0})
+    with pytest.raises(Exception, match="history_window"):
+        RaggedInferenceEngineConfig(speculative={
+            "enabled": True, "draft_len": 4, "min_ngram": 3,
+            "history_window": 7})
+
+
+def test_draft_prompt_lookup_matches_and_misses():
+    """Device drafter semantics: trailing-n-gram match proposes the
+    continuation of its MOST RECENT earlier occurrence; no match (or a
+    -1-padded tail) proposes nothing; -1 fill never matches a real
+    n-gram."""
+    pad = [-1] * 6
+    hist = jnp.asarray([
+        pad + [5, 6, 7, 9, 5, 6],       # tail (5,6) matched at col 6
+        pad + [1, 2, 3, 4, 5, 6],       # no earlier (5,6): miss
+        [-1] * 10 + [3, 5],             # tail touches the -1 fill
+    ], jnp.int32)
+    draft, eff = draft_prompt_lookup(hist, min_ngram=2, draft_len=3)
+    assert eff.tolist() == [3, 0, 0]
+    assert draft[0].tolist() == [7, 9, 5]
+    # recency bias: with two occurrences the LATER one wins
+    hist2 = jnp.asarray(
+        [[1, 2, 8, 8, 1, 2, 9, 9, 9, 1, 2]], jnp.int32)
+    d2, e2 = draft_prompt_lookup(hist2, min_ngram=2, draft_len=2)
+    assert e2.tolist() == [2] and d2[0].tolist() == [9, 9]
+    # a window-edge match with a SHORT continuation is outranked by an
+    # earlier match with a full one (period-1 repetition must not
+    # collapse to 1-token drafts)
+    hist3 = jnp.asarray([[7, 7, 7, 7, 7, 7]], jnp.int32)
+    d3, e3 = draft_prompt_lookup(hist3, min_ngram=2, draft_len=3)
+    assert e3.tolist() == [3] and d3[0].tolist() == [7, 7, 7]
+
+
+def test_append_history_variable_advance():
+    """append_history shifts each row by its OWN emitted count and
+    keeps the window right-aligned; m=0 rows come back unchanged."""
+    hist = jnp.asarray([[-1, -1, 1, 2], [-1, 5, 6, 7]], jnp.int32)
+    emitted = jnp.asarray([[8, 9, 0], [3, 0, 0]], jnp.int32)
+    out = append_history(hist, emitted, jnp.asarray([2, 0], jnp.int32))
+    assert out.tolist() == [[1, 2, 8, 9], [-1, 5, 6, 7]]
+
+
+def test_sample_token_grid_greedy_is_argmax():
+    """The grid sampler's greedy path is exact argmax over every
+    (row, slot) — the verify step's exact-match guarantee."""
+    from deepspeed_tpu.ops.sampling import (position_keys,
+                                            sample_token_grid)
+    import jax
+    logits = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 3, 11)), jnp.float32)
+    keys = jax.vmap(position_keys)(
+        jax.random.split(jax.random.PRNGKey(0), 2),
+        jnp.arange(6, dtype=jnp.int32).reshape(2, 3))
+    got = sample_token_grid(logits, keys, temperature=0.0)
+    assert (np.asarray(got)
+            == np.argmax(np.asarray(logits), -1)).all()
+
+
+# ---------------------------------------------------------------------
+# engine acceptance (tier-1: tiny model, short horizons)
+# ---------------------------------------------------------------------
+
+def test_spec_greedy_parity_all_modes(devices8):
+    """Acceptance: greedy outputs are bit-identical spec-on vs spec-off
+    across per-tick, chained, and ring serving, and every engine is
+    left leak-free."""
+    model = Llama(size="tiny")
+    ref = _engine(model).generate(PROMPTS, max_new_tokens=8)
+    ref_f = _engine(model).generate_fused(PROMPTS, max_new_tokens=8,
+                                          k_steps=3)
+    assert ref_f == ref
+    chained = _engine(model, speculative=SPEC)
+    assert chained.generate_fused(PROMPTS, max_new_tokens=8,
+                                  k_steps=3) == ref
+    ring = _engine(model, speculative=SPEC, fused_admission=True,
+                   max_inflight_dispatches=3)
+    assert ring.generate_fused(PROMPTS, max_new_tokens=8,
+                               k_steps=3) == ref
+    for e in (chained, ring):
+        assert e.free_blocks == 128 and not e.state_manager.seqs
+    # counters have the documented schema (acceptance <= 1, committed
+    # slot multiplier >= 1 whether or not drafts landed on this model)
+    m = chained.serving_metrics()
+    assert m["spec_accepted_tokens"] <= m["spec_proposed_tokens"]
+    assert 0.0 <= m["spec_acceptance_rate"] <= 1.0
+    assert m["tokens_per_dispatch"] >= 0.0
+
+
+def test_spec_steady_state_zero_recompile_and_leak(devices8):
+    """Acceptance: a warmed spec-on engine adds ZERO backend_compile
+    events on subsequent generations (drafting/verify are one
+    executable family per config), and repeated runs with mid-stream
+    rejections leave the block pool full."""
+    from deepspeed_tpu.telemetry.bridges import (
+        compile_event_count, install_jax_compile_listener)
+    install_jax_compile_listener()
+    model = Llama(size="tiny")
+    e = _engine(model, speculative=SPEC)
+    kw = dict(max_new_tokens=8, k_steps=3)
+    first = e.generate_fused(PROMPTS, **kw)          # compile + warm
+    before = compile_event_count()
+    assert e.generate_fused(PROMPTS, **kw) == first
+    assert compile_event_count() == before
+    assert e.free_blocks == 128 and not e.state_manager.seqs
+
+
+# ---------------------------------------------------------------------
+# heavy variants (conftest._SLOW)
+# ---------------------------------------------------------------------
+
+def test_spec_stochastic_schedule_invariance(devices8):
+    """Same seeds -> same tokens: stochastic accept/reject uses
+    position-derived keys, so outputs are invariant to draft depth,
+    chain discipline, and ring admission for a fixed base seed."""
+    model = Llama(size="tiny")
+    kw = dict(max_new_tokens=8, temperature=0.9, top_k=50, seed=13)
+    a = _engine(model).generate_fused(PROMPTS, k_steps=2, **kw)
+    b = _engine(model, speculative=SPEC).generate_fused(
+        PROMPTS, k_steps=4, **kw)
+    c = _engine(model, speculative={**SPEC, "draft_len": 5},
+                fused_admission=True).generate_fused(
+        PROMPTS, k_steps=3, **kw)
+    assert a == b == c
+
+
+def test_spec_admission_order_invariance(devices8):
+    """Same seeds -> same tokens under DIFFERENT admission orders: a
+    batched admission and a row-constrained serial admission emit
+    identical per-uid stochastic streams (keys fold the uid, not the
+    row or the admission time)."""
+    from deepspeed_tpu.inference.v2.serve_loop import FusedServeLoop
+    model = Llama(size="tiny")
+
+    def serve(e, order):
+        loop = FusedServeLoop(e, k_steps=3, temperature=0.9, top_k=50,
+                              seed=13)
+        for uid in order:
+            loop.submit(PROMPTS[uid - 10], 8, uid=uid)
+        out = {u: [] for u in order}
+        while loop.has_work():
+            for evt in loop.step():
+                out[evt.uid].extend(evt.tokens)
+        return out
+
+    batched = serve(_engine(model, speculative=SPEC), [10, 11])
+    serial = serve(_engine(model, speculative=SPEC,
+                           max_ragged_sequence_count=1), [11, 10])
+    assert batched == serial
+
+
+def test_spec_eos_and_constrained_ring_parity(devices8):
+    """Mid-stream EOS truncation and the constrained-pool ring swap
+    stay bit-identical to per-tick spec-off decode."""
+    model = Llama(size="tiny")
+    free = _engine(model).generate([[1, 2, 3, 4, 5]],
+                                   max_new_tokens=10)[0]
+    eos = free[4]
+    ref = _engine(model).generate([[1, 2, 3, 4, 5], [9, 8, 7]],
+                                  max_new_tokens=10, eos_id=eos)
+    got = _engine(model, speculative=SPEC).generate_fused(
+        [[1, 2, 3, 4, 5], [9, 8, 7]], max_new_tokens=10, k_steps=4,
+        eos_id=eos)
+    assert got == ref
+    p = [list(range(10)), list(range(12))]
+    ref2 = _engine(model, num_kv_blocks=6).generate(p,
+                                                    max_new_tokens=12)
+    e2 = _engine(model, num_kv_blocks=6, speculative=SPEC,
+                 fused_admission=True)
+    assert e2.generate_fused(p, max_new_tokens=12, k_steps=3) == ref2
+    assert e2.free_blocks == 6 and not e2.state_manager.seqs
+
+
+def test_spec_cancel_mid_stream_releases_blocks(devices8):
+    """Leak regression with speculation on: a mid-stream cancel (KV
+    slots for in-flight draft tokens included) returns every block to
+    the pool."""
+    from deepspeed_tpu.serving import (AsyncInferenceServer,
+                                       RequestCancelled, ServingConfig)
+    e = _engine(Llama(size="tiny"), speculative=SPEC)
+
+    async def main():
+        async with AsyncInferenceServer(e, ServingConfig(k_steps=2)) as s:
+            h = await s.submit([1, 2, 3, 4, 5], max_new_tokens=100)
+            got = []
+            with pytest.raises(RequestCancelled):
+                async for t in h:
+                    got.append(t)
+                    if len(got) >= 3:
+                        h.cancel()
+            for _ in range(200):
+                if e.free_blocks == 128:
+                    break
+                await asyncio.sleep(0.02)
+            return got
+
+    assert asyncio.run(main())
+    assert e.free_blocks == 128 and not e.state_manager.seqs

@@ -288,6 +288,14 @@ _GATES = {
         ("ttft_seconds", -1, 0.15),
         ("itl_p99", -1, 0.15),
         ("itl_seconds", -1, 0.15),
+        # speculative decoding (ISSUE 9): draft acceptance and the
+        # tokens-committed-per-(row, tick)-slot multiplier must not
+        # shrink, and the spec-on overhead on a drafts-never-hit
+        # workload must not creep up. Listed before tokens_per_sec /
+        # the _ms stems so the more specific names match first.
+        ("spec_overhead_ms", -1, 0.10),
+        ("acceptance_rate", +1, 0.05),
+        ("tokens_per_dispatch", +1, 0.05),
         ("tokens_per_sec", +1, 0.05),
         ("fused_occupancy", +1, 0.05),
     ),
