@@ -571,6 +571,14 @@ def serve_openloop_bench(ds, on_tpu: bool):
     # the gated efficiency counters must cover ONLY the measured
     # traffic window, not the warm-up drives
     e.reset_serving_metrics()
+    # per-request tracing (ISSUE 10): with --telemetry the request
+    # recorder is live — clear the warm-up traces so the component
+    # percentiles and the access log cover only the measured window
+    from deepspeed_tpu.utils.telemetry_probe import active_telemetry
+    tel = active_telemetry()
+    rec = tel.get_request_recorder() if tel is not None else None
+    if rec is not None:
+        rec.clear()
 
     results = {"ttft": [], "itl_req": [], "done": 0}
 
@@ -610,6 +618,29 @@ def serve_openloop_bench(ds, on_tpu: bool):
 
     ticks = [dt / s * 1e3 for dt, s in drains if s > 0]
     tick_p50 = pct(ticks, 0.5)
+    # tail-latency attribution (ISSUE 10): per-request component
+    # percentiles + the dominant p99-TTFT component + a reconciliation
+    # figure (worst relative gap between a request's TTFT component sum
+    # and its measured TTFT — the acceptance bound is 5%)
+    breakdown: dict = {}
+    if rec is not None:
+        pcts = rec.component_percentiles()
+        for name in ("queue_wait", "prefill", "first_drain",
+                     "decode_active", "boundary_gap", "preempt_stall"):
+            row = pcts.get(name)
+            breakdown[f"{name}_p50_ms"] = (
+                round(row["p50"] * 1e3, 3) if row else None)
+            breakdown[f"{name}_p99_ms"] = (
+                round(row["p99"] * 1e3, 3) if row else None)
+        attr = rec.ttft_attribution()
+        breakdown["ttft_dominant_component"] = attr.get(
+            "dominant_component")
+        recon = [abs((tr.queue_wait_s + tr.prefill_s
+                      + tr.first_drain_s) - tr.ttft_s) / tr.ttft_s
+                 for tr in rec.completed() if tr.ttft_s]
+        breakdown["access_log_requests"] = len(rec.completed())
+        breakdown["ttft_recon_max_rel_err"] = (
+            round(max(recon), 5) if recon else None)
     return {"metric": "serve_openloop_ttft_p50_ms",
             "value": pct(results["ttft"], 0.5), "unit": "ms",
             "requests": n_req, "completed": results["done"],
@@ -628,7 +659,7 @@ def serve_openloop_bench(ds, on_tpu: bool):
             "fused_occupancy": round(m["fused_occupancy"], 3),
             "preemptions": m["preemptions"],
             "chain_depth": depth, "fused_k": K,
-            "fused_admission": True}
+            "fused_admission": True, **breakdown}
 
 
 def serving_bench(ds, on_tpu: bool):

@@ -952,6 +952,14 @@ class InferenceEngineV2:
         tel = _telemetry()
         reg = tel.get_registry() if tel is not None else None
         lat = _LatencyProbe(reg) if reg is not None else None
+        # per-request traces (ISSUE 10): the per-tick driver records
+        # the same lifecycle the fused serve loop does, so its requests
+        # land in the access log / Perfetto tracks too
+        rt = tel.get_request_recorder() if tel is not None else None
+        if rt is not None:
+            for uid, prompt in pending:
+                rt.enqueue(uid, priority=1, prompt_tokens=len(prompt),
+                           max_new_tokens=max_new_tokens)
 
         def admit():
             """Admit as many pending prompts as fit, reserving each one's
@@ -987,6 +995,12 @@ class InferenceEngineV2:
                     live[uid] = []
             if lat is not None:
                 lat.admitted([u for u, _ in batch], waiting=len(pending))
+            if rt is not None:
+                for uid, _ in batch:
+                    seen = mgr.seqs[uid].seen
+                    rt.admitted(uid, queue_depth=len(pending),
+                                cached_tokens=seen,
+                                cached_blocks=seen // bs)
 
         try:
             admit()
@@ -1000,6 +1014,7 @@ class InferenceEngineV2:
                     continue
                 # one tick advances every pending sequence one chunk; a
                 # sequence whose pending drained yields logits -> sample
+                t_tick = time.perf_counter() if rt is not None else 0.0
                 finished = self.tick()
                 decode_uids: list[int] = []
                 for u in sorted(finished):
@@ -1014,12 +1029,20 @@ class InferenceEngineV2:
                     self.serving_stats["decoded_tokens"] += 1
                     if lat is not None:
                         lat.tokens(u, 1, first=len(live[u]) == 1)
+                    if rt is not None:
+                        # each tick is this driver's dispatch window:
+                        # tick wall lands in decode_active, inter-tick
+                        # host time in boundary_gap
+                        rt.tokens_landed(u, 1, window_start=t_tick,
+                                         steps=1)
                     if (len(live[u]) >= max_new_tokens
                             or (eos_id is not None
                                 and live[u][-1] == eos_id)):
                         results[u] = live.pop(u)[:max_new_tokens]
                         reserved.pop(u)
                         self.flush(u)
+                        if rt is not None:
+                            rt.finished(u, "completed")
                     else:
                         decode_uids.append(u)
                 if decode_uids:
@@ -1033,6 +1056,9 @@ class InferenceEngineV2:
             # already-scheduled sequences' KV blocks on a shared engine
             for u in list(live):
                 self.flush(u)
+            if rt is not None:
+                for u in list(live) + [uid for uid, _ in pending]:
+                    rt.finished(u, "aborted")
             raise
         return [results[i] for i in range(len(prompts))]
 

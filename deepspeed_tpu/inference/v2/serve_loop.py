@@ -178,6 +178,10 @@ class FusedServeLoop:
                else None)
         from .engine_v2 import _LatencyProbe
         self._lat = _LatencyProbe(reg) if reg is not None else None
+        # per-request lifecycle recorder (ISSUE 10): every call below
+        # is guarded, so the telemetry-disabled loop is untouched
+        self._rt = (self._tel.get_request_recorder()
+                    if self._tel is not None else None)
 
     # ------------------------------------------------------------------
     # request intake (single-threaded with step(); see module docstring)
@@ -194,6 +198,12 @@ class FusedServeLoop:
             uid=int(uid), prompt=toks,
             max_new_tokens=max(1, int(max_new_tokens)),
             priority=int(priority), order=next(self._order)))
+        if self._rt is not None:
+            # idempotent: the async server already recorded the true
+            # submit time (mailbox latency counts as queue wait)
+            self._rt.enqueue(int(uid), priority=int(priority),
+                             prompt_tokens=len(toks),
+                             max_new_tokens=max(1, int(max_new_tokens)))
         return int(uid)
 
     def cancel(self, uid: int) -> None:
@@ -228,6 +238,9 @@ class FusedServeLoop:
     def close(self) -> None:
         """Release every request's KV state (server shutdown)."""
         self._emergency_flush()
+        if self._rt is not None:
+            for r in self.waiting:
+                self._rt.finished(r.uid, "aborted")
         self.waiting.clear()
         self._cancelled.clear()
 
@@ -244,6 +257,11 @@ class FusedServeLoop:
         self._carry = None
         for u in (set(self.live) | set(self.staged) | set(self.to_flush)):
             self.e.flush(u)
+        if self._rt is not None:
+            # to_flush uids already recorded their outcome (finished()
+            # is a no-op on unknown uids); live/staged die aborted
+            for u in (set(self.live) | set(self.staged)):
+                self._rt.finished(u, "aborted")
         self.live.clear()
         self.staged.clear()
         self.to_flush.clear()
@@ -276,6 +294,8 @@ class FusedServeLoop:
                 self.e.flush(uid)
                 self._carry = None  # membership changed mid-rowset
             self.counters["cancellations"] += 1
+            if self._rt is not None:
+                self._rt.finished(uid, "cancelled")
             ev.append(TokenEvent(uid, [], finished=True,
                                  error="cancelled"))
         self._cancelled.clear()
@@ -286,7 +306,11 @@ class FusedServeLoop:
         self.to_flush.append(uid)
         if self._lat is not None:
             self._lat.finished(uid)
-        if uid in self._cancelled:
+        cancelled = uid in self._cancelled
+        if self._rt is not None:
+            self._rt.finished(uid, "cancelled" if cancelled
+                              else "completed")
+        if cancelled:
             self._cancelled.discard(uid)
             self.counters["cancellations"] += 1
             ev.append(TokenEvent(uid, [], finished=True,
@@ -341,6 +365,8 @@ class FusedServeLoop:
                 if self.strict:
                     raise ValueError(msg)
                 self.waiting.pop(0)
+                if self._rt is not None:
+                    self._rt.finished(req.uid, "failed", error=msg)
                 ev.append(TokenEvent(req.uid, [], finished=True,
                                      error=msg))
                 continue
@@ -378,6 +404,14 @@ class FusedServeLoop:
         self.counters["restores"] += sum(1 for r in batch
                                          if r.preemptions > 0
                                          and r.generated)
+        if self._rt is not None:
+            qd = len(self.waiting)
+            for r in batch:
+                seen = mgr.seqs[r.uid].seen
+                self._rt.admitted(
+                    r.uid, queue_depth=qd, cached_tokens=seen,
+                    cached_blocks=seen // bs,
+                    restore=r.preemptions > 0 and bool(r.generated))
         return [r.uid for r in batch]
 
     def _try_preempt(self, req: ServeRequest, short_blocks: int,
@@ -413,6 +447,8 @@ class FusedServeLoop:
             self.counters["preemptions"] += 1
             if self._lat is not None:
                 self._lat.finished(v.uid)
+            if self._rt is not None:
+                self._rt.parked(v.uid)
             self._carry = None
             parked = True
             short_blocks -= mgr.available_blocks - freed_before
@@ -449,6 +485,10 @@ class FusedServeLoop:
         if not firsts:
             return
         uids_f = list(firsts)
+        if self._rt is not None:
+            # prefill compute done; first-token sampling/stream-out
+            # lands in the first_drain component
+            self._rt.prefill_done(uids_f)
         base = e._base_key(self.seed)
         row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(
             jnp.asarray(np.asarray(uids_f, np.uint32)))
@@ -467,6 +507,8 @@ class FusedServeLoop:
             ev.append(TokenEvent(u, [tok]))
             if self._lat is not None:
                 self._lat.tokens(u, 1, first=len(req.generated) == 1)
+            if self._rt is not None:
+                self._rt.tokens_landed(u, 1)
             if req.budget <= 0 or (self.eos is not None
                                    and tok == self.eos):
                 self._finish(u, ev, staged=u in self.staged)
@@ -555,6 +597,9 @@ class FusedServeLoop:
                               sstat))
             stats["host_dispatches"] += 1
             stats["fused_dispatches"] += 1
+            if self._rt is not None:
+                self._rt.dispatched(self._rowset,
+                                    stats["fused_dispatches"], k=self.k)
 
         if not self.infl:       # chain declined to enqueue: rebuild
             self._carry = None
@@ -577,6 +622,7 @@ class FusedServeLoop:
         stats["fused_steps"] += n_exec
         stats["fused_slots"] += n_exec * len(rows)
         now = time.perf_counter()
+        win_start = self._last_drain_t     # dispatch-window open (ISSUE 10)
         self.drain_stats.append((now - self._last_drain_t, n_exec))
         self._last_drain_t = now
         self.counters["chain_drains"] += 1
@@ -600,6 +646,10 @@ class FusedServeLoop:
                 stats["fused_live_slots"] += len(row)
             if self._lat is not None:
                 self._lat.tokens(u, len(row))
+            if self._rt is not None:
+                self._rt.tokens_landed(u, len(row),
+                                       window_start=win_start,
+                                       steps=n_exec, row=i)
             if u not in self._cancelled:
                 ev.append(TokenEvent(u, row))
             if (req.budget <= 0
@@ -630,10 +680,11 @@ class FusedServeLoop:
             # the engine is empty and the head request STILL does not
             # fit: it never will — fail it instead of spinning
             req = self.waiting.pop(0)
-            ev.append(TokenEvent(
-                req.uid, [], finished=True,
-                error=f"request {req.uid} cannot fit the KV pool even "
-                      "with the engine idle"))
+            msg = (f"request {req.uid} cannot fit the KV pool even "
+                   "with the engine idle")
+            if self._rt is not None:
+                self._rt.finished(req.uid, "failed", error=msg)
+            ev.append(TokenEvent(req.uid, [], finished=True, error=msg))
 
     # ------------------------------------------------------------------
     # ring mode: in-graph admission + one host read per chain
@@ -751,6 +802,9 @@ class FusedServeLoop:
                 res = self.fn(e.params, e.pools, *dis_ops)
         stats["host_dispatches"] += 1
         stats["fused_dispatches"] += 1
+        if self._rt is not None:
+            self._rt.dispatched(rowset, stats["fused_dispatches"],
+                                k=self.k)
         return res
 
     def _drain_ring(self, ev, rowset, stage_map, ring, ring_ep,
@@ -788,6 +842,7 @@ class FusedServeLoop:
         stats["fused_steps"] += n_exec
         stats["fused_slots"] += n_exec * len(rowset)
         now = time.perf_counter()
+        win_start = self._last_drain_t     # chain-window open (ISSUE 10)
         self.drain_stats.append((now - self._last_drain_t, n_exec))
         self._last_drain_t = now
         self.counters["chain_drains"] += 1
@@ -812,6 +867,11 @@ class FusedServeLoop:
                     stats["fused_live_slots"] += len(seg)
                 if self._lat is not None:
                     self._lat.tokens(uid, len(seg))
+                if self._rt is not None:
+                    self._rt.tokens_landed(uid, len(seg),
+                                           window_start=win_start,
+                                           steps=n_exec, row=i,
+                                           epoch=e_idx)
                 if uid not in self._cancelled:
                     ev.append(TokenEvent(uid, seg))
                 if staged and int(ep_fin[i]) >= 1:

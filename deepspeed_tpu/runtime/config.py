@@ -236,6 +236,15 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # SIGABRT the process after a hang dump so a supervisor restarts
     # it (instead of an external timeout SIGKILLing without forensics)
     watchdog_abort: bool = False
+    # --- per-request serving traces (ISSUE 10) -----------------------
+    # record one lifecycle trace per serving request (enqueue/admit/
+    # prefill/dispatch/drain/park/finish) with an exact TTFT + ITL
+    # latency decomposition; exported as per-request Perfetto tracks,
+    # a JSONL access log and component/SLO registry metrics. Host-only
+    # ring; nothing is recorded until requests flow.
+    request_traces: bool = True
+    # completed-trace ring capacity (requests; oldest dropped first)
+    request_trace_size: int = 1024
 
 
 class SentinelsConfig(DeepSpeedConfigModel):

@@ -47,3 +47,11 @@ class ServingConfig(DeepSpeedConfigModel):
     seed: int = 0
     # worker-thread sleep while idle or waiting on admission headroom
     idle_poll_s: float = Field(0.002, gt=0.0)
+    # --- serving SLO targets (ISSUE 10) ------------------------------
+    # with telemetry's request tracing active, every completed request
+    # whose TTFT (submit -> first token) exceeds this target bumps
+    # ds_serving_slo_ttft_breaches_total (SLO burn). 0 = no target.
+    slo_ttft_ms: float = Field(0.0, ge=0.0)
+    # same for the request's MEAN inter-token latency ->
+    # ds_serving_slo_itl_breaches_total. 0 = no target.
+    slo_itl_ms: float = Field(0.0, ge=0.0)

@@ -67,6 +67,11 @@ class RequestHandle:
         self._finished = False
         self.error: Optional[str] = None
         self.submitted_at = time.perf_counter()
+        # request-trace correlation id (ISSUE 10): set by submit() when
+        # telemetry's request tracing is active — the same id appears
+        # in the access log, the Perfetto request track and the
+        # Prometheus histogram exemplars
+        self.trace_id: Optional[str] = None
 
     # worker -> event loop (always via call_soon_threadsafe)
     def _push(self, evt: TokenEvent) -> None:
@@ -135,6 +140,9 @@ class AsyncInferenceServer:
         self._open = 0          # queued + running requests
         self._worker_error: Optional[BaseException] = None
         self.session: Optional[FusedServeLoop] = None
+        self._rt = None         # request-trace recorder (ISSUE 10)
+        self._hb_meta: dict = {}    # cached heartbeat summary
+        self._hb_next = 0.0         # next full-summary refresh time
 
     # ------------------------------------------------------------------
     async def __aenter__(self):
@@ -154,6 +162,14 @@ class AsyncInferenceServer:
             temperature=cfg.temperature, top_k=cfg.top_k,
             top_p=cfg.top_p, eos_id=cfg.eos_token_id, seed=cfg.seed,
             strict=False, preemption=cfg.preemption)
+        tel = _telemetry()
+        self._rt = (tel.get_request_recorder() if tel is not None
+                    else None)
+        if self._rt is not None:
+            # SLO burn counters measure against this server's targets
+            self._rt.set_slo(
+                cfg.slo_ttft_ms / 1e3 if cfg.slo_ttft_ms else None,
+                cfg.slo_itl_ms / 1e3 if cfg.slo_itl_ms else None)
         self._accepting = True
         self._stopping = False
         self._thread = threading.Thread(target=self._work, daemon=True,
@@ -199,11 +215,18 @@ class AsyncInferenceServer:
         handle = RequestHandle(uid, self)
         self._handles[uid] = handle
         self._open += 1
-        self._post(("submit", uid, [int(t) for t in prompt],
-                    int(max_new_tokens if max_new_tokens is not None
-                        else cfg.default_max_new_tokens),
-                    int(priority if priority is not None
-                        else cfg.default_priority)))
+        toks = [int(t) for t in prompt]
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else cfg.default_max_new_tokens)
+        prio = int(priority if priority is not None
+                   else cfg.default_priority)
+        if self._rt is not None:
+            # the trace's enqueue timestamp is the client-visible
+            # submit time — mailbox marshalling counts as queue wait
+            handle.trace_id = self._rt.enqueue(
+                uid, priority=prio, prompt_tokens=len(toks),
+                max_new_tokens=max_new)
+        self._post(("submit", uid, toks, max_new, prio))
         return handle
 
     async def generate(self, prompt: Sequence[int], **kw) -> list[int]:
@@ -272,6 +295,15 @@ class AsyncInferenceServer:
                     for uid in list(self._handles)]
             if fail:
                 self._emit(fail)
+            if self._rt is not None:
+                # close the traces of every request this server still
+                # owned — including submits stranded in the mailbox
+                # that never reached the loop (finished() is a no-op
+                # for uids the loop already closed); otherwise they
+                # haunt in_flight()/hang dumps as ever-aging ghosts
+                for uid in list(self._handles):
+                    self._rt.finished(uid, "failed",
+                                      error="serving loop died")
         finally:
             try:
                 s.close()
@@ -300,7 +332,23 @@ class AsyncInferenceServer:
             return
         fr = tel.get_flight_recorder()
         if fr is not None:
-            fr.progress("serving_loop")
+            # the heartbeat names the in-flight requests (ISSUE 10):
+            # a wedged serving loop's flight-recorder ring and hang
+            # dump then say WHICH uids were stuck and for how long,
+            # not just that the thread stalled. The full oldest-first
+            # summary scans the in-flight map, so refresh it at most
+            # ~4 Hz; between refreshes the heartbeat carries the O(1)
+            # live count (this loop steps every few ms under load)
+            if self._rt is None:
+                meta = {"inflight": self._open}
+            else:
+                now = time.monotonic()
+                if now >= self._hb_next:
+                    self._hb_meta = self._rt.heartbeat_meta()
+                    self._hb_next = now + 0.25
+                meta = {**self._hb_meta,
+                        "inflight": self._rt.inflight_count()}
+            fr.progress("serving_loop", **meta)
         reg = tel.get_registry()
         if reg is None:
             return

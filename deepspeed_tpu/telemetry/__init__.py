@@ -35,13 +35,15 @@ from typing import Optional
 
 from . import (bridges, collectives, flightrec as _flightrec_mod,  # noqa: F401
                ledger as _ledger_mod, registry as _registry_mod,
-               spans as _spans_mod)
+               reqtrace as _reqtrace_mod, spans as _spans_mod)
 from .flightrec import (FlightRecorder, HangWatchdog,  # noqa: F401
                         get_flight_recorder, get_watchdog)
 flightrec = _flightrec_mod   # public alias for instrumented call sites
 from .ledger import ExecutableLedger, get_ledger  # noqa: F401
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry, get_registry)
+from .reqtrace import (RequestTraceRecorder,  # noqa: F401
+                       get_request_recorder)
 from .spans import NULL_CONTEXT, SpanTracer, get_tracer  # noqa: F401
 
 _ACTIVE = False
@@ -62,7 +64,9 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
               flight_recorder_size: Optional[int] = None,
               watchdog_deadline_s: Optional[float] = None,
               watchdog_artifact_dir: Optional[str] = None,
-              watchdog_abort: Optional[bool] = None) -> None:
+              watchdog_abort: Optional[bool] = None,
+              request_traces: Optional[bool] = None,
+              request_trace_size: Optional[int] = None) -> None:
     """Activate telemetry for this process. ``config`` may be the
     engine's ``TelemetryConfig`` block; keyword overrides win.
     Idempotent: re-configuring while active keeps the existing
@@ -96,9 +100,17 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
     abort = pick(watchdog_abort, "watchdog_abort", False)
     global _ARTIFACT_DIR
     _ARTIFACT_DIR = artifact_dir
+    req_on = pick(request_traces, "request_traces", True)
+    req_cap = pick(request_trace_size, "request_trace_size", 1024)
     _spans_mod.set_tracer(SpanTracer(
         capacity=capacity, profiler_annotations=annotations))
     _registry_mod.set_registry(MetricsRegistry())
+    if req_on:
+        # per-request serving traces (ISSUE 10): host-only ring; the
+        # serving loops resolve it through the probe and guard every
+        # call, so nothing is recorded until requests actually flow
+        _reqtrace_mod.set_request_recorder(RequestTraceRecorder(
+            capacity=req_cap, registry=_registry_mod.get_registry()))
     if ledger_on:
         _ledger_mod.set_ledger(ExecutableLedger(
             hlo_collectives=hlo_coll))
@@ -124,6 +136,7 @@ def shutdown() -> None:
     _flightrec_mod.set_watchdog(None)
     _flightrec_mod.set_flight_recorder(None)
     _ledger_mod.set_ledger(None)
+    _reqtrace_mod.set_request_recorder(None)
     _spans_mod.set_tracer(None)
     _registry_mod.set_registry(None)
 
@@ -143,6 +156,9 @@ def clear() -> None:
     fr = get_flight_recorder()
     if fr is not None:
         fr.clear()
+    rt = get_request_recorder()
+    if rt is not None:
+        rt.clear()
 
 
 def span(name: str, **tags):
@@ -181,14 +197,34 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
     bridges.collect_ledger(reg)
     if serving_metrics is not None:
         bridges.collect_serving(reg, serving_metrics)
-    out = {
-        "trace": tracer.export_chrome_trace(
-            os.path.join(out_dir, f"{prefix}.trace.json")),
-        "prometheus": reg.dump_prometheus(
-            os.path.join(out_dir, f"{prefix}.prom")),
-        "metrics_json": reg.dump_json(
-            os.path.join(out_dir, f"{prefix}.metrics.json")),
-    }
+    rt = get_request_recorder()
+    if rt is not None:
+        rt.collect(reg)     # component p50/p99 gauges
+    out = {}
+    # per-request async tracks (ISSUE 10) ride the same Chrome-trace
+    # document as the host spans — one named tid per request — so
+    # `telemetry_report --merge` composes them per rank unchanged
+    doc = tracer.chrome_trace()
+    if rt is not None:
+        pid = doc["traceEvents"][0].get("pid", 0) \
+            if doc["traceEvents"] else 0
+        doc["traceEvents"].extend(
+            rt.chrome_events(pid, tracer.epoch_ns))
+    trace_path = os.path.join(out_dir, f"{prefix}.trace.json")
+    import json as _json
+    with open(trace_path, "w") as f:
+        _json.dump(doc, f)
+    out["trace"] = trace_path
+    out["prometheus"] = reg.dump_prometheus(
+        os.path.join(out_dir, f"{prefix}.prom"))
+    out["metrics_json"] = reg.dump_json(
+        os.path.join(out_dir, f"{prefix}.metrics.json"))
+    if rt is not None:
+        # structured access log: one JSONL line per completed request
+        log_path = rt.write_access_log(
+            os.path.join(out_dir, f"{prefix}.access.jsonl"))
+        if log_path:
+            out["access_log"] = log_path
     led = get_ledger()
     if led is not None:
         import json as _json
@@ -214,4 +250,4 @@ def dump_flight_record(reason: str,
     return _flightrec_mod.dump_state(
         reason, out_dir or _ARTIFACT_DIR, recorder=rec,
         tracer=get_tracer(), ledger=get_ledger(),
-        registry=get_registry())
+        registry=get_registry(), reqtrace=get_request_recorder())

@@ -150,7 +150,7 @@ def _thread_stacks() -> dict:
 
 
 def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
-               ledger=None, registry=None) -> str:
+               ledger=None, registry=None, reqtrace=None) -> str:
     """Write one self-contained hang-dump JSON artifact and return its
     path. Safe to call from any thread (the watchdog's, bench's
     budget watchdog, a signal handler's deferred path); never raises —
@@ -179,6 +179,13 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
             doc["ledger"] = ledger.snapshot()
     except Exception as e:   # noqa: BLE001
         doc["ledger_error"] = repr(e)
+    try:
+        # the stuck REQUESTS, not just the stalled thread (ISSUE 10):
+        # uids, trace ids, state and age of everything in flight
+        if reqtrace is not None:
+            doc["in_flight_requests"] = reqtrace.in_flight()
+    except Exception as e:   # noqa: BLE001
+        doc["in_flight_requests_error"] = repr(e)
     try:
         if registry is not None:
             doc["metrics"] = registry.snapshot()
@@ -273,10 +280,12 @@ class HangWatchdog:
     def fire(self, reason: str) -> str:
         """Dump now, regardless of stall state (bench's total-budget
         watchdog routes through here)."""
-        from . import get_ledger, get_registry, get_tracer
+        from . import (get_ledger, get_registry, get_request_recorder,
+                       get_tracer)
         path = dump_state(reason, self.artifact_dir,
                           recorder=self.recorder, tracer=get_tracer(),
-                          ledger=get_ledger(), registry=get_registry())
+                          ledger=get_ledger(), registry=get_registry(),
+                          reqtrace=get_request_recorder())
         if path:
             self.dumps.append(path)
             from ..utils.logging import logger

@@ -31,7 +31,18 @@ def _label_key(labels: dict) -> LabelKey:
 
 
 def _escape(v: str) -> str:
+    """Label-VALUE escaping per the text-format spec: backslash first
+    (escaping the escapes), then quote and newline. Now that
+    request-derived label values exist (trace ids, outcome strings,
+    component names fed from serving state), every value goes through
+    here — a stray quote or newline must not break a scrape."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: the spec escapes backslash and newline only
+    (quotes are legal in help text — escaping them would corrupt it)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(key: LabelKey, extra: Iterable[tuple[str, str]] = ()) -> str:
@@ -100,12 +111,16 @@ class Gauge(_Metric):
 
 
 class _HistState:
-    __slots__ = ("bucket_counts", "sum", "count")
+    __slots__ = ("bucket_counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int):
         self.bucket_counts = [0] * n_buckets  # non-cumulative per bucket
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (trace_id, value): the most recent exemplar
+        # observed into that bucket (OpenMetrics exemplar semantics —
+        # a p99 bucket links to a concrete request trace)
+        self.exemplars: dict[int, tuple[str, float]] = {}
 
 
 class Histogram(_Metric):
@@ -119,7 +134,12 @@ class Histogram(_Metric):
         super().__init__(name, help)
         self.buckets = tuple(sorted(float(b) for b in buckets))
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, *, exemplar: Optional[str] = None,
+                **labels) -> None:
+        """Record one observation. ``exemplar`` attaches a trace id to
+        the bucket the value lands in (most recent wins), emitted in
+        OpenMetrics exemplar syntax by :meth:`MetricsRegistry.\
+prometheus_text` so a tail bucket names a concrete trace."""
         value = float(value)
         k = _label_key(labels)
         with self._lock:
@@ -135,6 +155,16 @@ class Histogram(_Metric):
             st.bucket_counts[i] += 1
             st.sum += value
             st.count += 1
+            if exemplar is not None:
+                st.exemplars[i] = (str(exemplar), value)
+
+    def exemplars(self, **labels) -> dict:
+        """{bucket upper bound (inf for the tail): (trace_id, value)}"""
+        st = self._values.get(_label_key(labels))
+        if st is None:
+            return {}
+        ubs = list(self.buckets) + [math.inf]
+        return {ubs[i]: ex for i, ex in st.exemplars.items()}
 
     def summary(self, **labels) -> dict:
         """{count, sum, mean, buckets: {le: cumulative_count}}"""
@@ -200,11 +230,18 @@ class MetricsRegistry:
             for labels in m.label_sets():
                 if isinstance(m, Histogram):
                     s = m.summary(**labels)
-                    entries.append({
+                    entry = {
                         "labels": labels, "count": s["count"],
                         "sum": s["sum"], "mean": s["mean"],
                         "buckets": {("+Inf" if math.isinf(k) else k): v
-                                    for k, v in s["buckets"].items()}})
+                                    for k, v in s["buckets"].items()}}
+                    exs = m.exemplars(**labels)
+                    if exs:
+                        entry["exemplars"] = {
+                            ("+Inf" if math.isinf(k) else k):
+                                {"trace_id": t, "value": v}
+                            for k, (t, v) in exs.items()}
+                    entries.append(entry)
                 else:
                     entries.append({"labels": labels,
                                     "value": m.value(**labels)})
@@ -220,23 +257,36 @@ class MetricsRegistry:
             f.write(self.to_json(indent=indent))
         return path
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format (version 0.0.4)."""
+    def prometheus_text(self, exemplars: bool = True) -> str:
+        """Prometheus text exposition. HELP text and label values are
+        escaped per the 0.0.4 spec; with ``exemplars=True`` (default)
+        histogram buckets holding one carry it in OPENMETRICS exemplar
+        syntax (``... # {trace_id="..."} value``) so a tail bucket
+        links to a concrete request trace. Exemplars are an
+        OpenMetrics extension — strict 0.0.4 parsers reject mid-line
+        ``#``, so pass ``exemplars=False`` when feeding one (the
+        in-repo consumer, ``telemetry_report.parse_prometheus``,
+        strips the suffix)."""
         lines: list[str] = []
         for name in self.names():
             m = self._metrics[name]
             if m.help:
-                lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# HELP {name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
             for labels in m.label_sets():
                 key = _label_key(labels)
                 if isinstance(m, Histogram):
                     s = m.summary(**labels)
+                    exs = m.exemplars(**labels) if exemplars else {}
                     for ub, cum in s["buckets"].items():
                         le = "+Inf" if math.isinf(ub) else repr(ub)
-                        lines.append(
-                            f"{name}_bucket"
-                            f"{_fmt_labels(key, [('le', le)])} {cum}")
+                        line = (f"{name}_bucket"
+                                f"{_fmt_labels(key, [('le', le)])} {cum}")
+                        ex = exs.get(ub)
+                        if ex is not None:
+                            line += (f' # {{trace_id="{_escape(ex[0])}"}}'
+                                     f" {ex[1]}")
+                        lines.append(line)
                     lines.append(f"{name}_sum{_fmt_labels(key)} "
                                  f"{s['sum']}")
                     lines.append(f"{name}_count{_fmt_labels(key)} "

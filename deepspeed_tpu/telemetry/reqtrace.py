@@ -1,0 +1,615 @@
+"""Per-request lifecycle tracing + tail-latency attribution for the
+serving stack (ISSUE 10 tentpole).
+
+The serving telemetry so far is aggregate: the TTFT/ITL histograms say
+p99 is slow without saying WHICH request was slow or WHY (queue wait?
+cold prefill? a preemption park? a chain-boundary drain gap?). This
+module records one host-side trace per request driven through
+:class:`~...inference.v2.serve_loop.FusedServeLoop` (closed-loop
+``generate_fused``, the per-tick ``generate`` driver, and the async
+``deepspeed_tpu.serving`` front end all ride it): every lifecycle
+event — enqueue, admission (priority, queue depth at entry,
+prefix-cache blocks hit), prefill, fused dispatches participated in,
+token drains, preemption park/restore, cancel, completion — lands in a
+bounded per-request event list, and at completion the recorder derives
+an EXACT latency decomposition:
+
+- ``TTFT = queue_wait + prefill + first_drain`` (telescoping event
+  timestamps, so the components reconcile with the measured TTFT by
+  construction);
+- decode time (first token -> last token) splits into
+  ``decode_active`` (inside a dispatch-chain window: device compute +
+  dispatch RTT), ``boundary_gap`` (between chains: the host doing
+  admission/prefill/housekeeping for OTHER requests), and
+  ``preempt_stall`` (parked by a higher-priority arrival until the
+  next token after restore).
+
+Three export surfaces (all flush-boundary, never per token):
+
+- per-request async tracks appended to the Chrome-trace/Perfetto
+  export (one named track per request; composable with
+  ``telemetry_report --merge``);
+- a structured JSONL access log, one line per completed request
+  (:data:`ACCESS_LOG_KEYS`);
+- registry metrics: ``ds_serving_component_seconds{component}``
+  histograms and ``ds_serving_request_ttft_seconds`` carrying
+  OpenMetrics trace-id EXEMPLARS (a p99 bucket links to a concrete
+  trace), component p50/p99 gauges, and SLO burn counters
+  (``ds_serving_slo_{ttft,itl}_breaches_total`` against the
+  ``ServingConfig`` targets).
+
+Host-only, stdlib-only (graftlint host-only package audit applies);
+zero-import when telemetry is disabled — call sites resolve the
+recorder through the telemetry probe and guard every call.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+# one JSONL access-log line per completed request — the stable schema
+# consumers (and the schema test) hold on to. *_ms components telescope:
+# queue_wait + prefill + first_drain == ttft_ms and decode_active +
+# boundary_gap + preempt_stall == total_ms - ttft_ms, exactly.
+ACCESS_LOG_KEYS = (
+    "trace_id", "uid", "priority", "prompt_tokens", "output_tokens",
+    "max_new_tokens", "cached_blocks", "cached_tokens",
+    "queue_depth_at_admit", "preemptions", "drains", "dispatches",
+    "spec_tokens_extra", "outcome", "error", "enqueue_unix_s",
+    "ttft_ms", "itl_mean_ms", "total_ms", "queue_wait_ms",
+    "prefill_ms", "first_drain_ms", "decode_active_ms",
+    "boundary_gap_ms", "preempt_stall_ms")
+
+# the latency components the percentile gauges / bench breakdown report
+COMPONENT_KEYS = ("queue_wait", "prefill", "first_drain",
+                  "decode_active", "boundary_gap", "preempt_stall")
+
+_EVENT_CAP = 256            # per-request event-list bound
+_PARK_CAP = 32              # per-request parked-interval bound
+
+
+class RequestTrace:
+    """One request's lifecycle. Timestamps are ``time.perf_counter()``
+    seconds (same clock family as the span tracer's epoch, so the
+    Chrome export lines up with the host spans)."""
+
+    __slots__ = (
+        "uid", "trace_id", "priority", "prompt_tokens",
+        "max_new_tokens", "t_enqueue", "enqueue_unix",
+        "t_admit", "t_prefill_done", "t_first", "t_last", "t_finish",
+        "queue_depth_at_admit", "cached_tokens", "cached_blocks",
+        "preemptions", "tokens", "drains", "dispatches",
+        "spec_tokens_extra", "decode_active_s", "boundary_gap_s",
+        "preempt_stall_s", "park_open_t", "parks", "events",
+        "outcome", "error", "_t_prev_token", "_state")
+
+    def __init__(self, uid: int, trace_id: str, priority: int,
+                 prompt_tokens: int, max_new_tokens: int,
+                 now: float):
+        self.uid = uid
+        self.trace_id = trace_id
+        self.priority = priority
+        self.prompt_tokens = prompt_tokens
+        self.max_new_tokens = max_new_tokens
+        self.t_enqueue = now
+        self.enqueue_unix = time.time()
+        self.t_admit: Optional[float] = None        # first admission
+        self.t_prefill_done: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.t_finish: Optional[float] = None
+        self.queue_depth_at_admit = 0
+        self.cached_tokens = 0
+        self.cached_blocks = 0
+        self.preemptions = 0
+        self.tokens = 0
+        self.drains = 0
+        self.dispatches = 0
+        self.spec_tokens_extra = 0
+        self.decode_active_s = 0.0
+        self.boundary_gap_s = 0.0
+        self.preempt_stall_s = 0.0
+        self.park_open_t: Optional[float] = None
+        self.parks: list[tuple[float, float]] = []
+        self.events: deque = deque(maxlen=_EVENT_CAP)
+        self.outcome: Optional[str] = None
+        self.error: Optional[str] = None
+        self._t_prev_token: Optional[float] = None
+        self._state = "queued"
+
+    # -- derived components (seconds) ---------------------------------
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return self.t_first - self.t_enqueue
+
+    @property
+    def queue_wait_s(self) -> float:
+        if self.t_admit is None:
+            end = self.t_finish if self.t_finish is not None \
+                else self.t_enqueue
+            return end - self.t_enqueue
+        return self.t_admit - self.t_enqueue
+
+    @property
+    def prefill_s(self) -> float:
+        if self.t_admit is None or self.t_prefill_done is None:
+            return 0.0
+        return self.t_prefill_done - self.t_admit
+
+    @property
+    def first_drain_s(self) -> float:
+        if self.t_prefill_done is None or self.t_first is None:
+            return 0.0
+        return self.t_first - self.t_prefill_done
+
+    @property
+    def itl_mean_s(self) -> Optional[float]:
+        if self.t_first is None or self.t_last is None or self.tokens < 2:
+            return None
+        return (self.t_last - self.t_first) / (self.tokens - 1)
+
+    def components(self) -> dict[str, float]:
+        return {"queue_wait": self.queue_wait_s,
+                "prefill": self.prefill_s,
+                "first_drain": self.first_drain_s,
+                "decode_active": self.decode_active_s,
+                "boundary_gap": self.boundary_gap_s,
+                "preempt_stall": self.preempt_stall_s}
+
+    def access_log_row(self) -> dict:
+        ttft = self.ttft_s
+        itl = self.itl_mean_s
+        total = ((self.t_finish - self.t_enqueue)
+                 if self.t_finish is not None else None)
+
+        def ms(v):
+            return round(v * 1e3, 3) if v is not None else None
+
+        return {"trace_id": self.trace_id, "uid": self.uid,
+                "priority": self.priority,
+                "prompt_tokens": self.prompt_tokens,
+                "output_tokens": self.tokens,
+                "max_new_tokens": self.max_new_tokens,
+                "cached_blocks": self.cached_blocks,
+                "cached_tokens": self.cached_tokens,
+                "queue_depth_at_admit": self.queue_depth_at_admit,
+                "preemptions": self.preemptions,
+                "drains": self.drains, "dispatches": self.dispatches,
+                "spec_tokens_extra": self.spec_tokens_extra,
+                "outcome": self.outcome, "error": self.error,
+                "enqueue_unix_s": round(self.enqueue_unix, 6),
+                "ttft_ms": ms(ttft), "itl_mean_ms": ms(itl),
+                "total_ms": ms(total),
+                "queue_wait_ms": ms(self.queue_wait_s),
+                "prefill_ms": ms(self.prefill_s),
+                "first_drain_ms": ms(self.first_drain_s),
+                "decode_active_ms": ms(self.decode_active_s),
+                "boundary_gap_ms": ms(self.boundary_gap_s),
+                "preempt_stall_ms": ms(self.preempt_stall_s)}
+
+
+class RequestTraceRecorder:
+    """Bounded recorder: an ``active`` map of in-flight traces plus a
+    ring (``capacity``) of completed ones. All methods are host-only
+    and O(1) per event; the registry work (histograms + exemplars +
+    SLO counters) happens once per request at completion, percentile
+    gauges once per :meth:`collect` (export boundaries)."""
+
+    def __init__(self, capacity: int = 1024, registry=None,
+                 slo_ttft_s: Optional[float] = None,
+                 slo_itl_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.capacity = max(int(capacity), 8)
+        self._active: dict[int, RequestTrace] = {}
+        self._done: deque[RequestTrace] = deque(maxlen=self.capacity)
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._registry = registry
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_itl_s = slo_itl_s
+
+    # -- configuration -------------------------------------------------
+    def set_registry(self, registry) -> None:
+        self._registry = registry
+
+    def set_slo(self, ttft_s: Optional[float],
+                itl_s: Optional[float]) -> None:
+        """SLO targets (seconds; None/0 disables the burn counter)."""
+        self.slo_ttft_s = ttft_s if ttft_s else None
+        self.slo_itl_s = itl_s if itl_s else None
+
+    # -- lifecycle events ----------------------------------------------
+    def enqueue(self, uid: int, priority: int = 1,
+                prompt_tokens: int = 0,
+                max_new_tokens: int = 0) -> Optional[str]:
+        """Request submitted. Idempotent per in-flight uid (the async
+        server records the true submit time; the serve loop's own
+        submit() then no-ops). Returns the trace id."""
+        now = self._clock()
+        with self._lock:
+            tr = self._active.get(uid)
+            if tr is not None:
+                return tr.trace_id
+            trace_id = f"req{next(self._seq):06d}-u{uid}"
+            tr = RequestTrace(uid, trace_id, int(priority),
+                              int(prompt_tokens), int(max_new_tokens),
+                              now)
+            tr.events.append((now, "enqueue", None))
+            self._active[uid] = tr
+            return trace_id
+
+    def admitted(self, uid: int, queue_depth: int = 0,
+                 cached_tokens: int = 0, cached_blocks: int = 0,
+                 restore: bool = False) -> None:
+        now = self._clock()
+        with self._lock:
+            tr = self._active.get(uid)
+            if tr is None:
+                return
+            tr.events.append((now, "restore" if restore else "admit",
+                              {"queue_depth": queue_depth,
+                               "cached_blocks": cached_blocks}))
+            tr._state = "live"
+            if tr.t_admit is None:
+                tr.t_admit = now
+                tr.queue_depth_at_admit = int(queue_depth)
+                tr.cached_tokens = int(cached_tokens)
+                tr.cached_blocks = int(cached_blocks)
+
+    def prefill_done(self, uids) -> None:
+        now = self._clock()
+        with self._lock:
+            for uid in uids:
+                tr = self._active.get(uid)
+                if tr is not None:
+                    tr.events.append((now, "prefill_done", None))
+                    if tr.t_prefill_done is None:
+                        tr.t_prefill_done = now
+
+    def dispatched(self, uids, dispatch_id: int, k: int = 0) -> None:
+        """One fused dispatch enqueued with these uids in its rowset
+        (row/epoch attribution comes from the drain side)."""
+        now = self._clock()
+        with self._lock:
+            for uid in uids:
+                tr = self._active.get(uid)
+                if tr is not None:
+                    tr.dispatches += 1
+                    tr.events.append((now, "dispatch",
+                                      {"dispatch_id": dispatch_id,
+                                       "k": k}))
+
+    def tokens_landed(self, uid: int, n: int, *,
+                      window_start: Optional[float] = None,
+                      steps: int = 0, row: Optional[int] = None,
+                      epoch: Optional[int] = None) -> None:
+        """``n`` tokens for ``uid`` reached the host. ``window_start``
+        is the dispatch-chain window this drain closes (everything in
+        the gap since the request's previous token that falls inside
+        the window is decode_active; parked time is preempt_stall; the
+        remainder is boundary_gap). Prefill-sampled first tokens pass
+        no window."""
+        if n <= 0:
+            return
+        now = self._clock()
+        with self._lock:
+            tr = self._active.get(uid)
+            if tr is None:
+                return
+            meta = {"tokens": n}
+            if steps:
+                meta["steps"] = steps
+            if row is not None:
+                meta["row"] = row
+            if epoch:
+                meta["epoch"] = epoch
+            tr.events.append((now, "drain", meta))
+            tr.tokens += n
+            if steps:
+                tr.drains += 1
+                # tokens beyond one per executed tick: verified
+                # speculative drafts (ISSUE 9) landing in this drain
+                tr.spec_tokens_extra += max(0, n - steps)
+            if tr.t_first is None:
+                if tr.t_prefill_done is None:
+                    # driver never reported prefill separately (the
+                    # per-tick generate path): fold it into prefill so
+                    # the TTFT components still telescope exactly
+                    tr.t_prefill_done = now
+                tr.t_first = now
+            else:
+                prev = tr._t_prev_token if tr._t_prev_token is not None \
+                    else tr.t_first
+                gap = max(now - prev, 0.0)
+                parked = 0.0
+                if tr.park_open_t is not None:
+                    # the preemption stall ends at the first token
+                    # after restore (re-queue + re-prefill included:
+                    # from the client's seat that whole gap is the
+                    # preemption's price)
+                    parked = min(max(now - tr.park_open_t, 0.0), gap)
+                    tr.parks.append((tr.park_open_t, now))
+                    del tr.parks[:-_PARK_CAP]
+                    tr.park_open_t = None
+                active = 0.0
+                if window_start is not None:
+                    active = min(max(now - max(prev, window_start), 0.0),
+                                 gap - parked)
+                tr.preempt_stall_s += parked
+                tr.decode_active_s += active
+                tr.boundary_gap_s += max(gap - parked - active, 0.0)
+            tr._t_prev_token = now
+            tr.t_last = now
+            tr._state = "live"
+
+    def parked(self, uid: int) -> None:
+        """Preemption swap-out: the request left the decode batch."""
+        now = self._clock()
+        with self._lock:
+            tr = self._active.get(uid)
+            if tr is None:
+                return
+            tr.preemptions += 1
+            tr.park_open_t = now
+            tr._state = "parked"
+            tr.events.append((now, "park", None))
+
+    def finished(self, uid: int, outcome: str = "completed",
+                 error: Optional[str] = None) -> None:
+        now = self._clock()
+        with self._lock:
+            tr = self._active.pop(uid, None)
+            if tr is None:
+                return
+            if tr.t_first is not None:
+                # attribute the last-token -> finish tail so the decode
+                # decomposition telescopes exactly: decode_active +
+                # boundary_gap + preempt_stall == total - ttft
+                prev = tr._t_prev_token if tr._t_prev_token is not None \
+                    else tr.t_first
+                gap = max(now - prev, 0.0)
+                parked = 0.0
+                if tr.park_open_t is not None:
+                    parked = min(max(now - tr.park_open_t, 0.0), gap)
+                    tr.parks.append((tr.park_open_t, now))
+                    del tr.parks[:-_PARK_CAP]
+                    tr.park_open_t = None
+                tr.preempt_stall_s += parked
+                tr.boundary_gap_s += gap - parked
+            elif tr.park_open_t is not None:
+                # parked before any token and finished there
+                # (cancel/abort): close the stall
+                tr.preempt_stall_s += max(now - tr.park_open_t, 0.0)
+                tr.parks.append((tr.park_open_t, now))
+                tr.park_open_t = None
+            tr.t_finish = now
+            tr.outcome = outcome
+            tr.error = error
+            tr._state = outcome
+            tr.events.append((now, "finish", {"outcome": outcome}))
+            self._done.append(tr)
+        self._observe_finished(tr)
+
+    # -- registry export -----------------------------------------------
+    def _observe_finished(self, tr: RequestTrace) -> None:
+        reg = self._registry
+        if reg is None:
+            return
+        reg.counter(
+            "ds_serving_requests_total",
+            "completed serving requests by outcome").inc(
+            outcome=tr.outcome or "completed")
+        comp = reg.histogram(
+            "ds_serving_component_seconds",
+            "per-request latency decomposition: TTFT = queue_wait + "
+            "prefill + first_drain; decode = decode_active (in a "
+            "dispatch-chain window) + boundary_gap (between chains) + "
+            "preempt_stall (parked)")
+        for name, v in tr.components().items():
+            comp.observe(v, exemplar=tr.trace_id, component=name)
+        ttft = tr.ttft_s
+        if ttft is not None:
+            reg.histogram(
+                "ds_serving_request_ttft_seconds",
+                "submit -> first token per request (queueing "
+                "included; exemplars link buckets to trace ids)"
+            ).observe(ttft, exemplar=tr.trace_id)
+            if self.slo_ttft_s is not None and ttft > self.slo_ttft_s:
+                reg.counter(
+                    "ds_serving_slo_ttft_breaches_total",
+                    "requests whose TTFT exceeded the ServingConfig "
+                    "target (SLO burn)").inc()
+        itl = tr.itl_mean_s
+        if itl is not None:
+            reg.histogram(
+                "ds_serving_request_itl_seconds",
+                "per-request mean inter-token latency (exemplars "
+                "link buckets to trace ids)").observe(
+                itl, exemplar=tr.trace_id)
+            if self.slo_itl_s is not None and itl > self.slo_itl_s:
+                reg.counter(
+                    "ds_serving_slo_itl_breaches_total",
+                    "requests whose mean ITL exceeded the "
+                    "ServingConfig target (SLO burn)").inc()
+
+    def collect(self, reg=None) -> None:
+        """Component p50/p99 gauges from the completed ring (export
+        boundaries only — sorts the ring per component)."""
+        reg = reg if reg is not None else self._registry
+        if reg is None:
+            return
+        pcts = self.component_percentiles()
+        if not pcts:
+            return
+        p50 = reg.gauge("ds_serving_component_p50_seconds",
+                        "median per-request latency component over the "
+                        "completed-trace ring")
+        p99 = reg.gauge("ds_serving_component_p99_seconds",
+                        "p99 per-request latency component over the "
+                        "completed-trace ring")
+        for name, row in pcts.items():
+            p50.set(row["p50"], component=name)
+            p99.set(row["p99"], component=name)
+
+    # -- readers ---------------------------------------------------------
+    def completed(self) -> list[RequestTrace]:
+        with self._lock:
+            return list(self._done)
+
+    def in_flight(self) -> list[dict]:
+        """[{uid, trace_id, state, age_s, tokens, priority}] for the
+        flight-recorder heartbeat and the hang-watchdog dump: a wedged
+        serving loop names its stuck requests, not just the stalled
+        thread."""
+        now = self._clock()
+        with self._lock:
+            return [{"uid": tr.uid, "trace_id": tr.trace_id,
+                     "state": tr._state,
+                     "age_s": round(now - tr.t_enqueue, 4),
+                     "tokens": tr.tokens, "priority": tr.priority}
+                    for tr in self._active.values()]
+
+    def inflight_count(self) -> int:
+        """O(1) live-request count (the per-step heartbeat's fast
+        path — no scan, no row building)."""
+        with self._lock:
+            return len(self._active)
+
+    def heartbeat_meta(self, cap: int = 8) -> dict:
+        """Compact in-flight summary for a flight-recorder progress
+        event: live count plus the ``cap`` oldest uids (one partial
+        heap pass, no full sort / per-row dicts — this runs on the
+        serving loop's step path)."""
+        now = self._clock()
+        with self._lock:
+            n = len(self._active)
+            if not n:
+                return {"inflight": 0}
+            oldest = heapq.nsmallest(cap, self._active.values(),
+                                     key=lambda tr: tr.t_enqueue)
+        return {"inflight": n,
+                "uids": [tr.uid for tr in oldest],
+                "oldest_age_s": round(now - oldest[0].t_enqueue, 4),
+                "oldest_uid": oldest[0].uid}
+
+    def component_percentiles(self) -> dict[str, dict]:
+        """{component: {p50, p99, mean, n}} seconds over completed
+        requests that produced at least one token."""
+        rows = [tr for tr in self.completed() if tr.t_first is not None]
+        if not rows:
+            return {}
+        out = {}
+        for name in COMPONENT_KEYS:
+            vals = sorted(tr.components()[name] for tr in rows)
+            out[name] = {
+                "p50": vals[len(vals) // 2],
+                "p99": vals[min(len(vals) - 1,
+                               int(len(vals) * 0.99))],
+                "mean": sum(vals) / len(vals), "n": len(vals)}
+        return out
+
+    def ttft_attribution(self) -> dict:
+        """Which component dominates the TTFT tail: over the requests
+        at/above the TTFT p99, the mean of each TTFT component and the
+        name of the largest — 'what made the slowest requests slow'."""
+        rows = [tr for tr in self.completed() if tr.ttft_s is not None]
+        if not rows:
+            return {}
+        ttfts = sorted(tr.ttft_s for tr in rows)
+        p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+        tail = [tr for tr in rows if tr.ttft_s >= p99] or rows
+        comps = {}
+        for name in ("queue_wait", "prefill", "first_drain"):
+            comps[name] = (sum(tr.components()[name] for tr in tail)
+                           / len(tail))
+        dominant = max(comps, key=comps.get)
+        return {"ttft_p99_s": p99, "tail_requests": len(tail),
+                "dominant_component": dominant,
+                "tail_mean_components_s": comps}
+
+    # -- artifact export -------------------------------------------------
+    def write_access_log(self, path: str) -> Optional[str]:
+        """JSONL, one line per completed request, enqueue order.
+        Returns the path, or None when nothing completed."""
+        rows = self.completed()
+        if not rows:
+            return None
+        with open(path, "w") as f:
+            for tr in rows:
+                f.write(json.dumps(tr.access_log_row(),
+                                   sort_keys=True) + "\n")
+        return path
+
+    def chrome_events(self, pid: int, epoch_ns: int) -> list[dict]:
+        """Per-request tracks for the Chrome-trace export: each request
+        gets its own named tid under the host process, with one X slice
+        per lifecycle phase (+ parked intervals), so Perfetto shows a
+        swimlane per request next to the host spans. ``epoch_ns`` is
+        the span tracer's epoch (``perf_counter_ns`` at configure), so
+        both track families share a timebase."""
+        events: list[dict] = []
+
+        def us(t: float) -> float:
+            return round((t * 1e9 - epoch_ns) / 1e3, 3)
+
+        def slice_(tid, name, t0, t1, args):
+            if t0 is None or t1 is None or t1 < t0:
+                return
+            events.append({"name": name, "ph": "X", "ts": us(t0),
+                           "dur": round((t1 - t0) * 1e6, 3),
+                           "pid": pid, "tid": tid, "cat": "request",
+                           "args": args})
+
+        for i, tr in enumerate(self.completed()):
+            tid = 0x520000 + i          # clear of real thread ids
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": tid,
+                "args": {"name": f"req {tr.trace_id} "
+                                 f"(prio {tr.priority})"}})
+            base = {"trace_id": tr.trace_id, "uid": tr.uid}
+            slice_(tid, "req/queue_wait", tr.t_enqueue,
+                   tr.t_admit if tr.t_admit is not None else tr.t_finish,
+                   {**base, "queue_depth": tr.queue_depth_at_admit})
+            slice_(tid, "req/prefill", tr.t_admit, tr.t_prefill_done,
+                   {**base, "cached_blocks": tr.cached_blocks,
+                    "prompt_tokens": tr.prompt_tokens})
+            slice_(tid, "req/first_drain", tr.t_prefill_done, tr.t_first,
+                   dict(base))
+            slice_(tid, "req/decode", tr.t_first, tr.t_last,
+                   {**base, "tokens": tr.tokens,
+                    "drains": tr.drains,
+                    "dispatches": tr.dispatches,
+                    "preemptions": tr.preemptions,
+                    "outcome": tr.outcome})
+            for t0, t1 in tr.parks:
+                slice_(tid, "req/parked", t0, t1, dict(base))
+        return events
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._done.clear()
+
+
+# --- module-level current recorder (wired by telemetry.configure) --------
+
+_RECORDER: Optional[RequestTraceRecorder] = None
+
+
+def get_request_recorder() -> Optional[RequestTraceRecorder]:
+    return _RECORDER
+
+
+def set_request_recorder(rec: Optional[RequestTraceRecorder]) -> None:
+    global _RECORDER
+    _RECORDER = rec

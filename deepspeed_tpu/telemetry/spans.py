@@ -174,6 +174,13 @@ class SpanTracer:
                 self._depth0_seconds += dur_us / 1e6
             self.recorded += 1
 
+    @property
+    def epoch_ns(self) -> int:
+        """The tracer's time origin (``perf_counter_ns`` at
+        construction/clear) — exporters producing events on the same
+        timeline (the per-request tracks) convert through this."""
+        return self._epoch_ns
+
     # ------------------------------------------------------------------
     def spans(self) -> list[Span]:
         with self._lock:

@@ -182,6 +182,10 @@ _SLOW = {
     ("test_prefix_cache.py", "test_serving_metrics_schema_and_reset"),
     ("test_prefix_cache.py", "test_generate_fused_error_flushes_blocks"),
     ("test_prefix_cache.py", "test_prefix_cache_greedy_parity_per_tick"),
+    # request tracing (ISSUE 10): the fake-clock recorder unit tests
+    # (decomposition, schema, exemplars, SLO) stay tier-1; this
+    # engine-backed async-server reconciliation run is the heavy tail
+    ("test_reqtrace.py", "test_server_traces_reconcile_end_to_end"),
 }
 
 

@@ -613,6 +613,57 @@ def test_traced_roots_fixture_detects_traced_planner(tmp_path):
                for r in roots2)
 
 
+def test_serving_and_reqtrace_are_host_only():
+    """ISSUE 10 satellite: the async serving front end and the
+    per-request trace recorder are pure scheduler/bookkeeping code —
+    the worker thread marshals device work into the engine
+    (inference/v2), and reqtrace feeds request-derived strings into
+    the Prometheus exposition, so neither may ever become
+    jit-reachable (a traced recorder would bake wall-clock state into
+    an executable AND put tracers in the label path)."""
+    from deepspeed_tpu.analysis import traced_roots
+    targets = [os.path.join(PACKAGE, "serving"),
+               os.path.join(PACKAGE, "telemetry", "reqtrace.py")]
+    roots = traced_roots(targets, root=REPO)
+    assert roots == [], (
+        "serving/ + telemetry/reqtrace.py must stay host-only; "
+        "traced functions found:\n"
+        + "\n".join(f"{r['path']}:{r['line']}: {r['name']}"
+                    for r in roots))
+    # and the regular rule set is clean over both targets too
+    res = lint_paths(targets, root=REPO)
+    assert res.findings == [] and not res.errors
+
+
+def test_traced_roots_fixture_detects_traced_recorder(tmp_path):
+    """The serving/reqtrace audit actually detects a violation: a
+    recorder whose component math is jitted (positive fixture) is
+    flagged; the host-only twin (negative fixture) stays quiet."""
+    bad = tmp_path / "reqtrace_bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax, jax.numpy as jnp
+        def components(qw, pf, fd):
+            return jnp.stack([qw, pf, fd]) / jnp.sum(qw + pf + fd)
+        components_jit = jax.jit(components)
+        """))
+    good = tmp_path / "reqtrace_good.py"
+    good.write_text(textwrap.dedent("""
+        import time
+        def components(qw, pf, fd):
+            total = qw + pf + fd
+            return {"queue_wait": qw / total, "prefill": pf / total,
+                    "first_drain": fd / total}
+        def heartbeat_meta(rows):
+            return {"inflight": len(rows),
+                    "oldest_age_s": max((r["age_s"] for r in rows),
+                                        default=0.0)}
+        """))
+    from deepspeed_tpu.analysis import traced_roots
+    roots = traced_roots([str(bad)], root=str(tmp_path))
+    assert any(r["name"] == "components" for r in roots)
+    assert traced_roots([str(good)], root=str(tmp_path)) == []
+
+
 # ---------------------------------------------------------------------
 # runtime sentinels
 # ---------------------------------------------------------------------

@@ -1,0 +1,452 @@
+"""Per-request tracing & tail-latency attribution (ISSUE 10): the
+request-trace recorder's exact TTFT/decode decomposition, the access-log
+schema, component percentiles + tail attribution, Prometheus exemplars +
+SLO burn counters, flight-recorder heartbeat metadata, per-request
+Chrome-trace tracks, and the end-to-end serving reconciliation.
+
+Everything except the end-to-end test drives the recorder with a fake
+clock — host-only, no engine, tier-1 lean."""
+
+import asyncio
+import json
+import math
+import os
+
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.reqtrace import (ACCESS_LOG_KEYS,
+                                              COMPONENT_KEYS,
+                                              RequestTraceRecorder)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    """Deterministic perf_counter stand-in: advance() moves time."""
+
+    def __init__(self, t0: float = 100.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _drive_one(rec, clock, uid=1, *, queue=0.010, prefill=0.020,
+               first_drain=0.005, gaps=(0.003, 0.004), priority=1):
+    """One full lifecycle with exact, known component times. Each decode
+    gap lands entirely inside its dispatch window (window_start at the
+    previous token) so it is pure decode_active."""
+    rec.enqueue(uid, priority=priority, prompt_tokens=5, max_new_tokens=8)
+    clock.advance(queue)
+    rec.admitted(uid, queue_depth=3, cached_tokens=8, cached_blocks=1)
+    clock.advance(prefill)
+    rec.prefill_done([uid])
+    rec.dispatched([uid], 1, k=4)
+    clock.advance(first_drain)
+    rec.tokens_landed(uid, 1)                     # first token (no window)
+    for gap in gaps:
+        start = clock.t
+        clock.advance(gap)
+        rec.tokens_landed(uid, 1, window_start=start, steps=1)
+    rec.finished(uid, "completed")
+
+
+# ---------------------------------------------------------------------
+# decomposition reconciliation (the tentpole invariant)
+# ---------------------------------------------------------------------
+
+def test_ttft_decomposition_telescopes_exactly():
+    """TTFT = queue_wait + prefill + first_drain and
+    total - ttft = decode_active + boundary_gap + preempt_stall, both
+    EXACT (telescoping timestamps, not sampled estimates)."""
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    _drive_one(rec, clock, uid=1, queue=0.010, prefill=0.020,
+               first_drain=0.005, gaps=(0.003, 0.004))
+    (tr,) = rec.completed()
+    assert tr.ttft_s == pytest.approx(0.035, abs=1e-12)
+    assert tr.queue_wait_s == pytest.approx(0.010, abs=1e-12)
+    assert tr.prefill_s == pytest.approx(0.020, abs=1e-12)
+    assert tr.first_drain_s == pytest.approx(0.005, abs=1e-12)
+    comp = tr.components()
+    assert sum(comp[k] for k in ("queue_wait", "prefill", "first_drain")) \
+        == pytest.approx(tr.ttft_s, abs=1e-12)
+    total = tr.t_finish - tr.t_enqueue
+    assert sum(comp[k] for k in ("decode_active", "boundary_gap",
+                                 "preempt_stall")) \
+        == pytest.approx(total - tr.ttft_s, abs=1e-12)
+    # the gaps above were fully inside their windows -> pure active
+    assert tr.decode_active_s == pytest.approx(0.007, abs=1e-12)
+    assert tr.boundary_gap_s == pytest.approx(0.0, abs=1e-12)
+
+
+def test_boundary_gap_vs_decode_active_split():
+    """Time before the dispatch window opened is a chain-boundary gap
+    (host doing other requests' admission), time inside is active."""
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    rec.enqueue(1)
+    rec.admitted(1)
+    rec.prefill_done([1])
+    rec.tokens_landed(1, 1)
+    clock.advance(0.006)                  # host busy elsewhere: boundary
+    win = clock.t
+    clock.advance(0.004)                  # inside the chain window
+    rec.tokens_landed(1, 2, window_start=win, steps=2)
+    rec.finished(1, "completed")
+    (tr,) = rec.completed()
+    assert tr.boundary_gap_s == pytest.approx(0.006, abs=1e-12)
+    assert tr.decode_active_s == pytest.approx(0.004, abs=1e-12)
+
+
+def test_preempt_stall_attribution_and_parked_finish():
+    """Park -> restore: the whole gap up to the first post-restore token
+    is preempt_stall (the client-visible price). Finishing while parked
+    closes the stall into the decomposition too."""
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    rec.enqueue(1)
+    rec.admitted(1)
+    rec.prefill_done([1])
+    rec.tokens_landed(1, 1)
+    clock.advance(0.002)
+    rec.parked(1)
+    clock.advance(0.050)                  # parked the whole time
+    rec.tokens_landed(1, 1, window_start=clock.t, steps=1)
+    rec.finished(1, "completed")
+    (tr,) = rec.completed()
+    assert tr.preemptions == 1
+    assert tr.preempt_stall_s == pytest.approx(0.050, abs=1e-12)
+    assert tr.boundary_gap_s == pytest.approx(0.002, abs=1e-12)
+    assert len(tr.parks) == 1
+
+    # cancel while parked: stall closes at finish, decomposition intact
+    rec.enqueue(2)
+    rec.admitted(2)
+    rec.prefill_done([2])
+    rec.tokens_landed(2, 1)
+    rec.parked(2)
+    clock.advance(0.030)
+    rec.finished(2, "cancelled")
+    tr2 = rec.completed()[-1]
+    assert tr2.outcome == "cancelled"
+    assert tr2.preempt_stall_s == pytest.approx(0.030, abs=1e-12)
+    total = tr2.t_finish - tr2.t_enqueue
+    assert sum(tr2.components().values()) == pytest.approx(total, abs=1e-12)
+
+
+def test_enqueue_is_idempotent_per_inflight_uid():
+    """The async server records the true submit time; the serve loop's
+    own submit() for the same uid must not reset it."""
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    tid = rec.enqueue(5, priority=0, prompt_tokens=3, max_new_tokens=9)
+    clock.advance(0.5)
+    assert rec.enqueue(5, priority=2) == tid     # no-op, same trace
+    rec.admitted(5)
+    rec.prefill_done([5])
+    rec.tokens_landed(5, 1)
+    rec.finished(5)
+    (tr,) = rec.completed()
+    assert tr.priority == 0 and tr.queue_wait_s >= 0.5
+
+
+# ---------------------------------------------------------------------
+# access log
+# ---------------------------------------------------------------------
+
+def test_access_log_schema_and_jsonl(tmp_path):
+    """One JSONL line per completed request carrying exactly
+    ACCESS_LOG_KEYS, components in ms, telescoping preserved."""
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    _drive_one(rec, clock, uid=1)
+    _drive_one(rec, clock, uid=2, priority=0)
+    path = rec.write_access_log(str(tmp_path / "access.jsonl"))
+    rows = [json.loads(ln) for ln in open(path)]
+    assert len(rows) == 2
+    for row in rows:
+        assert tuple(sorted(row)) == tuple(sorted(ACCESS_LOG_KEYS))
+        assert row["outcome"] == "completed" and row["error"] is None
+        assert row["output_tokens"] == 3 and row["dispatches"] == 1
+        assert (row["queue_wait_ms"] + row["prefill_ms"]
+                + row["first_drain_ms"]) == pytest.approx(
+            row["ttft_ms"], rel=1e-6)
+        assert (row["decode_active_ms"] + row["boundary_gap_ms"]
+                + row["preempt_stall_ms"]) == pytest.approx(
+            row["total_ms"] - row["ttft_ms"], abs=2e-3)  # ms rounding
+    assert rows[1]["priority"] == 0
+    # nothing completed -> no file
+    assert RequestTraceRecorder().write_access_log(
+        str(tmp_path / "empty.jsonl")) is None
+
+
+# ---------------------------------------------------------------------
+# percentiles + tail attribution
+# ---------------------------------------------------------------------
+
+def test_component_percentiles_and_ttft_attribution():
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    # 9 fast requests queue-dominated at ~2ms, one tail request whose
+    # TTFT is prefill-dominated
+    for uid in range(9):
+        _drive_one(rec, clock, uid=uid, queue=0.002, prefill=0.001,
+                   first_drain=0.0005)
+    _drive_one(rec, clock, uid=99, queue=0.001, prefill=0.200,
+               first_drain=0.001)
+    pcts = rec.component_percentiles()
+    assert set(pcts) == set(COMPONENT_KEYS)
+    assert pcts["queue_wait"]["n"] == 10
+    assert pcts["prefill"]["p50"] == pytest.approx(0.001, abs=1e-9)
+    assert pcts["prefill"]["p99"] == pytest.approx(0.200, abs=1e-9)
+    attr = rec.ttft_attribution()
+    assert attr["dominant_component"] == "prefill"
+    assert attr["tail_requests"] >= 1
+    assert attr["ttft_p99_s"] == pytest.approx(0.202, abs=1e-6)
+
+    # percentile gauges land in the registry at collect()
+    reg = MetricsRegistry()
+    rec.collect(reg)
+    g = reg.gauge("ds_serving_component_p99_seconds")
+    assert g.value(component="prefill") == pytest.approx(0.200, abs=1e-9)
+
+
+# ---------------------------------------------------------------------
+# registry export: exemplars + SLO burn
+# ---------------------------------------------------------------------
+
+def test_exemplars_link_buckets_to_trace_ids():
+    """A histogram bucket carries the most recent trace id observed into
+    it, and the Prometheus text emits OpenMetrics exemplar syntax."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    rec = RequestTraceRecorder(registry=reg, clock=clock)
+    _drive_one(rec, clock, uid=1, queue=0.010)
+    (tr,) = rec.completed()
+    h = reg.histogram("ds_serving_request_ttft_seconds")
+    exs = h.exemplars()
+    assert exs, "no exemplar recorded"
+    (ub, (trace_id, value)), = [next(iter(exs.items()))]
+    assert trace_id == tr.trace_id
+    assert value == pytest.approx(tr.ttft_s, abs=1e-9)
+    assert value <= ub
+    text = reg.prometheus_text()
+    assert f'# {{trace_id="{tr.trace_id}"}}' in text
+    # exemplars are an OpenMetrics extension: strict 0.0.4 output
+    # drops them, and the in-repo parser strips the suffix so the
+    # bucket COUNT (not the exemplar value) is the series value
+    assert "# {" not in reg.prometheus_text(exemplars=False)
+    import tempfile
+    sys_tools = os.path.join(REPO, "tools")
+    import sys
+    sys.path.insert(0, sys_tools)
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "m.prom")
+        open(p, "w").write(text)
+        parsed = telemetry_report.parse_prometheus(p)
+    ex_buckets = [v for k, v in parsed.items()
+                  if k.startswith("ds_serving_request_ttft_seconds_bucket")]
+    assert ex_buckets and all(float(v).is_integer() for v in ex_buckets)
+    assert not any("# {" in k for k in parsed)
+    # component histogram carries per-component exemplars too
+    comp = reg.histogram("ds_serving_component_seconds")
+    assert comp.exemplars(component="queue_wait")
+
+
+def test_slo_burn_counters_against_targets():
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    rec = RequestTraceRecorder(registry=reg, clock=clock)
+    rec.set_slo(0.030, 0.010)            # TTFT 30ms, mean ITL 10ms
+    # breaches both: TTFT 35ms, ITL 20ms
+    _drive_one(rec, clock, uid=1, queue=0.010, prefill=0.020,
+               first_drain=0.005, gaps=(0.020, 0.020))
+    # breaches neither
+    _drive_one(rec, clock, uid=2, queue=0.001, prefill=0.001,
+               first_drain=0.001, gaps=(0.001, 0.001))
+    assert reg.counter("ds_serving_slo_ttft_breaches_total").value() == 1
+    assert reg.counter("ds_serving_slo_itl_breaches_total").value() == 1
+    assert reg.counter("ds_serving_requests_total").value(
+        outcome="completed") == 2
+
+
+# ---------------------------------------------------------------------
+# in-flight visibility (flight recorder / hang watchdog)
+# ---------------------------------------------------------------------
+
+def test_in_flight_and_heartbeat_meta():
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    rec.enqueue(1, priority=2)
+    clock.advance(1.0)
+    rec.enqueue(2, priority=0)
+    rec.admitted(2)
+    rec.parked(2)
+    clock.advance(0.5)
+    rows = {r["uid"]: r for r in rec.in_flight()}
+    assert rows[1]["state"] == "queued"
+    assert rows[1]["age_s"] == pytest.approx(1.5, abs=1e-9)
+    assert rows[2]["state"] == "parked"
+    meta = rec.heartbeat_meta(cap=1)
+    assert meta["inflight"] == 2
+    assert meta["oldest_uid"] == 1 and meta["uids"] == [1]
+    rec.finished(1, "cancelled")
+    rec.finished(2, "cancelled")
+    assert rec.heartbeat_meta() == {"inflight": 0}
+
+
+def test_hang_dump_names_in_flight_requests(tmp_path):
+    """The watchdog/bench dump artifact carries the stuck requests."""
+    from deepspeed_tpu.telemetry.flightrec import dump_state
+    clock = FakeClock()
+    rec = RequestTraceRecorder(clock=clock)
+    rec.enqueue(42, priority=1, prompt_tokens=7)
+    clock.advance(2.0)
+    path = dump_state("test_stall", str(tmp_path), reqtrace=rec)
+    doc = json.load(open(path))
+    (row,) = doc["in_flight_requests"]
+    assert row["uid"] == 42 and row["age_s"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------
+# Chrome-trace request tracks
+# ---------------------------------------------------------------------
+
+def test_chrome_events_per_request_tracks():
+    clock = FakeClock(t0=100.0)
+    rec = RequestTraceRecorder(clock=clock)
+    _drive_one(rec, clock, uid=1, gaps=(0.003,))
+    epoch_ns = int(100.0 * 1e9)          # same origin as the fake clock
+    events = rec.chrome_events(pid=7, epoch_ns=epoch_ns)
+    (tr,) = rec.completed()
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and tr.trace_id in meta[0]["args"]["name"]
+    slices = {e["name"]: e for e in events if e["ph"] == "X"}
+    for name in ("req/queue_wait", "req/prefill", "req/first_drain",
+                 "req/decode"):
+        assert name in slices, name
+        assert slices[name]["pid"] == 7
+        assert slices[name]["args"]["trace_id"] == tr.trace_id
+    assert slices["req/queue_wait"]["ts"] == pytest.approx(0.0, abs=1e-3)
+    assert slices["req/queue_wait"]["dur"] == pytest.approx(1e4, rel=1e-6)
+    # phases tile the lifetime: each slice starts where the last ended
+    assert slices["req/prefill"]["ts"] == pytest.approx(
+        slices["req/queue_wait"]["ts"] + slices["req/queue_wait"]["dur"],
+        abs=1e-3)
+
+
+# ---------------------------------------------------------------------
+# recorder bounds + lifecycle
+# ---------------------------------------------------------------------
+
+def test_completed_ring_capacity_and_clear():
+    clock = FakeClock()
+    rec = RequestTraceRecorder(capacity=8, clock=clock)
+    for uid in range(20):
+        rec.enqueue(uid)
+        rec.finished(uid, "completed")
+    assert len(rec.completed()) == 8
+    assert rec.completed()[0].uid == 12          # oldest dropped
+    rec.clear()
+    assert rec.completed() == [] and rec.in_flight() == []
+
+
+def test_configure_wires_recorder_and_opt_out():
+    """telemetry.configure() wires a registry-backed recorder by
+    default; request_traces=False opts out; shutdown unwires."""
+    try:
+        telemetry.configure(request_trace_size=16)
+        rec = telemetry.get_request_recorder()
+        assert rec is not None and rec.capacity == 16
+        assert rec._registry is telemetry.get_registry()
+        telemetry.shutdown()
+        assert telemetry.get_request_recorder() is None
+        telemetry.configure(request_traces=False)
+        assert telemetry.get_request_recorder() is None
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------
+# end-to-end: a real serving run reconciles (engine-heavy -> slow tier)
+# ---------------------------------------------------------------------
+
+def test_server_traces_reconcile_end_to_end(devices8, tmp_path):
+    """Acceptance: drive the async server with telemetry on — one
+    access-log line per completed request, every line's TTFT component
+    sum within 5% of its measured TTFT (exactly, in fact: telescoping
+    timestamps), per-request tracks in the Chrome trace, and the tail
+    attribution names a dominant component."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.serving import AsyncInferenceServer, ServingConfig
+
+    try:
+        telemetry.configure()
+        e = InferenceEngineV2(
+            Llama(size="tiny"),
+            RaggedInferenceEngineConfig(dtype="float32", kv_block_size=8,
+                                        num_kv_blocks=128,
+                                        max_chunk_size=16))
+        prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [6, 7, 8, 9, 10, 11]]
+
+        async def main():
+            cfg = ServingConfig(k_steps=3, slo_ttft_ms=0.001)
+            async with AsyncInferenceServer(e, cfg) as s:
+                hs = [await s.submit(p, max_new_tokens=8) for p in prompts]
+                outs = [await h.tokens() for h in hs]
+                return outs, [h.trace_id for h in hs]
+
+        outs, trace_ids = asyncio.run(main())
+        assert all(len(o) == 8 for o in outs)
+        assert all(t for t in trace_ids)
+
+        rec = telemetry.get_request_recorder()
+        done = rec.completed()
+        assert len(done) == len(prompts)
+        for tr in done:
+            comp = tr.components()
+            ttft_sum = (comp["queue_wait"] + comp["prefill"]
+                        + comp["first_drain"])
+            assert ttft_sum == pytest.approx(tr.ttft_s, rel=0.05), \
+                (tr.trace_id, comp, tr.ttft_s)
+            total = tr.t_finish - tr.t_enqueue
+            assert sum(comp.values()) == pytest.approx(total, rel=0.05)
+            assert tr.tokens == 8 and tr.dispatches >= 1
+            assert tr.outcome == "completed"
+
+        # every real request's TTFT breaches the 1us SLO target
+        reg = telemetry.get_registry()
+        assert reg.counter("ds_serving_slo_ttft_breaches_total").value() \
+            == len(prompts)
+
+        paths = telemetry.export_artifacts(str(tmp_path), prefix="e2e")
+        rows = [json.loads(ln) for ln in open(paths["access_log"])]
+        assert len(rows) == len(prompts)
+        assert {r["trace_id"] for r in rows} == set(trace_ids)
+        doc = json.load(open(paths["trace"]))
+        req_tracks = [ev for ev in doc["traceEvents"]
+                      if ev.get("cat") == "request"]
+        assert len(req_tracks) >= 4 * len(prompts)
+        attr = rec.ttft_attribution()
+        assert attr["dominant_component"] in ("queue_wait", "prefill",
+                                              "first_drain")
+        prom = open(paths["prometheus"]).read()
+        assert "# {trace_id=" in prom
+        assert math.isfinite(
+            reg.gauge("ds_serving_component_p99_seconds").value(
+                component="queue_wait"))
+    finally:
+        telemetry.shutdown()

@@ -269,6 +269,29 @@ def test_serving_regression_gate(tmp_path):
                                "spec_overhead_ms": 39.0}))
     assert tr.main(["--diff", str(ps), str(sok),
                     "--gate", "serving"]) == 0
+    # per-request component breakdown (ISSUE 10): the OVERHEAD
+    # components gate downward at 15%; decode_active scales with
+    # output length and must NOT participate
+    ca = {"queue_wait_p99_ms": 100.0, "boundary_gap_p50_ms": 10.0,
+          "prefill_p99_ms": 50.0, "preempt_stall_p99_ms": 5.0,
+          "decode_active_p99_ms": 200.0}
+    pca = tmp_path / "ca.json"
+    pca.write_text(json.dumps(ca))
+    cbad = tmp_path / "cbad.json"
+    cbad.write_text(json.dumps({**ca, "queue_wait_p99_ms": 130.0,
+                                "prefill_p99_ms": 70.0,
+                                "decode_active_p99_ms": 900.0}))
+    diff3 = tr.diff_snapshots(str(pca), str(cbad), gate="serving")
+    assert {r["metric"] for r in diff3["regressions"]} == {
+        "queue_wait_p99_ms", "prefill_p99_ms"}
+    assert all(r["metric"] != "decode_active_p99_ms"
+               for r in diff3["rows"])
+    # within the 15% component gate (but past the generic 5%): passes
+    cok = tmp_path / "cok.json"
+    cok.write_text(json.dumps({**ca, "boundary_gap_p50_ms": 11.0,
+                               "preempt_stall_p99_ms": 5.5}))
+    assert tr.main(["--diff", str(pca), str(cok),
+                    "--gate", "serving"]) == 0
 
 
 def test_bench_default_invocation_always_exits_zero(devices8):

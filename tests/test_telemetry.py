@@ -98,6 +98,28 @@ def test_prometheus_exposition_format():
     assert snap["ds_lat_seconds"]["values"][0]["count"] == 2
 
 
+def test_prometheus_label_and_help_escaping():
+    """Text-exposition escaping audit (ISSUE 10 satellite): label
+    VALUES escape backslash, quote and newline — backslash FIRST, so
+    escapes aren't re-escaped; HELP text escapes backslash and newline
+    but NOT quotes (quotes are legal in help). Request-derived label
+    values (trace ids, outcomes, error strings) flow through here."""
+    reg = MetricsRegistry()
+    reg.counter("ds_esc_total", 'help with "quotes"\nand \\slash').inc(
+        1, path='C:\\tmp\n"x"')
+    text = reg.prometheus_text()
+    # label value: backslash doubled, quote escaped, newline literalized
+    assert r'path="C:\\tmp\n\"x\""' in text
+    # HELP: backslash + newline escaped, quotes left alone
+    assert '# HELP ds_esc_total help with "quotes"\\nand \\\\slash' in text
+    # the raw newline from the label value must not split the line
+    assert 'C:\\tmp\n' not in text
+    # a backslash-only value stays parseable (escape-the-escapes order)
+    reg2 = MetricsRegistry()
+    reg2.gauge("ds_bs").set(1.0, v="\\")
+    assert 'v="\\\\"' in reg2.prometheus_text()
+
+
 def test_events_for_monitor_flattens_scalars_and_histograms():
     reg = MetricsRegistry()
     reg.gauge("ds_g").set(1.5, k="v")
@@ -510,8 +532,19 @@ e.put([0], [list(range(1, 9))])
 e.state_manager.extend(0, [1])
 e.decode_fused([0], k_steps=2)
 
+# the serving path too (ISSUE 10): the FusedServeLoop + per-request
+# instrumentation must resolve the recorder through the probe, never
+# import it — reqtrace rides the same disabled-mode contract
+from deepspeed_tpu.inference.v2.serve_loop import FusedServeLoop
+loop = FusedServeLoop(e, k_steps=2)
+loop.submit([2, 3, 4], max_new_tokens=4)
+while loop.has_work():
+    loop.step()
+
 assert "deepspeed_tpu.telemetry" not in sys.modules, \
     "telemetry was imported on the disabled path"
+assert "deepspeed_tpu.telemetry.reqtrace" not in sys.modules, \
+    "reqtrace was imported on the disabled path"
 print("GUARD_OK")
 """
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -78,13 +78,18 @@ def span_table(events: list[dict]) -> list[dict]:
 
 
 def parse_prometheus(path: str) -> dict[str, float]:
-    """Flat {series: value} from Prometheus text exposition."""
+    """Flat {series: value} from Prometheus text exposition (the
+    OpenMetrics exemplar suffix serving histogram buckets carry —
+    ``... # {trace_id="..."} v`` — is stripped, keeping the bucket
+    count as the series value)."""
     out: dict[str, float] = {}
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
+            if " # {" in line:
+                line = line.split(" # {", 1)[0].rstrip()
             try:
                 series, value = line.rsplit(None, 1)
                 out[series] = float(value)
@@ -296,6 +301,19 @@ _GATES = {
         ("spec_overhead_ms", -1, 0.10),
         ("acceptance_rate", +1, 0.05),
         ("tokens_per_dispatch", +1, 0.05),
+        # per-request latency decomposition (ISSUE 10): bench
+        # serve_openloop's `<component>_p50/p99_ms` fields (registry
+        # gauge snapshots flatten without the component label, so
+        # only the bench JSON participates). Only the OVERHEAD
+        # components gate (queue wait, prefill, first-drain,
+        # chain-boundary gap, preemption stall); decode_active scales
+        # with tokens generated, so gating it would flag longer
+        # outputs as regressions.
+        ("queue_wait", -1, 0.15),
+        ("first_drain", -1, 0.15),
+        ("boundary_gap", -1, 0.15),
+        ("preempt_stall", -1, 0.15),
+        ("prefill_p", -1, 0.15),
         ("tokens_per_sec", +1, 0.05),
         ("fused_occupancy", +1, 0.05),
     ),
