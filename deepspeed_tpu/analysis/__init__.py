@@ -3,16 +3,23 @@
 Two halves with opposite costs:
 
 - :mod:`.linter` / :mod:`.rules` — pure-``ast`` static analysis
-  (GL001-GL040: host syncs in jit-reachable code, recompile hazards,
-  donation gaps, dtype promotion, telemetry-probe enforcement). Imports
-  only the stdlib; run via ``python tools/graftlint.py`` or the tier-1
-  gate in ``tests/test_graftlint.py``. Catalog: docs/static-analysis.md.
+  (GL001-GL053: host syncs in jit-reachable code, recompile hazards,
+  donation gaps, dtype promotion, telemetry-probe enforcement, and the
+  graftsan thread-domain pass — device calls/blocking off the worker
+  thread, cross-domain races, lock-order inversions). Imports only the
+  stdlib; run via ``python tools/graftlint.py`` or the tier-1 gate in
+  ``tests/test_graftlint.py``. Catalog: docs/static-analysis.md.
 - :mod:`.sentinels` — runtime enforcement on the hot paths the linter
   cannot see into: a recompile sentinel (piggybacking on the telemetry
   bridges' jax.monitoring compile listener) asserting warmed-up steps
   never retrace, and ``jax.transfer_guard``-based hot-path guards wired
   into ``engine.train_batch`` and the v2 fused-decode dispatch/drain.
   Imports jax — keep it out of linter import paths.
+- :mod:`.blocksan` — graftsan runtime sanitizers (ISSUE 11): the KV
+  block-accounting journal with conservation-at-quiesce checks + leak
+  provenance, and the thread-affinity checker. Stdlib-only like the
+  linter; opt-in via ``RaggedInferenceEngineConfig.graftsan`` or env
+  ``DS_GRAFTSAN=1``.
 
 Import note: this ``__init__`` stays jax-free so the CLI lints without
 paying a jax import; reach sentinels via

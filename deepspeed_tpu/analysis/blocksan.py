@@ -1,0 +1,311 @@
+"""graftsan runtime sanitizers (ISSUE 11 tentpole part 2): KV
+block-accounting + thread-affinity enforcement for the serving stack.
+
+Two host-only, stdlib-only checkers, opt-in via
+``RaggedInferenceEngineConfig.graftsan`` (or env ``DS_GRAFTSAN=1``) the
+way the recompile sentinel is:
+
+- :class:`BlockSanitizer` — journals every KV-block accounting
+  mutation (``allocate``/``free``/``incref``/``decref``/LRU
+  park/evict) with CALL-SITE PROVENANCE, asserts refcounts never go
+  negative, blocks are never double-freed or incref'd after free, and
+  — at every quiesce point (``DSStateManager.flush``/``park``, i.e.
+  after each drain/park-restore roundtrip) — checks **pool
+  conservation**: every block is exactly one of *free*, *referenced*
+  or *LRU-cached*. A violated invariant names the leaked blocks AND
+  the stack that allocated them, so the PR 4 cap-path leak class dies
+  with a file:line instead of a slow pool exhaustion. Wired into
+  ``BlockedAllocator``/``PrefixCache``/``DSStateManager`` behind
+  ``sanitizer is not None`` guards — the disabled path is one attribute
+  load per accounting call.
+
+- :class:`ThreadAffinityChecker` — the runtime half of the GL050
+  thread-domain contract: the engine stamps the thread that owns JAX
+  dispatch (the async server re-stamps its worker thread at loop
+  start; closed-loop drivers auto-stamp on first dispatch) and every
+  subsequent dispatch from ANY other thread raises
+  :class:`AffinityError` naming both threads.
+
+Violations also bump ``ds_blocksan_violations_total`` /
+``ds_affinity_violations_total`` in the telemetry registry (guarded
+through the zero-import probe) so ``tools/telemetry_report.py``
+surfaces them, and the active sanitizer's journal tail rides every
+hang-watchdog dump (telemetry/flightrec.py).
+
+This module must stay importable without jax (the linter half of
+``analysis/`` never pays a jax import; neither does this).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from collections import deque
+from typing import Optional
+
+
+class BlockSanError(RuntimeError):
+    """A KV block-accounting invariant was violated."""
+
+
+class AffinityError(RuntimeError):
+    """JAX dispatch attempted from a thread that does not own the
+    engine."""
+
+
+def _count_violation(metric: str, kind: str) -> None:
+    """Bump the sanitizer-violation counter in the telemetry registry
+    when telemetry is active; free (one sys.modules probe) otherwise."""
+    try:
+        from ..utils.telemetry_probe import active_telemetry
+        tel = active_telemetry()
+        reg = tel.get_registry() if tel is not None else None
+        if reg is not None:
+            reg.counter(metric,
+                        "graftsan runtime-sanitizer violations "
+                        "(ISSUE 11; see docs/static-analysis.md)"
+                        ).inc(kind=kind)
+    except Exception:   # noqa: BLE001 — telemetry must never mask the finding
+        pass
+
+
+def _call_site(depth: int = 3) -> str:
+    """``file:line (func)`` chain of the nearest ``depth`` frames
+    outside this module — the provenance attached to every journal
+    entry and allocation."""
+    frames = []
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None and len(frames) < depth:
+        fn = f.f_code.co_filename
+        if fn != here:
+            frames.append(f"{os.path.basename(fn)}:{f.f_lineno} "
+                          f"({f.f_code.co_name})")
+        f = f.f_back
+    return " <- ".join(frames) if frames else "<unknown>"
+
+
+class BlockSanitizer:
+    """See module docstring. One instance audits one
+    :class:`~..inference.v2.ragged.DSStateManager`'s pool; attach via
+    ``DSStateManager.attach_sanitizer``."""
+
+    def __init__(self, num_blocks: int, mode: str = "raise",
+                 journal_size: int = 512):
+        if mode not in ("raise", "warn"):
+            raise ValueError(
+                f"blocksan mode must be raise|warn, got {mode!r}")
+        self.n = int(num_blocks)
+        self.mode = mode
+        self.journal: deque = deque(maxlen=max(int(journal_size), 16))
+        # mirrors, updated by the hooks: catching a missed transition
+        # (mirror drift vs the allocator's own structures) is itself a
+        # conservation failure — it means a free-routing path bypassed
+        # the audited choke point
+        self.ref = [0] * self.n
+        self.freed: set[int] = set(range(self.n))
+        self.alloc_site: dict[int, str] = {}
+        self.counters = {"ops": 0, "violations": 0, "quiesce_checks": 0}
+        self.violation_log: list[str] = []
+
+    # -- plumbing ------------------------------------------------------
+    def _journal(self, op: str, blocks, site: str) -> None:
+        self.counters["ops"] += 1
+        self.journal.append((op, tuple(int(b) for b in blocks), site))
+
+    def _fail(self, msg: str, kind: str) -> None:
+        self.counters["violations"] += 1
+        self.violation_log.append(msg)
+        _count_violation("ds_blocksan_violations_total", kind)
+        if self.mode == "raise":
+            raise BlockSanError(f"blocksan: {msg}")
+        from ..utils.logging import logger
+        logger.warning(f"blocksan: {msg}")
+
+    def _provenance(self, block: int) -> str:
+        return self.alloc_site.get(block, "<pre-sanitizer allocation>")
+
+    # -- hooks (called by BlockedAllocator / PrefixCache) --------------
+    def on_allocate(self, blocks) -> None:
+        site = _call_site()
+        self._journal("allocate", blocks, site)
+        for b in blocks:
+            if b not in self.freed:
+                self._fail(f"allocate: block {b} handed out while not "
+                           f"on the free list (previous owner: "
+                           f"{self._provenance(b)}; at {site})",
+                           "bad-allocate")
+            self.freed.discard(b)
+            self.ref[b] = 1
+            self.alloc_site[b] = site
+
+    def on_free(self, blocks) -> None:
+        site = _call_site()
+        self._journal("free", blocks, site)
+        for b in blocks:
+            if b in self.freed:
+                self._fail(f"double-free: block {b} freed at {site} "
+                           f"but already on the free list (allocated "
+                           f"at {self._provenance(b)})", "double-free")
+                continue
+            self.freed.add(b)
+            self.ref[b] = 0
+
+    def on_incref(self, blocks) -> None:
+        site = _call_site()
+        self._journal("incref", blocks, site)
+        for b in blocks:
+            if b in self.freed:
+                self._fail(f"use-after-free: incref of freed block {b} "
+                           f"at {site} (allocated at "
+                           f"{self._provenance(b)})", "use-after-free")
+            self.ref[b] += 1
+
+    def on_decref(self, blocks) -> None:
+        site = _call_site()
+        self._journal("decref", blocks, site)
+        for b in blocks:
+            if self.ref[b] <= 0:
+                self._fail(f"negative refcount: decref of block {b} at "
+                           f"refcount {self.ref[b]} ({site}; allocated "
+                           f"at {self._provenance(b)})",
+                           "negative-refcount")
+            self.ref[b] = max(0, self.ref[b] - 1)
+
+    def on_cache_park(self, block: int) -> None:
+        site = _call_site()
+        self._journal("lru_park", (block,), site)
+        if block in self.freed:
+            self._fail(f"LRU park of freed block {block} at {site}",
+                       "lru-park")
+        elif self.ref[block] != 0:
+            self._fail(f"LRU park of block {block} with refcount "
+                       f"{self.ref[block]} at {site} — only "
+                       "unreferenced blocks may park", "lru-park")
+
+    def on_cache_evict(self, block: int) -> None:
+        self._journal("lru_evict", (block,), _call_site())
+
+    # -- quiesce-point conservation ------------------------------------
+    def check_conservation(self, allocator, cache, label: str) -> None:
+        """Pool conservation at a quiesce point: free + referenced +
+        LRU-cached must partition the pool exactly. Derived from the
+        LIVE allocator/cache structures (the mirrors only supply
+        provenance), so a leak is caught even if a hook was bypassed."""
+        self.counters["quiesce_checks"] += 1
+        free = set(allocator._free)
+        referenced = {b for b in range(self.n) if allocator._ref[b] > 0}
+        lru = set(cache.lru) if cache is not None else set()
+        problems = []
+        for name_a, set_a, name_b, set_b in (
+                ("free", free, "referenced", referenced),
+                ("free", free, "LRU-cached", lru),
+                ("referenced", referenced, "LRU-cached", lru)):
+            both = set_a & set_b
+            if both:
+                problems.append(
+                    f"blocks {sorted(both)} are {name_a} AND {name_b}")
+        leaked = set(range(self.n)) - free - referenced - lru
+        if leaked:
+            sites = "; ".join(
+                f"block {b} allocated at {self._provenance(b)}"
+                for b in sorted(leaked))
+            problems.append(
+                f"{len(leaked)} block(s) leaked — on no list and "
+                f"referenced by nothing: {sites}")
+        if self.freed != free:
+            drift = self.freed.symmetric_difference(free)
+            problems.append(
+                f"journal missed a free-list transition on blocks "
+                f"{sorted(drift)} (a free-routing path bypassed the "
+                "audited choke point)")
+        if problems:
+            self._fail(f"conservation at quiesce point '{label}': "
+                       + " | ".join(problems), "conservation")
+
+    # -- reporting -----------------------------------------------------
+    def journal_tail(self, n: int = 64) -> list[dict]:
+        return [{"op": op, "blocks": list(blocks), "site": site}
+                for op, blocks, site in list(self.journal)[-n:]]
+
+    def snapshot(self) -> dict:
+        """Hang-dump / forensics view (telemetry/flightrec.py embeds
+        this in every watchdog dump while a sanitizer is active)."""
+        return {"pool_size": self.n,
+                "mode": self.mode,
+                "counters": dict(self.counters),
+                "violations": list(self.violation_log[-16:]),
+                "journal_tail": self.journal_tail()}
+
+
+class ThreadAffinityChecker:
+    """See module docstring. ``bind()`` stamps the calling thread as
+    the engine owner (``force=True`` re-stamps — the async server does
+    this at worker start, since engine warmup may have auto-bound the
+    constructing thread); ``check()`` auto-binds on first dispatch and
+    raises :class:`AffinityError` from any other thread afterwards."""
+
+    def __init__(self, mode: str = "raise"):
+        if mode not in ("raise", "warn"):
+            raise ValueError(
+                f"affinity mode must be raise|warn, got {mode!r}")
+        self.mode = mode
+        self.violations = 0
+        self._tid: Optional[int] = None
+        self._tname = ""
+
+    def bind(self, force: bool = False) -> None:
+        if self._tid is None or force:
+            t = threading.current_thread()
+            self._tid, self._tname = t.ident, t.name
+
+    def unbind(self) -> None:
+        """Release ownership (server shutdown) so a later closed-loop
+        driver on another thread can re-stamp instead of raising."""
+        self._tid = None
+        self._tname = ""
+
+    def check(self, label: str) -> None:
+        if self._tid is None:
+            self.bind()
+            return
+        t = threading.current_thread()
+        if t.ident == self._tid:
+            return
+        self.violations += 1
+        _count_violation("ds_affinity_violations_total", label)
+        msg = (f"graftsan thread-affinity: {label} dispatched from "
+               f"thread '{t.name}' ({t.ident}) but the engine is owned "
+               f"by '{self._tname}' ({self._tid}) — every JAX call "
+               "must run on the worker thread (marshal through the "
+               "serving mailbox, or bind(force=True) on a deliberate "
+               "ownership transfer)")
+        if self.mode == "raise":
+            raise AffinityError(msg)
+        from ..utils.logging import logger
+        logger.warning(msg)
+
+
+# --- process-wide handle for forensics (hang dumps) -----------------------
+# Engines register their sanitizer here so the hang watchdog can embed
+# the journal tail without holding an engine reference; last-enabled
+# wins, which is exact for the one-engine serving processes this is for.
+
+_SAN: Optional[BlockSanitizer] = None
+
+
+def get_blocksan() -> Optional[BlockSanitizer]:
+    return _SAN
+
+
+def set_blocksan(san: Optional[BlockSanitizer]) -> None:
+    global _SAN
+    _SAN = san
+
+
+def env_enabled() -> bool:
+    """The ``DS_GRAFTSAN=1`` env knob (conftest/CI opt-in): truthy
+    values enable the runtime sanitizers even when the config block
+    leaves them off."""
+    return os.environ.get("DS_GRAFTSAN", "") not in ("", "0")

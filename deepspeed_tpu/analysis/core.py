@@ -33,6 +33,34 @@ Suppression syntax (same line or the line directly above)::
 File-level, in the first ten lines::
 
     # graftlint: disable-file=GL020
+
+**Concurrency domains (ISSUE 11, graftsan)**: every function may carry a
+set of *thread domains* — which kind of thread its body runs on —
+consumed by the GL050-GL053 rules in :mod:`.rules.concurrency`:
+
+- ``worker``: the engine-owning thread (the only one allowed to touch
+  JAX; the async server's ``_work`` loop, or the main thread in
+  closed-loop drivers);
+- ``asyncio``: the event loop — must never device-call or block;
+- ``daemon``: background watchers (watchdog, flight-recorder pollers) —
+  may sleep, must not own device work;
+- ``any``: author-audited as safe from every thread; exempt from the
+  domain rules (use sparingly, it is a declaration, not an inference).
+
+Domains are seeded from declarative annotations on the ``def`` line (or
+the line directly above)::
+
+    def _work(self):   # graftsan: domain=worker
+
+``async def`` functions are seeded ``asyncio`` automatically. Seeds
+propagate along the same call-graph machinery jit-reachability uses:
+lexically nested functions inherit (unless annotated, or handed to a
+domain-transfer call — ``loop.call_soon_threadsafe(cb)`` pins ``cb`` to
+``asyncio`` regardless of where it is defined), and ``f()`` /
+``self.m()`` calls push the caller's domains onto the callee. Across
+modules, pass 1 of the lint run exports the names each annotated/async
+function calls but does not define (one propagation hop — the same
+name-based scheme ``traced_names`` uses).
 """
 
 from __future__ import annotations
@@ -79,6 +107,21 @@ _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?!-file)(?:=([A-Z0-9, ]+))?")
 _SUPPRESS_FILE_RE = re.compile(
     r"#\s*graftlint:\s*disable-file=([A-Z0-9, ]+)")
+
+# thread-domain annotation (ISSUE 11): see module docstring
+_DOMAIN_RE = re.compile(r"#\s*graftsan:\s*domain=([a-z_]+)")
+
+# the domain vocabulary; unknown names in an annotation are ignored so
+# a typo degrades to "no domain" (no false findings) instead of crashing
+DOMAINS = frozenset({"worker", "asyncio", "daemon", "any"})
+
+# callables that move a function REFERENCE onto a known domain: the
+# async server hands worker-side closures to the event loop this way
+DOMAIN_TRANSFER = {
+    "call_soon_threadsafe": "asyncio",
+    "call_soon": "asyncio",
+    "run_coroutine_threadsafe": "asyncio",
+}
 
 
 def _comment_lines(source: str):
@@ -133,6 +176,50 @@ class Suppressions:
                 if rules is None or rule in rules:
                     return True
         return False
+
+
+def _domain_annotations(source: str) -> dict[int, str]:
+    """lineno -> domain for every ``# graftsan: domain=<d>`` COMMENT
+    (string/docstring occurrences don't count, same as suppressions).
+    Unknown domain names are ignored — a typo degrades to "no domain"
+    rather than crashing the lint run."""
+    out: dict[int, str] = {}
+    for i, comment in _comment_lines(source):
+        if "graftsan" not in comment:
+            continue
+        m = _DOMAIN_RE.search(comment)
+        if m and m.group(1) in DOMAINS:
+            out[i] = m.group(1)
+    return out
+
+
+def _def_sig_lines(node: ast.AST) -> range:
+    """Line span of a def's signature: the ``def`` line through the
+    line before the first body statement — a multi-line signature puts
+    the annotation comment wherever it fits, commonly the
+    closing-paren line."""
+    lineno = getattr(node, "lineno", 0)
+    body = getattr(node, "body", None)
+    end = body[0].lineno - 1 if isinstance(body, list) and body else lineno
+    return range(lineno, max(lineno, end) + 1)
+
+
+def _domain_for_def(ann: dict[int, str], sig_lines: set,
+                    node: ast.AST) -> Optional[str]:
+    """Annotation applying to a def: any line of its signature span
+    (see :func:`_def_sig_lines`), or the line directly above the
+    ``def`` — UNLESS that line belongs to some def's signature (an
+    annotation on ``def _work(): # graftsan: domain=worker``, incl. a
+    multi-line signature's closing line, must not leak onto a nested
+    def starting on the very next line)."""
+    for ln in _def_sig_lines(node):
+        d = ann.get(ln)
+        if d is not None:
+            return d
+    prev = getattr(node, "lineno", 0) - 1
+    if prev in sig_lines:
+        return None
+    return ann.get(prev)
 
 
 # --------------------------------------------------------------------
@@ -273,6 +360,40 @@ def collect_traced_names(tree: ast.AST) -> set[str]:
     return names - local_defs
 
 
+_BUILTIN_NAMES = frozenset(dir(__import__("builtins")))
+
+
+def collect_domain_exports(tree: ast.AST, source: str) -> dict[str, set]:
+    """Pass-1 API for the driver (ISSUE 11): ONE cross-module
+    propagation hop for thread domains. For every function this module
+    seeds a domain on (explicit ``# graftsan: domain=`` annotation, or
+    ``async def``), export the names its body CALLS that the module does
+    not define itself, tagged with the caller's domain — the same
+    local-defs-subtracted scheme :func:`collect_traced_names` uses, so a
+    common local helper name cannot poison same-named defs across the
+    package. ``any`` seeds export nothing (it is an exemption, not a
+    constraint)."""
+    ann = _domain_annotations(source)
+    defs = [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    local_defs = {n.name for n in defs}
+    sig_lines = {ln for n in defs for ln in _def_sig_lines(n)}
+    out: dict[str, set] = {}
+    for node in defs:
+        dom = _domain_for_def(ann, sig_lines, node)
+        if dom is None and isinstance(node, ast.AsyncFunctionDef):
+            dom = "asyncio"
+        if dom is None or dom == "any":
+            continue
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call) \
+                    and isinstance(call.func, ast.Name) \
+                    and call.func.id not in local_defs \
+                    and call.func.id not in _BUILTIN_NAMES:
+                out.setdefault(call.func.id, set()).add(dom)
+    return out
+
+
 # --------------------------------------------------------------------
 # per-module analysis
 # --------------------------------------------------------------------
@@ -286,6 +407,13 @@ class FuncInfo:
     is_root: bool = False               # directly handed to a trace wrapper
     reachable: bool = False             # body may run under trace
     traced: set[str] = field(default_factory=set)   # device-valued locals
+    # thread domains (ISSUE 11): which kind of thread may run this body.
+    # Empty = unknown (no seed reaches it) — the concurrency rules stay
+    # quiet there. ``domain_pinned`` marks an explicit annotation or a
+    # domain-transfer site: the author's declaration wins, propagation
+    # must not accumulate onto it.
+    domains: set[str] = field(default_factory=set)
+    domain_pinned: bool = False
 
 
 class ModuleIndex:
@@ -294,16 +422,23 @@ class ModuleIndex:
     ``external_traced_names``: function names known (from the whole lint
     run's pass 1) to be traced somewhere — how cross-module jit sites
     (engine_v2 jitting paged.fused_decode_loop) mark defs here.
+
+    ``external_domains``: ``{function name: {domains}}`` from pass 1's
+    :func:`collect_domain_exports` over the whole run — how a domain
+    annotated in one module reaches the functions it calls in another.
     """
 
     def __init__(self, path: str, source: str,
-                 external_traced_names: Optional[set[str]] = None):
+                 external_traced_names: Optional[set[str]] = None,
+                 external_domains: Optional[dict] = None):
         self.path = path
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self.suppressions = Suppressions(source)
         self._external = external_traced_names or set()
+        self._external_domains = external_domains or {}
+        self._domain_by_line = _domain_annotations(source)
         self._parents: dict[ast.AST, ast.AST] = {}
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
@@ -316,6 +451,7 @@ class ModuleIndex:
         for info in self.functions.values():
             if info.reachable:
                 info.traced = self._infer_traced_locals(info)
+        self._assign_domains()
 
     # -- structure -------------------------------------------------
     def _build_functions(self) -> None:
@@ -344,6 +480,17 @@ class ModuleIndex:
     def enclosing_info(self, node: ast.AST) -> Optional[FuncInfo]:
         enc = self.enclosing_function(node)
         return self.functions.get(enc) if enc is not None else None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        """Nearest enclosing ClassDef (crossing intermediate function
+        scopes: a closure nested in a method still belongs to the class
+        whose ``self`` it closes over)."""
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self._parents.get(cur)
+        return None
 
     def in_loop(self, node: ast.AST) -> bool:
         """Node sits inside a for/while loop or comprehension within its
@@ -507,6 +654,92 @@ class ModuleIndex:
                     continue
                 return True
         return False
+
+    # -- thread domains (ISSUE 11) ---------------------------------
+    def _assign_domains(self) -> None:
+        """Seed + propagate thread domains (see module docstring):
+        explicit annotations pin; ``async def`` seeds ``asyncio``;
+        pass-1 cross-module exports seed by name; references handed to
+        a domain-transfer call (``call_soon_threadsafe``) pin to the
+        transfer's domain; then a fixpoint pushes domains to lexically
+        nested defs and to callees resolved by bare name or
+        ``self.m()``/``cls.m()`` within the same class."""
+        sig_lines = {ln for i in self.functions.values()
+                     for ln in _def_sig_lines(i.node)}
+        for info in self.functions.values():
+            node = info.node
+            dom = _domain_for_def(self._domain_by_line, sig_lines, node)
+            if dom is not None:
+                info.domains = {dom}
+                info.domain_pinned = True
+            elif isinstance(node, ast.AsyncFunctionDef):
+                info.domains = {"asyncio"}
+            elif info.name in self._external_domains:
+                info.domains = set(self._external_domains[info.name])
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            dom = DOMAIN_TRANSFER.get(chain[-1]) if chain else None
+            if dom is None:
+                continue
+            targets: list[FuncInfo] = []
+            for a in node.args:
+                if isinstance(a, ast.Name):
+                    targets.extend(self._resolve_name_at(node, a.id))
+                elif isinstance(a, ast.Lambda) and a in self.functions:
+                    targets.append(self.functions[a])
+            for t in targets:
+                if not t.domain_pinned:
+                    t.domains = {dom}
+                    t.domain_pinned = True
+
+        def absorb(dst: FuncInfo, doms: set[str]) -> bool:
+            if dst.domain_pinned:
+                return False
+            new = doms - dst.domains
+            if new:
+                dst.domains |= new
+                return True
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for info in self.functions.values():
+                doms = info.domains - {"any"}
+                if not doms:
+                    continue
+                for child in self.functions.values():
+                    if child.parent is info:
+                        changed |= absorb(child, doms)
+                for node in ast.walk(info.node):
+                    if not isinstance(node, ast.Call) \
+                            or self.enclosing_function(node) \
+                            is not info.node:
+                        continue
+                    callees: list[FuncInfo] = []
+                    if isinstance(node.func, ast.Name):
+                        callees = self._resolve_name_at(node,
+                                                        node.func.id)
+                    elif isinstance(node.func, ast.Attribute) \
+                            and isinstance(node.func.value, ast.Name) \
+                            and node.func.value.id in ("self", "cls"):
+                        cls = self.enclosing_class(info.node)
+                        if cls is not None:
+                            callees = [
+                                c for c in self._by_name.get(
+                                    node.func.attr, [])
+                                if self.enclosing_class(c.node) is cls]
+                    for c in callees:
+                        changed |= absorb(c, doms)
+
+    def domain_functions(self, *domains: str) -> list[FuncInfo]:
+        """Functions whose domain set intersects ``domains`` and that
+        are not declared ``any`` (author-audited exemption)."""
+        want = set(domains)
+        return [i for i in self.functions.values()
+                if i.domains & want and "any" not in i.domains]
 
     def traced_union(self, info: "FuncInfo") -> set[str]:
         """Traced locals visible in ``info``: its own plus every

@@ -27,7 +27,8 @@ from collections import Counter
 from dataclasses import dataclass, field
 from typing import Iterable, Optional, Sequence
 
-from .core import Context, Finding, ModuleIndex, collect_traced_names
+from .core import (Context, Finding, ModuleIndex, collect_domain_exports,
+                   collect_traced_names)
 from .rules import ALL_RULES, RULES_BY_ID
 
 BASELINE_DEFAULT = ".graftlint-baseline.json"
@@ -98,9 +99,12 @@ def lint_paths(paths: Sequence[str], *,
     files = list(iter_python_files(paths))
     result = LintResult(files=len(files))
 
-    # pass 1: global traced-name registry
+    # pass 1: global traced-name registry + cross-module thread-domain
+    # exports (ISSUE 11: one propagation hop — names called from
+    # annotated/async functions carry the caller's domain package-wide)
     sources: dict[str, str] = {}
     traced_names: set[str] = set()
+    domain_exports: dict[str, set] = {}
     for path in files:
         try:
             with open(path, encoding="utf-8") as f:
@@ -112,7 +116,11 @@ def lint_paths(paths: Sequence[str], *,
             continue
         try:
             import ast
-            traced_names |= collect_traced_names(ast.parse(sources[path]))
+            tree = ast.parse(sources[path])
+            traced_names |= collect_traced_names(tree)
+            for name, doms in collect_domain_exports(
+                    tree, sources[path]).items():
+                domain_exports.setdefault(name, set()).update(doms)
         except SyntaxError:
             pass    # reported in pass 2
 
@@ -123,7 +131,8 @@ def lint_paths(paths: Sequence[str], *,
         rel = _relpath(path, root)
         try:
             index = ModuleIndex(rel, sources[path],
-                                external_traced_names=traced_names)
+                                external_traced_names=traced_names,
+                                external_domains=domain_exports)
         except SyntaxError as e:
             result.errors.append(Finding(
                 rule="GL000", path=rel, line=e.lineno or 0, col=0,

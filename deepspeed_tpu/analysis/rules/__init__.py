@@ -4,10 +4,12 @@ source of truth. IDs are stable — retired rules are never reused."""
 
 from __future__ import annotations
 
-from . import donation, dtype_rules, host_sync, recompile, telemetry_rules
+from . import (concurrency, donation, dtype_rules, host_sync, recompile,
+               telemetry_rules)
 
 ALL_RULES = (host_sync.RULES + recompile.RULES + donation.RULES
-             + dtype_rules.RULES + telemetry_rules.RULES)
+             + dtype_rules.RULES + telemetry_rules.RULES
+             + concurrency.RULES)
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
 

@@ -1,0 +1,355 @@
+"""Concurrency-domain rules (GL050-GL053, ISSUE 11 tentpole part 1).
+
+The serving stack runs three kinds of threads with sharply different
+contracts: the WORKER thread owns every JAX call (serving/server.py's
+``_work`` loop, or the main thread in closed-loop drivers), the ASYNCIO
+event loop must never block or device-call (one stray ``Event.wait``
+stalls every stream), and DAEMON watchers (hang watchdog, pollers) may
+sleep but must not own device work. ``core.py`` assigns each function a
+set of thread domains from ``# graftsan: domain=...`` annotations and
+``async def`` seeds, propagated along the call graph (see the core
+module docstring for the syntax and propagation rules); these rules
+turn a domain-contract violation into a lint failure instead of a
+production hang:
+
+- GL050: JAX/device calls reachable from a non-worker domain;
+- GL051: blocking primitives reachable from the asyncio domain;
+- GL052: shared instance state mutated from >= 2 domains without a
+  common lock;
+- GL053: lock acquisition under a held lock in inconsistent order
+  (the classic AB/BA deadlock shape).
+
+Functions with no domain stay exempt (unknown != violation), as do
+functions declared ``domain=any`` (an author-audited exemption).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..core import Context, FuncInfo, Rule, attr_chain, is_device_call
+
+# --------------------------------------------------------------------
+# shared predicates
+# --------------------------------------------------------------------
+
+# jnp/jax tails that are runtime/transfer calls rather than traced math:
+# is_device_call deliberately excludes them (they are not *hidden*
+# device work at a jit site), but from an asyncio/daemon thread ANY
+# runtime interaction is a domain violation
+_RUNTIME_TAILS = {"device_put", "device_get", "block_until_ready"}
+
+# repo-local helpers that query the device runtime (the watchdog's
+# last-resort memory probe lives behind one of these)
+_DEVICE_HELPER_NAMES = {"device_memory_stats", "live_arrays",
+                        "live_buffers"}
+
+
+def _is_device_touch(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    if is_device_call(node):
+        return True
+    chain = attr_chain(node.func)
+    if not chain:
+        return False
+    if chain[0] in ("jnp", "jax", "lax") and chain[-1] in _RUNTIME_TAILS:
+        return True
+    return chain[-1] in _DEVICE_HELPER_NAMES
+
+
+# receiver-name stems identifying a lock-ish object in a with-item or
+# .acquire() call
+_LOCK_STEMS = ("lock", "mutex", "mtx", "semaphore", "sem_", "cond")
+
+
+def _lockish_name(expr: ast.AST) -> Optional[str]:
+    """Dotted name of a lock-like context expr (``self._mail_lock`` ->
+    ``self._mail_lock``); None when the expr is not name-shaped or does
+    not look like a lock."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    low = chain[-1].lower()
+    if any(s in low for s in _LOCK_STEMS):
+        return ".".join(chain)
+    return None
+
+
+def _held_locks(index, node: ast.AST) -> frozenset:
+    """Lock names held at ``node``: lock-ish with-items of every
+    enclosing ``with`` within the same function."""
+    held: set[str] = set()
+    cur = index.parent(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                name = _lockish_name(item.context_expr)
+                if name:
+                    held.add(name)
+        cur = index.parent(cur)
+    return frozenset(held)
+
+
+def _owned_nodes(ctx: Context, info: FuncInfo) -> Iterable[ast.AST]:
+    for node in ast.walk(info.node):
+        if node is info.node:
+            continue
+        if ctx.index.enclosing_function(node) is info.node:
+            yield node
+
+
+# --------------------------------------------------------------------
+# GL050
+# --------------------------------------------------------------------
+
+
+class DeviceCallOffWorker(Rule):
+    id = "GL050"
+    name = "device-call-off-worker"
+    summary = ("JAX/device call reachable from a non-worker thread "
+               "domain (asyncio event loop or a daemon watcher) — only "
+               "the worker thread owns the engine; a device call from "
+               "the event loop blocks every stream, and one from a "
+               "daemon races the worker's dispatch state")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.domain_functions("asyncio", "daemon"):
+            bad = sorted(info.domains & {"asyncio", "daemon"})
+            for node in _owned_nodes(ctx, info):
+                if _is_device_touch(node):
+                    ctx.report(
+                        self.id, node,
+                        f"device/runtime call in the {'/'.join(bad)} "
+                        f"domain (function '{info.name}'); move it to "
+                        "the worker thread (marshal through the "
+                        "mailbox) or annotate a justified exception")
+
+
+# --------------------------------------------------------------------
+# GL051
+# --------------------------------------------------------------------
+
+# blocking attr tails that are unambiguous on any receiver
+_BLOCK_ANY_RECV = {"wait", "acquire"}
+# blocking attr tails that need a receiver-name hint (``.get()`` /
+# ``.join()`` are too common on dicts/strings to flag bare)
+_BLOCK_BY_RECV = {
+    "get": ("queue", "mailbox", "mbox", "jobs", "_q", "q"),
+    "join": ("thread", "worker", "proc", "process"),
+    "result": ("future", "fut", "promise"),
+}
+_SLEEP_CHAINS = {("time", "sleep")}
+
+
+def _stem_match(part: str, stem: str) -> bool:
+    """Multi-char stems match by containment ("queue" in "work_queue");
+    the 1-2 char q stems must match the whole part or a ``_``-suffix —
+    containment would false-fire on any name merely containing the
+    letter ("q" in "requests" is a dict lookup, not a Queue)."""
+    if len(stem) > 2:
+        return stem in part
+    base = stem.lstrip("_")
+    return part in (stem, base) or part.endswith("_" + base)
+
+
+def _blocking_reason(node: ast.Call) -> Optional[str]:
+    chain = attr_chain(node.func)
+    if not chain:
+        return None
+    if tuple(chain) in _SLEEP_CHAINS:
+        return "time.sleep()"
+    tail = chain[-1]
+    recv = [p.lower() for p in chain[:-1]]
+    if len(chain) >= 2 and tail in _BLOCK_ANY_RECV:
+        return f".{tail}()"
+    stems = _BLOCK_BY_RECV.get(tail)
+    if stems and any(_stem_match(part, stem)
+                     for part in recv for stem in stems):
+        return f"{chain[-2]}.{tail}()"
+    return None
+
+
+class BlockingCallInAsyncio(Rule):
+    id = "GL051"
+    name = "blocking-call-in-asyncio"
+    summary = ("blocking primitive (Event.wait / Lock.acquire / "
+               "Queue.get / thread join / time.sleep / `with lock:`) "
+               "reachable from the asyncio domain — it stalls the whole "
+               "event loop, freezing every request stream at once")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.domain_functions("asyncio"):
+            for node in _owned_nodes(ctx, info):
+                if isinstance(node, ast.Call):
+                    # awaited calls are the asyncio-native non-blocking
+                    # forms (await q.get(), await lock.acquire())
+                    if isinstance(ctx.index.parent(node), ast.Await):
+                        continue
+                    reason = _blocking_reason(node)
+                    if reason:
+                        ctx.report(
+                            self.id, node,
+                            f"{reason} in the asyncio domain blocks "
+                            "the event loop; use the asyncio "
+                            "equivalent (await) or marshal to the "
+                            "worker thread")
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        name = _lockish_name(item.context_expr)
+                        if name:
+                            ctx.report(
+                                self.id, node,
+                                f"`with {name}:` in the asyncio domain "
+                                "acquires a thread lock on the event "
+                                "loop; keep critical sections off the "
+                                "loop (or justify: O(1) body, never "
+                                "held around device work)")
+
+
+# --------------------------------------------------------------------
+# GL052
+# --------------------------------------------------------------------
+
+# method names that mutate their receiver in place
+_MUTATORS = {"append", "extend", "add", "update", "pop", "popitem",
+             "popleft", "appendleft", "clear", "remove", "discard",
+             "insert", "setdefault", "sort"}
+
+
+def _self_attr(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _mutated_attr(node: ast.AST) -> Optional[str]:
+    """Name of the ``self.<attr>`` an AST node mutates, if any."""
+    targets: list[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = node.targets
+    elif isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATORS:
+        return _self_attr(node.func.value)
+    for t in targets:
+        stack = [t]
+        while stack:
+            cur = stack.pop()
+            if isinstance(cur, (ast.Tuple, ast.List)):
+                stack.extend(cur.elts)
+                continue
+            if isinstance(cur, ast.Starred):
+                stack.append(cur.value)
+                continue
+            if isinstance(cur, ast.Subscript):
+                cur = cur.value
+            attr = _self_attr(cur)
+            if attr:
+                return attr
+    return None
+
+
+class CrossDomainMutationWithoutLock(Rule):
+    id = "GL052"
+    name = "cross-domain-mutation-without-lock"
+    summary = ("instance attribute mutated from >= 2 thread domains "
+               "with no common lock across the sites — a data race on "
+               "shared engine/scheduler state (or a GIL-atomicity "
+               "assumption that deserves an explicit justification)")
+
+    def check(self, ctx: Context) -> None:
+        index = ctx.index
+        by_class: dict = {}
+        for info in index.functions.values():
+            if "any" in info.domains:
+                continue        # author-audited exemption
+            doms = info.domains - {"any"}
+            if not doms:
+                continue
+            cls = index.enclosing_class(info.node)
+            if cls is None:
+                continue
+            for node in _owned_nodes(ctx, info):
+                attr = _mutated_attr(node)
+                if attr is None:
+                    continue
+                by_class.setdefault(cls, {}).setdefault(attr, []).append(
+                    (doms, _held_locks(index, node), node, info))
+        for cls, attrs in by_class.items():
+            for attr, sites in attrs.items():
+                domains = set().union(*(d for d, _, _, _ in sites))
+                if len(domains) < 2:
+                    continue
+                common = frozenset.intersection(
+                    *(locks for _, locks, _, _ in sites))
+                if common:
+                    continue
+                sites.sort(key=lambda s: s[2].lineno)
+                _, _, first, _ = sites[0]
+                where = ", ".join(
+                    f"{i.name}:{n.lineno} [{'/'.join(sorted(d))}]"
+                    for d, _, n, i in sites)
+                ctx.report(
+                    self.id, first,
+                    f"self.{attr} is mutated from domains "
+                    f"{sorted(domains)} with no common lock "
+                    f"(sites: {where}); lock it, confine it to one "
+                    "domain, or justify the benign race inline")
+
+
+# --------------------------------------------------------------------
+# GL053
+# --------------------------------------------------------------------
+
+
+class InconsistentLockOrder(Rule):
+    id = "GL053"
+    name = "inconsistent-lock-order"
+    summary = ("lock acquired while holding another lock, with the "
+               "opposite order taken elsewhere in the module — two "
+               "threads running the two paths deadlock (AB/BA)")
+
+    def check(self, ctx: Context) -> None:
+        index = ctx.index
+        edges: dict[tuple, list[ast.AST]] = {}
+        for node in ast.walk(index.tree):
+            inner: Optional[str] = None
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    inner = _lockish_name(item.context_expr)
+                    if inner:
+                        break
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                inner = _lockish_name(node.func.value)
+            if not inner:
+                continue
+            for outer in _held_locks(index, node):
+                if outer != inner:
+                    edges.setdefault((outer, inner), []).append(node)
+        reported: set[frozenset] = set()
+        for (a, b), nodes in edges.items():
+            if (b, a) not in edges or frozenset((a, b)) in reported:
+                continue
+            reported.add(frozenset((a, b)))
+            other = edges[(b, a)][0]
+            ctx.report(
+                self.id, nodes[0],
+                f"lock order {a} -> {b} here, but {b} -> {a} at line "
+                f"{other.lineno}: two threads taking the two paths "
+                "deadlock; pick one global order (or collapse to one "
+                "lock)")
+
+
+RULES = [DeviceCallOffWorker(), BlockingCallInAsyncio(),
+         CrossDomainMutationWithoutLock(), InconsistentLockOrder()]

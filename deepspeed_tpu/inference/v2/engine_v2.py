@@ -16,8 +16,9 @@ compiled step so KV writes are in-place.
 from __future__ import annotations
 
 import functools
+import os
 import time
-from typing import Any, Optional, Sequence
+from typing import Any, Literal, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -182,6 +183,30 @@ class SpeculativeConfig(DeepSpeedConfigModel):
         return self
 
 
+class GraftsanConfig(DeepSpeedConfigModel):
+    """Runtime concurrency/KV-accounting sanitizers (ISSUE 11,
+    ``analysis/blocksan.py`` — the runtime half of the graftsan
+    GL050-GL053 static pass). ``blocksan`` journals every KV-block
+    accounting mutation with call-site provenance and asserts refcount
+    >= 0, no double-free, and pool conservation (free + referenced +
+    LRU-cached == pool) at every flush/park quiesce point, naming
+    leaked blocks' allocation sites on failure; ``thread_affinity``
+    stamps the engine-owning thread (the async server re-stamps its
+    worker at loop start) and raises on JAX dispatch from any other
+    thread. Off by default — the disabled path is one attribute load
+    per accounting call and nothing is imported. Env ``DS_GRAFTSAN=1``
+    force-enables both (the conftest/CI opt-in knob)."""
+    enabled: bool = False
+    blocksan: bool = True
+    thread_affinity: bool = True
+    # "raise" fails fast (tests/bench); "warn" logs, counts, and keeps
+    # serving (violations still reach ds_blocksan_violations_total)
+    mode: Literal["raise", "warn"] = "raise"
+    # bounded journal of recent accounting ops kept for leak reports
+    # and hang-dump forensics
+    journal_size: int = Field(512, ge=16)
+
+
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
     (state_manager block/pool sizing knobs + the fused-decode loop)."""
@@ -233,6 +258,10 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     # docs/serving.md)
     speculative: SpeculativeConfig = Field(
         default_factory=SpeculativeConfig)
+    # graftsan runtime sanitizers (ISSUE 11): KV block-accounting
+    # journal + conservation checks and the thread-affinity checker
+    # (see docs/static-analysis.md, "Concurrency domains & sanitizers")
+    graftsan: GraftsanConfig = Field(default_factory=GraftsanConfig)
 
 
 class InferenceEngineV2:
@@ -322,6 +351,25 @@ class InferenceEngineV2:
             self._decode_sentinel = RecompileSentinel(
                 "fused_decode", mode=config.sentinel_mode, warmup_calls=0)
             self._hot_guard = hot_path_guard
+        # graftsan runtime sanitizers (ISSUE 11): opt-in via the config
+        # block or the DS_GRAFTSAN env knob; lazily imported so a
+        # sanitizer-off process never loads analysis/blocksan
+        self._blocksan = None
+        self._affinity = None
+        gs = config.graftsan
+        if gs.enabled or os.environ.get("DS_GRAFTSAN", "") \
+                not in ("", "0"):
+            from ...analysis import blocksan as _bsan
+            if gs.blocksan:
+                self._blocksan = _bsan.BlockSanitizer(
+                    config.num_kv_blocks, mode=gs.mode,
+                    journal_size=gs.journal_size)
+                self.state_manager.attach_sanitizer(self._blocksan)
+                # registered process-wide so hang-watchdog dumps embed
+                # the journal tail (telemetry/flightrec.dump_state)
+                _bsan.set_blocksan(self._blocksan)
+            if gs.thread_affinity:
+                self._affinity = _bsan.ThreadAffinityChecker(mode=gs.mode)
         # serving counters behind serving_metrics(): host dispatches vs
         # decoded tokens measures how host-free the decode loop is.
         # Schema-driven (SERVING_COUNTER_KEYS) so reset/emission can
@@ -340,6 +388,11 @@ class InferenceEngineV2:
     def _run(self, uids: list[int]) -> jnp.ndarray:
         """One bucketed forward over the pending tokens of `uids`.
         Returns last-token logits [len(uids), V]."""
+        if self._affinity is not None:
+            # runtime half of GL050: only the engine-owning thread may
+            # reach a JAX dispatch (auto-binds on first use; the async
+            # server re-stamps its worker at loop start)
+            self._affinity.check("v2/_run")
         mgr = self.state_manager
         seqs = [mgr.seqs[u] for u in uids]
         max_pending = max(s.pending for s in seqs)
@@ -733,6 +786,10 @@ class InferenceEngineV2:
         ``jnp.asarray`` uploads vs committed jit outputs), so XLA keeps
         one executable per variant — a fact this sentinel itself
         surfaced when first wired in."""
+        if self._affinity is not None:
+            # every fused dispatch path (decode_fused, chain mode, ring
+            # mode) enters through this scope — one affinity choke point
+            self._affinity.check("v2/fused_dispatch")
         s = self._decode_sentinel
         if s is None:
             return _NULLCM

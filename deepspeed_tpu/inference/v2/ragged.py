@@ -76,6 +76,10 @@ class BlockedAllocator:
         self._free = list(range(num_blocks - 1, -1, -1))
         self._ref = [0] * num_blocks
         self.evict_source = None        # () -> Optional[int]
+        # opt-in block-accounting sanitizer (ISSUE 11,
+        # analysis/blocksan.py): every hook below is behind an
+        # attribute-load guard, so the disabled path is untouched
+        self.sanitizer = None
 
     @property
     def free_blocks(self) -> int:
@@ -89,16 +93,23 @@ class BlockedAllocator:
             b = self.evict_source()
             if b is None:
                 break
-            self._free.append(b)
+            # route the evicted block through free() — the ONE way
+            # blocks return to the free list, so the sanitizer sees
+            # every transition (no raw _free.append path exists)
+            self.free([b])
         if n > len(self._free):
             raise RuntimeError(
                 f"KV pool exhausted: want {n} blocks, {len(self._free)} free")
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._ref[b] = 1
+        if self.sanitizer is not None:
+            self.sanitizer.on_allocate(out)
         return out
 
     def incref(self, blocks) -> None:
+        if self.sanitizer is not None:
+            self.sanitizer.on_incref(blocks)
         for b in blocks:
             self._ref[b] += 1
 
@@ -106,6 +117,8 @@ class BlockedAllocator:
         """Drop one reference per block; returns the blocks that reached
         refcount zero (NOT freed — the caller routes them to the free
         list or the prefix cache's LRU pool)."""
+        if self.sanitizer is not None:
+            self.sanitizer.on_decref(blocks)
         zeros = []
         for b in blocks:
             self._ref[b] -= 1
@@ -116,6 +129,8 @@ class BlockedAllocator:
 
     def free(self, blocks: list[int]) -> None:
         """Raw return to the free list (refcounts cleared)."""
+        if self.sanitizer is not None:
+            self.sanitizer.on_free(blocks)
         for b in blocks:
             self._ref[b] = 0
         self._free.extend(blocks)
@@ -149,6 +164,8 @@ class PrefixCache:
         # allocator's free list) — an evicted block is on neither the
         # free list nor the index, so dropping it would leak it
         self.free_sink = None               # (block: int) -> None
+        # opt-in block-accounting sanitizer (ISSUE 11); see allocator
+        self.sanitizer = None
 
     @property
     def cached_blocks(self) -> int:
@@ -211,6 +228,8 @@ class PrefixCache:
         caller should return it to the free list."""
         if block not in self.block_key:
             return False
+        if self.sanitizer is not None:
+            self.sanitizer.on_cache_park(block)
         self.lru[block] = None
         self.lru.move_to_end(block)
         return True
@@ -223,6 +242,8 @@ class PrefixCache:
         block, _ = self.lru.popitem(last=False)
         del self.index[self.block_key.pop(block)]
         self.stats["prefix_evictions"] += 1
+        if self.sanitizer is not None:
+            self.sanitizer.on_cache_evict(block)
         return block
 
 
@@ -238,9 +259,37 @@ class DSStateManager:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.seqs: dict[int, SequenceDescriptor] = {}
         self.cache = prefix_cache
+        self.sanitizer = None           # ISSUE 11; attach_sanitizer
         if prefix_cache is not None:
             self.allocator.evict_source = prefix_cache.evict_one
-            prefix_cache.free_sink = lambda b: self.allocator.free([b])
+            prefix_cache.free_sink = self._free_sink
+
+    def _free_sink(self, block: int) -> None:
+        """Cap-path eviction outlet (PrefixCache.publish): routes the
+        evicted refcount-zero block through ``allocator.free`` — the
+        sanitizer-audited choke every freed block passes — so the PR 4
+        cap-path leak class is structurally impossible (there is no
+        second way out of the index)."""
+        self.allocator.free([block])
+
+    def attach_sanitizer(self, san) -> None:
+        """Wire the opt-in KV block-accounting sanitizer (ISSUE 11,
+        analysis/blocksan.py) into every accounting mutation point:
+        the allocator's allocate/free/incref/decref, the prefix
+        cache's LRU park/evict, and this manager's quiesce points
+        (flush/park conservation checks)."""
+        self.sanitizer = san
+        self.allocator.sanitizer = san
+        if self.cache is not None:
+            self.cache.sanitizer = san
+
+    def _quiesce(self, label: str) -> None:
+        """Conservation check at a quiesce point: free + referenced +
+        LRU-cached must partition the pool (no-op with the sanitizer
+        detached)."""
+        if self.sanitizer is not None:
+            self.sanitizer.check_conservation(self.allocator, self.cache,
+                                              label)
 
     @property
     def available_blocks(self) -> int:
@@ -305,6 +354,12 @@ class DSStateManager:
         self._release_blocks([b for _, b in matches])
 
     def _release_blocks(self, blocks: list[int]) -> None:
+        """THE free-routing choke point (ISSUE 11 satellite): every
+        release — flush, park, unpin — is decref, then the prefix
+        cache's LRU park for indexed blocks, then ``allocator.free``
+        for the rest; the cap path reaches ``free`` through
+        :meth:`_free_sink`. No other route returns blocks, which is
+        what lets blocksan audit the whole lifecycle at four hooks."""
         zeros = self.allocator.decref(blocks)
         if self.cache is not None:
             zeros = [b for b in zeros if not self.cache.release(b)]
@@ -479,6 +534,7 @@ class DSStateManager:
         seq = self.seqs.pop(uid, None)
         if seq is not None:
             self._release_blocks(seq.blocks)
+            self._quiesce("flush")
 
     def park(self, uid: int) -> list[int]:
         """Preemption swap-out (ISSUE 6): release a LIVE sequence's KV

@@ -186,7 +186,8 @@ class FusedServeLoop:
     # ------------------------------------------------------------------
     # request intake (single-threaded with step(); see module docstring)
     def submit(self, prompt, max_new_tokens: int = 32, *,
-               priority: int = 1, uid: Optional[int] = None) -> int:
+               priority: int = 1,
+               uid: Optional[int] = None) -> int:   # graftsan: domain=worker
         """Queue one prompt; returns its uid. Lower ``priority`` values
         run first; ties admit in submission order."""
         toks = [int(t) for t in prompt]
@@ -216,7 +217,7 @@ class FusedServeLoop:
                     or self.to_flush or self._cancelled)
 
     # ------------------------------------------------------------------
-    def step(self) -> list[TokenEvent]:
+    def step(self) -> list[TokenEvent]:     # graftsan: domain=worker
         """One scheduler iteration: boundary housekeeping (flush /
         cancel / preempt / admit / prefill), then enqueue up to the
         configured chain depth and drain. Returns the tokens decoded
@@ -235,7 +236,7 @@ class FusedServeLoop:
             raise
         return ev
 
-    def close(self) -> None:
+    def close(self) -> None:    # graftsan: domain=worker
         """Release every request's KV state (server shutdown)."""
         self._emergency_flush()
         if self._rt is not None:

@@ -170,8 +170,13 @@ class AsyncInferenceServer:
             self._rt.set_slo(
                 cfg.slo_ttft_ms / 1e3 if cfg.slo_ttft_ms else None,
                 cfg.slo_itl_ms / 1e3 if cfg.slo_itl_ms else None)
-        self._accepting = True
-        self._stopping = False
+        # GIL-atomic bool flags shared with the worker: _accepting is
+        # flipped off by a dying worker (the losing race costs one
+        # submit that then hits the _worker_error check), _stopping is
+        # mailbox-ordered (the worker only sets it after reading a stop
+        # message this thread posted) — benign by construction
+        self._accepting = True      # graftlint: disable=GL052
+        self._stopping = False      # graftlint: disable=GL052
         self._thread = threading.Thread(target=self._work, daemon=True,
                                         name="ds-serving-loop")
         self._thread.start()
@@ -246,7 +251,10 @@ class AsyncInferenceServer:
 
     # ------------------------------------------------------------------
     def _post(self, msg: tuple) -> None:
-        with self._mail_lock:
+        # O(1) append under the mailbox lock; the worker holds the same
+        # lock only for a pointer swap (_drain_mailbox), never around
+        # engine/device work — the loop cannot stall on it
+        with self._mail_lock:       # graftlint: disable=GL051
             self._mailbox.append(msg)
         self._wake.set()
 
@@ -266,10 +274,17 @@ class AsyncInferenceServer:
 
         self._aloop.call_soon_threadsafe(deliver)
 
-    def _work(self) -> None:
+    def _work(self) -> None:    # graftsan: domain=worker
         """Worker thread: owns the session and every engine/JAX call."""
         s = self.session
         cfg = self.config
+        aff = getattr(self.engine, "_affinity", None)
+        if aff is not None:
+            # this thread is now THE engine owner: re-stamp (engine
+            # warmup may have auto-bound the constructing thread), and
+            # release ownership again on exit so a later closed-loop
+            # driver on another thread can re-bind instead of raising
+            aff.bind(force=True)
         try:
             while True:
                 stop = self._drain_mailbox(s)
@@ -309,6 +324,8 @@ class AsyncInferenceServer:
                 s.close()
             except Exception:   # noqa: BLE001 — shutdown best-effort
                 pass
+            if aff is not None:
+                aff.unbind()
 
     def _drain_mailbox(self, s: FusedServeLoop) -> bool:
         with self._mail_lock:

@@ -192,6 +192,17 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
     except Exception as e:   # noqa: BLE001
         doc["metrics_error"] = repr(e)
     try:
+        # blocksan journal tail (ISSUE 11): when the KV-accounting
+        # sanitizer is active, a wedged serving loop's dump also says
+        # what the allocator was DOING — the last accounting ops with
+        # call-site provenance, violation log and conservation counters
+        from ..analysis.blocksan import get_blocksan
+        san = get_blocksan()
+        if san is not None:
+            doc["blocksan"] = san.snapshot()
+    except Exception as e:   # noqa: BLE001
+        doc["blocksan_error"] = repr(e)
+    try:
         with open("/proc/self/status") as f:
             doc["host_memory"] = {
                 k: v.strip() for k, v in
@@ -204,7 +215,10 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
         # itself may block, and everything above is already on disk
         # semantics-wise (the dict is complete before the write below)
         from ..utils.memory import device_memory_stats
-        doc["device_memory"] = device_memory_stats()
+        # last-resort device query from the watchdog daemon: ordered
+        # LAST precisely because it may block on a wedged runtime, and
+        # the dump dict is already complete above
+        doc["device_memory"] = device_memory_stats()    # graftlint: disable=GL050
     except Exception as e:   # noqa: BLE001
         doc["device_memory_error"] = repr(e)
     try:
@@ -261,7 +275,7 @@ class HangWatchdog:
             t.join(timeout=2.0)
         self._thread = None
 
-    def _run(self) -> None:
+    def _run(self) -> None:     # graftsan: domain=daemon
         while not self._stop.wait(self.poll_s):
             stalled = self.recorder.stalled_for()
             if stalled is None or stalled <= self.deadline_s:

@@ -186,7 +186,30 @@ _SLOW = {
     # (decomposition, schema, exemplars, SLO) stay tier-1; this
     # engine-backed async-server reconciliation run is the heavy tail
     ("test_reqtrace.py", "test_server_traces_reconcile_end_to_end"),
+    # graftsan runtime sanitizers (ISSUE 11): the host-only invariant
+    # tests (double-free, negative refcount, conservation/leak
+    # provenance, affinity checker) stay tier-1 — they build no engine;
+    # these engine-integrated acceptance roundtrips are the heavy tail
+    ("test_graftsan.py", "test_generate_fused_park_restore_conservation"),
+    ("test_graftsan.py", "test_engine_dispatch_from_wrong_thread_raises"),
+    ("test_graftsan.py", "test_async_server_rebinds_worker_thread"),
 }
+
+
+# graftsan CI knob (ISSUE 11): DS_GRAFTSAN=1 force-enables the runtime
+# sanitizers (KV block-accounting journal + thread-affinity checker,
+# analysis/blocksan.py) on every InferenceEngineV2 a test builds — the
+# engine reads the env directly, so `DS_GRAFTSAN=1 pytest -m 'not slow'`
+# runs the lean host-only tier sanitized with no test-body changes.
+GRAFTSAN = os.environ.get("DS_GRAFTSAN", "") not in ("", "0")
+
+
+def pytest_report_header(config):
+    if GRAFTSAN:
+        return ("graftsan: DS_GRAFTSAN=1 — runtime sanitizers (blocksan "
+                "+ thread affinity) armed for every v2 engine this run "
+                "builds")
+    return None
 
 
 def _marker_keys(item):

@@ -235,6 +235,85 @@ FIXTURES = {
             return tel.get_registry() if tel is not None else None
         """,
     ),
+    "GL050": (
+        """
+        import jax.numpy as jnp
+        class Server:
+            async def submit(self, x):
+                y = jnp.sum(x)
+                return y
+        """,
+        """
+        import jax.numpy as jnp
+        class Server:
+            def _work(self, x):  # graftsan: domain=worker
+                return jnp.sum(x)
+            async def submit(self, x):
+                self._post(("submit", x))
+            def _post(self, msg):
+                self.mailbox.append(msg)
+        """,
+    ),
+    "GL051": (
+        """
+        import time
+        class Server:
+            async def submit(self, req):
+                time.sleep(0.01)
+                return req
+        """,
+        """
+        import time
+        class Server:
+            async def stream(self):
+                item = await self.queue.get()
+                return item
+            def _work(self):  # graftsan: domain=worker
+                time.sleep(0.01)
+        """,
+    ),
+    "GL052": (
+        """
+        class Server:
+            def _work(self):  # graftsan: domain=worker
+                self.open_requests += 1
+            async def submit(self):
+                self.open_requests -= 1
+        """,
+        """
+        class Server:
+            def _work(self):  # graftsan: domain=worker
+                with self.state_lock:
+                    self.open_requests += 1
+            def _watch(self):  # graftsan: domain=daemon
+                with self.state_lock:
+                    self.open_requests -= 1
+        """,
+    ),
+    "GL053": (
+        """
+        class Pool:
+            def grow(self):
+                with self.alloc_lock:
+                    with self.table_lock:
+                        self.n += 1
+            def shrink(self):
+                with self.table_lock:
+                    with self.alloc_lock:
+                        self.n -= 1
+        """,
+        """
+        class Pool:
+            def grow(self):
+                with self.alloc_lock:
+                    with self.table_lock:
+                        self.n += 1
+            def shrink(self):
+                with self.alloc_lock:
+                    with self.table_lock:
+                        self.n -= 1
+        """,
+    ),
     "GL041": (
         """
         import jax, jax.numpy as jnp
@@ -373,6 +452,144 @@ def test_local_jit_name_does_not_poison_other_modules(tmp_path):
     """))
     res = lint_paths([str(tmp_path)], root=str(tmp_path))
     assert not [f for f in res.findings if f.path == "b.py"], res.findings
+
+
+# ---------------------------------------------------------------------
+# thread domains (ISSUE 11): propagation, transfer pins, exemptions
+# ---------------------------------------------------------------------
+
+def test_domain_propagates_across_modules(tmp_path):
+    """One cross-module hop: a daemon-annotated driver in module A
+    calls probe() defined in module B — the device call in B fires
+    GL050 even though B carries no annotation."""
+    (tmp_path / "a.py").write_text(textwrap.dedent("""
+        from b import probe
+        def drive(xs):   # graftsan: domain=daemon
+            return probe(xs)
+    """))
+    (tmp_path / "b.py").write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+        def probe(xs):
+            return jnp.sum(xs)
+    """))
+    res = lint_paths([str(tmp_path)], root=str(tmp_path))
+    assert any(f.rule == "GL050" and f.path == "b.py"
+               for f in res.findings), res.findings
+
+
+def test_domain_propagates_through_self_calls(tmp_path):
+    """Annotated roots push their domain through self.m() chains —
+    the HangWatchdog._run -> fire -> dump shape."""
+    src = """
+        import jax.numpy as jnp
+        class Watchdog:
+            def _run(self):   # graftsan: domain=daemon
+                self.fire()
+            def fire(self):
+                return self.dump()
+            def dump(self):
+                return jnp.zeros(4)
+    """
+    res = _lint_src(tmp_path, src)
+    assert any(f.rule == "GL050" for f in res.findings), res.findings
+
+
+def test_call_soon_threadsafe_pins_callback_to_asyncio(tmp_path):
+    """A closure nested in worker code but handed to
+    call_soon_threadsafe RUNS on the event loop: its mutations share
+    the asyncio domain with async methods (no GL052) — while the same
+    closure called directly keeps the worker domain (GL052 fires)."""
+    transferred = """
+        class Server:
+            def _work(self):  # graftsan: domain=worker
+                def deliver():
+                    self.open_requests -= 1
+                self.loop.call_soon_threadsafe(deliver)
+            async def submit(self):
+                self.open_requests += 1
+    """
+    assert not [f for f in _lint_src(tmp_path, transferred).findings
+                if f.rule == "GL052"]
+    direct = transferred.replace(
+        "self.loop.call_soon_threadsafe(deliver)", "deliver()")
+    assert any(f.rule == "GL052"
+               for f in _lint_src(tmp_path, direct).findings)
+
+
+def test_domain_any_is_an_audited_exemption(tmp_path):
+    src = """
+        import time, jax.numpy as jnp
+        def audited(x):   # graftsan: domain=any
+            time.sleep(0.001)
+            return jnp.sum(x)
+        class Server:
+            async def submit(self, x):
+                return audited(x)
+    """
+    res = _lint_src(tmp_path, src)
+    assert not [f for f in res.findings
+                if f.rule in ("GL050", "GL051")], res.findings
+
+
+def test_domain_annotation_on_multiline_signature(tmp_path):
+    """An annotation on ANY line of a multi-line signature seeds the
+    def (FusedServeLoop.submit's comment sits on the closing-paren
+    line) — and a closing-line annotation still must not leak onto a
+    nested def starting on the very next line."""
+    src = """
+        import time
+        class Loop:
+            def submit(self, prompt,
+                       priority=1,
+                       uid=None):   # graftsan: domain=asyncio
+                time.sleep(0.001)
+    """
+    assert any(f.rule == "GL051" for f in _lint_src(tmp_path, src).findings)
+    # a closing-line annotation must not PIN a nested def starting on
+    # the very next line: deliver here must stay transferable to the
+    # asyncio domain (a leaked worker pin would block the transfer and
+    # GL052 would fire as in the direct-call variant)
+    nested = """
+        class Server:
+            def _work(self,
+                      budget):   # graftsan: domain=worker
+                def deliver():
+                    self.open_requests -= 1
+                self.loop.call_soon_threadsafe(deliver)
+            async def submit(self):
+                self.open_requests += 1
+    """
+    assert not [f for f in _lint_src(tmp_path, nested).findings
+                if f.rule == "GL052"]
+
+
+def test_gl051_get_needs_a_queueish_receiver(tmp_path):
+    """``.get()`` only counts as blocking on a queue-shaped receiver
+    name: ``self.requests.get(uid)`` (a dict lookup — 'q' is merely a
+    letter in the name) must not fire, ``self.work_q.get()`` must."""
+    src = """
+        class Server:
+            async def status(self, uid):
+                return self.requests.get(uid)
+    """
+    assert not [f for f in _lint_src(tmp_path, src).findings
+                if f.rule == "GL051"]
+    src_q = src.replace("self.requests.get(uid)", "self.work_q.get()")
+    assert any(f.rule == "GL051"
+               for f in _lint_src(tmp_path, src_q).findings)
+
+
+def test_graftsan_findings_suppress_and_baseline(tmp_path):
+    """The new rules ride the same suppression + baseline machinery as
+    GL001-GL041."""
+    pos, _ = FIXTURES["GL050"]
+    suppressed = pos.replace("y = jnp.sum(x)",
+                             "y = jnp.sum(x)  # graftlint: disable=GL050")
+    assert not [f for f in _lint_src(tmp_path, suppressed).findings
+                if f.rule == "GL050"]
+    res = _lint_src(tmp_path, pos)
+    hits = [f for f in res.findings if f.rule == "GL050"]
+    assert hits and diff_against_baseline(hits, hits) == []
 
 
 # ---------------------------------------------------------------------

@@ -1,0 +1,355 @@
+"""graftsan runtime sanitizers (ISSUE 11): KV block-accounting
+invariants (double-free, negative refcount, use-after-free,
+conservation-at-quiesce with leak provenance — incl. the mutation-style
+re-introduction of the PR 4 cap-path leak), the thread-affinity
+checker, hang-dump/telemetry integration, and the engine-integrated
+roundtrips (sanitizer on == tokens off; park/restore conservation) in
+the slow tier.
+
+Host-only tests build bare DSStateManager/BlockedAllocator state — no
+engine, no compiles — so the DS_GRAFTSAN=1 CI subset stays lean.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.blocksan import (AffinityError, BlockSanError,
+                                             BlockSanitizer,
+                                             ThreadAffinityChecker,
+                                             env_enabled, get_blocksan,
+                                             set_blocksan)
+from deepspeed_tpu.inference.v2.ragged import DSStateManager, PrefixCache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mgr(num_blocks=16, block_size=8, cache=None):
+    mgr = DSStateManager(block_size=block_size, num_blocks=num_blocks,
+                         max_blocks_per_seq=8, prefix_cache=cache)
+    san = BlockSanitizer(num_blocks)
+    mgr.attach_sanitizer(san)
+    return mgr, san
+
+
+# ---------------------------------------------------------------------
+# blocksan invariants (host-only)
+# ---------------------------------------------------------------------
+
+def test_blocksan_clean_roundtrip_and_counters():
+    """extend -> publish -> flush conserves the pool: no violations,
+    the quiesce check ran, and every block is back on the free list."""
+    mgr, san = _mgr(cache=PrefixCache(8))
+    mgr.extend(0, list(range(20)))
+    mgr.seqs[0].seen = 20
+    mgr.publish_full_blocks(mgr.seqs[0])
+    mgr.flush(0)
+    assert san.counters["violations"] == 0
+    assert san.counters["quiesce_checks"] == 1
+    assert san.counters["ops"] > 0
+    # published full blocks parked in the LRU, the tail freed —
+    # conservation holds with a *partitioned* pool, not just "all free"
+    assert mgr.available_blocks == 16
+
+
+def test_blocksan_double_free_fires():
+    mgr, san = _mgr()
+    blocks = mgr.allocator.allocate(2)
+    mgr.allocator.free(blocks)
+    with pytest.raises(BlockSanError, match="double-free: block"):
+        mgr.allocator.free([blocks[0]])
+
+
+def test_blocksan_negative_refcount_fires():
+    mgr, san = _mgr()
+    blocks = mgr.allocator.allocate(1)
+    mgr.allocator.decref(blocks)        # 1 -> 0 (legal)
+    with pytest.raises(BlockSanError, match="negative refcount"):
+        mgr.allocator.decref(blocks)    # 0 -> would go negative
+
+
+def test_blocksan_use_after_free_incref_fires():
+    mgr, san = _mgr()
+    blocks = mgr.allocator.allocate(1)
+    mgr.allocator.free(blocks)
+    with pytest.raises(BlockSanError, match="use-after-free"):
+        mgr.allocator.incref(blocks)
+
+
+def test_blocksan_cap_path_leak_names_allocation_site():
+    """Mutation-style seeded fault (acceptance): re-introduce the PR 4
+    cap-path leak shape — sever PrefixCache.free_sink so a cap
+    eviction drops the block — and the conservation check at the next
+    flush names the leaked block AND the stack that allocated it."""
+    mgr, san = _mgr(cache=PrefixCache(8, max_cached_blocks=1))
+    mgr.extend(1, list(range(9)))               # 2 blocks, 1 full
+    mgr.seqs[1].seen = 9
+    mgr.publish_full_blocks(mgr.seqs[1])
+    mgr.flush(1)                                # full block parks in LRU
+    mgr.cache.free_sink = None                  # the PR 4 bug, reborn
+    mgr.extend(2, list(range(100, 109)))
+    mgr.seqs[2].seen = 9
+    mgr.publish_full_blocks(mgr.seqs[2])        # cap evicts -> leaked
+    with pytest.raises(BlockSanError) as ei:
+        mgr.flush(2)
+    msg = str(ei.value)
+    assert "leaked" in msg
+    # provenance: the allocation stack names ragged's extend AND this
+    # test as the requester
+    assert "extend" in msg and "test_graftsan" in msg
+
+
+def test_blocksan_missed_transition_detected():
+    """A free-routing path that bypasses the audited choke point
+    (raw _free.append) shows up as mirror drift at the next quiesce —
+    the sanitizer polices its own coverage."""
+    mgr, san = _mgr()
+    blocks = mgr.allocator.allocate(1)
+    mgr.allocator._ref[blocks[0]] = 0
+    mgr.allocator._free.append(blocks[0])       # bypasses free()
+    with pytest.raises(BlockSanError, match="missed a free-list"):
+        san.check_conservation(mgr.allocator, mgr.cache, "unit")
+
+
+def test_blocksan_warn_mode_counts_without_raising():
+    mgr = DSStateManager(block_size=8, num_blocks=8, max_blocks_per_seq=8)
+    san = BlockSanitizer(8, mode="warn")
+    mgr.attach_sanitizer(san)
+    blocks = mgr.allocator.allocate(1)
+    mgr.allocator.free(blocks)
+    mgr.allocator.free(blocks)                  # double free: warns
+    assert san.counters["violations"] == 1
+    assert any("double-free" in v for v in san.violation_log)
+
+
+def test_blocksan_journal_and_snapshot_schema():
+    mgr, san = _mgr()
+    blocks = mgr.allocator.allocate(3)
+    mgr.allocator.incref(blocks)
+    mgr.allocator.decref(blocks)
+    tail = san.journal_tail()
+    assert [e["op"] for e in tail] == ["allocate", "incref", "decref"]
+    assert all("site" in e and ":" in e["site"] for e in tail)
+    snap = san.snapshot()
+    assert set(snap) == {"pool_size", "mode", "counters", "violations",
+                         "journal_tail"}
+    assert snap["pool_size"] == 16
+
+
+def test_blocksan_journal_rides_hang_dump(tmp_path):
+    """Watchdog forensics (ISSUE 11 satellite): while a sanitizer is
+    registered, every hang dump embeds its journal tail + counters."""
+    from deepspeed_tpu.telemetry import flightrec
+    mgr, san = _mgr()
+    mgr.allocator.allocate(2)
+    set_blocksan(san)
+    try:
+        path = flightrec.dump_state("unit-test", str(tmp_path),
+                                    recorder=None)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["blocksan"]["counters"]["ops"] >= 1
+        assert doc["blocksan"]["journal_tail"][-1]["op"] == "allocate"
+    finally:
+        set_blocksan(None)
+    assert get_blocksan() is None
+
+
+def test_blocksan_violation_counter_reaches_telemetry_report():
+    """Warn-mode violations bump ds_blocksan_violations_total in the
+    registry, and telemetry_report's serving summary surfaces it."""
+    from deepspeed_tpu import telemetry
+    telemetry.shutdown()
+    telemetry.configure()
+    try:
+        mgr = DSStateManager(block_size=8, num_blocks=8,
+                             max_blocks_per_seq=8)
+        san = BlockSanitizer(8, mode="warn")
+        mgr.attach_sanitizer(san)
+        blocks = mgr.allocator.allocate(1)
+        mgr.allocator.free(blocks)
+        mgr.allocator.free(blocks)
+        reg = telemetry.get_registry()
+        assert reg.counter("ds_blocksan_violations_total").value(
+            kind="double-free") == 1
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report",
+            os.path.join(REPO, "tools", "telemetry_report.py"))
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+        summary = tr.serving_summary(
+            {"ds_blocksan_violations_total/kind=double-free": 1.0,
+             "ds_other_metric": 5.0})
+        assert summary == {
+            "ds_blocksan_violations_total/kind=double-free": 1.0}
+    finally:
+        telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------
+# thread-affinity checker (host-only)
+# ---------------------------------------------------------------------
+
+def _check_in_thread(checker, label="unit"):
+    caught = []
+
+    def run():
+        try:
+            checker.check(label)
+        except AffinityError as e:
+            caught.append(str(e))
+    t = threading.Thread(target=run, name="intruder")
+    t.start()
+    t.join()
+    return caught
+
+
+def test_affinity_checker_raises_from_other_thread():
+    ch = ThreadAffinityChecker()
+    ch.check("warmup")          # auto-binds this (owning) thread
+    ch.check("steady")          # same thread: fine
+    caught = _check_in_thread(ch)
+    assert len(caught) == 1 and "intruder" in caught[0]
+    assert ch.violations == 1
+
+
+def test_affinity_rebind_and_unbind():
+    ch = ThreadAffinityChecker()
+    ch.bind()
+    done = []
+
+    def worker():
+        ch.bind(force=True)     # deliberate ownership transfer
+        ch.check("from-worker")
+        done.append(True)
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert done == [True]
+    with pytest.raises(AffinityError):
+        ch.check("main-after-transfer")
+    ch.unbind()
+    ch.check("rebound")         # auto-binds main again
+    assert ch.violations == 1
+
+
+def test_affinity_warn_mode_counts():
+    ch = ThreadAffinityChecker(mode="warn")
+    ch.bind()
+    assert _check_in_thread(ch) == []
+    assert ch.violations == 1
+
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.delenv("DS_GRAFTSAN", raising=False)
+    assert not env_enabled()
+    monkeypatch.setenv("DS_GRAFTSAN", "0")
+    assert not env_enabled()
+    monkeypatch.setenv("DS_GRAFTSAN", "1")
+    assert env_enabled()
+
+
+# ---------------------------------------------------------------------
+# engine-integrated acceptance (conftest._SLOW — engine builds)
+# ---------------------------------------------------------------------
+
+def _v2_engine(**over):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    kw = dict(dtype="float32", kv_block_size=8, num_kv_blocks=64,
+              max_chunk_size=16, fused_decode_steps=4)
+    kw.update(over)
+    return InferenceEngineV2(Llama(size="tiny"),
+                             RaggedInferenceEngineConfig(**kw))
+
+
+def test_generate_fused_park_restore_conservation(devices8):
+    """Acceptance: generate_fused with the sanitizer on produces the
+    SAME tokens as off, with zero violations and full pool
+    conservation — then a park/restore roundtrip (the preemption KV
+    swap-out) quiesces clean and resumes position-exactly."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 512, 7).tolist() for _ in range(3)]
+    e_off = _v2_engine(prefix_cache={"enabled": True})
+    ref = e_off.generate_fused(prompts, max_new_tokens=6)
+    e = _v2_engine(prefix_cache={"enabled": True},
+                   graftsan={"enabled": True})
+    assert e._blocksan is not None and e._affinity is not None
+    out = e.generate_fused(prompts, max_new_tokens=6)
+    assert out == ref
+    san = e._blocksan
+    assert san.counters["violations"] == 0
+    assert san.counters["quiesce_checks"] >= len(prompts)
+    assert e.state_manager.available_blocks == 64
+
+    # park/restore roundtrip through the serve loop's preemption path
+    from deepspeed_tpu.inference.v2.serve_loop import FusedServeLoop
+    loop = FusedServeLoop(e, k_steps=4)
+    # budget large enough that three scheduler steps cannot finish it
+    uid = loop.submit(prompts[0], 32)
+    for _ in range(3):
+        loop.step()
+    mgr = e.state_manager
+    assert uid in mgr.seqs
+    req = loop.live[uid]
+    tokens = mgr.park(uid)                  # KV swap-out (quiesces)
+    assert uid not in mgr.seqs
+    mgr.extend(uid, tokens)                 # restore: re-admit history
+    mgr.seqs[uid].seen = len(tokens) - 1    # all but the pending token
+    mgr.flush(uid)
+    loop.live.pop(uid, None)
+    assert san.counters["violations"] == 0
+    assert mgr.available_blocks == 64
+    assert req.generated                    # the roundtrip saw tokens
+
+
+def test_engine_dispatch_from_wrong_thread_raises(devices8):
+    """The runtime affinity checker (GL050's dynamic half): after the
+    owning thread warms the engine, a dispatch from any other thread
+    raises AffinityError instead of racing the scheduler state."""
+    e = _v2_engine(graftsan={"enabled": True})
+    logits = e.put([0], [[1, 2, 3, 4]])     # binds this thread
+    import jax.numpy as jnp
+    e.state_manager.extend(0, [int(jnp.argmax(logits[0]))])
+    caught = []
+
+    def intrude():
+        try:
+            e.decode_fused([0], k_steps=2, budgets={0: 2})
+        except AffinityError as e_:
+            caught.append(str(e_))
+    t = threading.Thread(target=intrude, name="wrong-thread")
+    t.start()
+    t.join()
+    assert caught and "wrong-thread" in caught[0]
+    e.flush(0)
+    assert e._blocksan.counters["violations"] == 0
+
+
+def test_async_server_rebinds_worker_thread(devices8):
+    """The async server's worker re-stamps engine ownership at loop
+    start and releases it on exit: serving works sanitized, and the
+    main thread can drive the engine again after stop()."""
+    import asyncio
+    from deepspeed_tpu.serving import AsyncInferenceServer, ServingConfig
+    e = _v2_engine(graftsan={"enabled": True})
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    ref = e.generate_fused(prompts, max_new_tokens=6, k_steps=3)
+
+    async def main():
+        async with AsyncInferenceServer(e, ServingConfig(k_steps=3)) as s:
+            hs = [await s.submit(p, max_new_tokens=6) for p in prompts]
+            return [await h.tokens() for h in hs]
+
+    outs = asyncio.run(main())
+    assert outs == ref
+    assert e._blocksan.counters["violations"] == 0
+    assert e.state_manager.available_blocks == 64
+    # ownership released on worker exit: the main thread binds again
+    again = e.generate_fused(prompts, max_new_tokens=6, k_steps=3)
+    assert again == ref
