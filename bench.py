@@ -1010,6 +1010,166 @@ def spec_bench(ds, on_tpu: bool):
             "decoded_tokens": n_tok}
 
 
+def kvquant_bench(ds, on_tpu: bool):
+    """Quantized KV cache (ISSUE 12): int8 pools with per-vector
+    scales, dequant fused into the paged-decode attention.
+
+    Four figures, each against an UNQUANTIZED engine of the same model
+    and compute dtype:
+
+    - ``max_resident_batch`` (gated +1): concurrent (prompt + budget)
+      requests the pool admits at EQUAL KV pool bytes — the quantized
+      allocator is sized in quantized bytes, so the same HBM budget
+      holds proportionally more blocks (the 2-4x resident-requests
+      headline; exact ratio = full-precision over quantized
+      bytes/token, reported as ``resident_batch_ratio``).
+    - ``kv_bytes_per_token`` (gated -1): storage cost per cached token
+      in the active format (deterministic layout arithmetic).
+    - ``tokens_per_sec_int8`` vs ``tokens_per_sec_fp`` (equal pool
+      bytes) and ``tokens_per_sec_fp_equal_blocks`` (a full-precision
+      pool with the SAME block count the quantized pool holds, i.e.
+      what matching the quantized engine's resident capacity costs
+      unquantized): greedy fused decode at matched batch. CAVEAT (CPU
+      rig): interpret-mode Pallas pays a pool-BYTES-proportional
+      emulation cost per dispatch plus emulated dequant multiplies, so
+      int8-vs-fp at equal bytes reads SLOWER here — the honest CPU
+      figure is the equal-blocks one (same resident capacity: the
+      int8 pool is ~2x faster AND 3-4x smaller). On TPU the dequant
+      is an in-register VPU multiply against halved-to-quartered pool
+      HBM traffic; re-baseline there.
+    - accuracy: ``greedy_parity_horizon`` — tokens until the first
+      greedy divergence vs the fp pool (min over the batch; the
+      horizon the ISSUE pins) — and ``spec_acceptance_delta``: the
+      prompt-lookup acceptance rate must move <2% absolute when the
+      verify forward reads quantized KV (speculation reads the same
+      pool as plain decode, so the drafter/acceptance machinery sees
+      quantization only through the logits)."""
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    if on_tpu:
+        model = Llama(hidden_size=1024, num_layers=12, num_heads=8,
+                      num_kv_heads=8, intermediate_size=2816,
+                      vocab_size=32000, max_seq_len=2048)
+        bs, nb, chunk = 64, 128, 256
+        B, P, N, K = 8, 128, 64, 8
+        n_spec = 512
+    else:
+        model = Llama(size="tiny", max_seq_len=768)
+        bs, nb, chunk = 8, 128, 32
+        B, P, N, K = 4, 16, 32, 4
+        n_spec = 320
+    dtype = "bfloat16" if on_tpu else "float32"
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    prompts = [rng.integers(0, vocab, P).tolist() for _ in range(B)]
+
+    def eng(quant, grow=True, **over):
+        kv = ({"enabled": True, "dtype": "int8", "grow_pool": grow}
+              if quant else {"enabled": False})
+        kw = dict(dtype=dtype, kv_block_size=bs, num_kv_blocks=nb,
+                  max_chunk_size=chunk, max_ragged_sequence_count=64,
+                  kv_cache=kv)
+        kw.update(over)
+        return InferenceEngineV2(model,
+                                 RaggedInferenceEngineConfig(**kw))
+
+    e_fp = eng(False)
+    e_q = eng(True)
+    # equal-budget accounting: the quantized pool must not exceed the
+    # fp pool's bytes while holding more blocks
+    assert e_q.kv_pool_bytes() <= e_fp.kv_pool_bytes(), \
+        (e_q.kv_pool_bytes(), e_fp.kv_pool_bytes())
+    bpr = -(-(P + N) // bs)          # blocks one resident request pins
+    resident_fp = e_fp.num_kv_blocks // bpr
+    resident_q = e_q.num_kv_blocks // bpr
+    ratio = resident_q / max(resident_fp, 1)
+    if dtype == "float32":
+        # CPU rig: fp32 -> int8(+scales) is >= 2x by construction; a
+        # regression here means the scale layout grew
+        assert ratio >= 2.0, (resident_q, resident_fp)
+
+    def timed_decode(e):
+        """Greedy fused decode at MATCHED batch (both engines hold >= B
+        requests): best-of-3 tokens/s over warmed drives."""
+        e.generate_fused(prompts, max_new_tokens=2 * K,
+                         k_steps=K)                  # compile the path
+        e.reset_serving_metrics()
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = e.generate_fused(prompts, max_new_tokens=N, k_steps=K)
+            wall = time.perf_counter() - t0
+            best = max(best, sum(len(o) for o in out) / max(wall, 1e-9))
+        return out, best
+
+    out_fp, tps_fp = timed_decode(e_fp)
+    out_q, tps_q = timed_decode(e_q)
+    # equal-RESIDENT-CAPACITY comparison: a full-precision pool sized
+    # to the quantized pool's block count (3-4x the bytes)
+    _, tps_fp_big = timed_decode(
+        eng(False, num_kv_blocks=e_q.num_kv_blocks)) \
+        if e_q.num_kv_blocks != e_fp.num_kv_blocks else (out_fp, tps_fp)
+    horizon = min(
+        next((i for i, (a, b) in enumerate(zip(of, oq)) if a != b),
+             len(of))
+        for of, oq in zip(out_fp, out_q))
+
+    # spec acceptance under quantized KV: the spec stage's repetitive
+    # long-horizon workload (greedy cycles past burn-in), fp vs int8
+    # pools. MANY streams on purpose: per-stream steady-state
+    # acceptance depends on which cycle the (slightly different) token
+    # stream settles into, so the comparable figure is the average —
+    # 12+ streams holds the fp-vs-int8 delta under the 2% acceptance
+    # bound (4 streams showed 4% of pure cycle-assignment noise).
+    # grow_pool=False: equal block COUNT, so both sides run the same
+    # admission schedule and the int8 pool's smaller bytes keep the
+    # interpret-mode dispatch affordable.
+    b_s, n_s = (8, 384) if on_tpu else (12, n_spec)
+    sp_prompts = [rng.integers(0, vocab, P).tolist() for _ in range(b_s)]
+    nb_s = -(-(P + n_s) // bs) * b_s
+
+    def spec_accept(quant):
+        e = eng(quant, grow=False, num_kv_blocks=nb_s,
+                speculative={"enabled": True, "draft_len": 4,
+                             "min_ngram": 2})
+        e.generate_fused(sp_prompts, max_new_tokens=2 * K, k_steps=K)
+        e.reset_serving_metrics()
+        e.generate_fused(sp_prompts, max_new_tokens=n_s, k_steps=K)
+        return e.serving_metrics()["spec_acceptance_rate"]
+
+    acc_fp = spec_accept(False)
+    acc_q = spec_accept(True)
+
+    # mirror the kv gauges into the stage's --telemetry artifacts
+    from deepspeed_tpu.utils.telemetry_probe import active_telemetry
+    tel = active_telemetry()
+    reg = tel.get_registry() if tel is not None else None
+    if reg is not None:
+        tel.bridges.collect_serving(reg, e_q.serving_metrics())
+    return {"metric": "kvquant_max_resident_batch", "value": resident_q,
+            "unit": "requests", "kv_dtype": e_q.kv_dtype,
+            "max_resident_batch": resident_q,
+            "resident_batch_fp": resident_fp,
+            "resident_batch_ratio": round(ratio, 2),
+            "kv_bytes_per_token": round(e_q.kv_bytes_per_token(), 2),
+            "kv_bytes_per_token_fp": round(e_fp.kv_bytes_per_token(), 2),
+            "kv_pool_bytes": e_q.kv_pool_bytes(),
+            "kv_pool_bytes_fp": e_fp.kv_pool_bytes(),
+            "kv_num_blocks": e_q.num_kv_blocks,
+            "kv_num_blocks_fp": e_fp.num_kv_blocks,
+            "tokens_per_sec_int8": round(tps_q, 1),
+            "tokens_per_sec_fp": round(tps_fp, 1),
+            "tokens_per_sec_fp_equal_blocks": round(tps_fp_big, 1),
+            "greedy_parity_horizon": horizon,
+            "decode_horizon": N,
+            "spec_acceptance_int8": round(acc_q, 3),
+            "spec_acceptance_fp": round(acc_fp, 3),
+            "spec_acceptance_delta": round(abs(acc_q - acc_fp), 4),
+            "batch": B, "prompt_tokens": P, "k_steps": K}
+
+
 def moe_serving_bench(ds, on_tpu: bool):
     """MoE serving (reference: inference/v2 cutlass_ops moe_gemm +
     mixed_gemm). Decode MoE is EXPERT-WEIGHT-READ bound: every live
@@ -1857,6 +2017,7 @@ STAGES = [("headline", headline_bench),
           ("moe", moe_bench), ("serving", serving_bench),
           ("prefix", prefix_bench),
           ("spec", spec_bench),
+          ("kvquant", kvquant_bench),
           ("serve_openloop", serve_openloop_bench),
           ("moe_serving", moe_serving_bench),
           ("offload", offload_smoke),

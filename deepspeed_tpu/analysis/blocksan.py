@@ -108,6 +108,19 @@ class BlockSanitizer:
         self.alloc_site: dict[int, str] = {}
         self.counters = {"ops": 0, "violations": 0, "quiesce_checks": 0}
         self.violation_log: list[str] = []
+        # quantized-KV scale-pool mirror (ISSUE 12): when attached, a
+        # block's scale slot goes live on allocate and dies on free —
+        # conservation asserts the scale partition tracks the KV
+        # partition slot-for-slot (a scale slot outliving its freed
+        # block, or missing from a live one, is a finding)
+        self.scale_slots: Optional[set[int]] = None
+
+    def attach_scale_pool(self) -> None:
+        """Audit the quantized pool's scale slabs alongside the KV
+        payload (ISSUE 12 satellite): the scale pool shares the KV
+        pool's block indices, so its live slots must partition
+        IDENTICALLY to the non-free blocks at every quiesce point."""
+        self.scale_slots = set()
 
     # -- plumbing ------------------------------------------------------
     def _journal(self, op: str, blocks, site: str) -> None:
@@ -139,6 +152,8 @@ class BlockSanitizer:
             self.freed.discard(b)
             self.ref[b] = 1
             self.alloc_site[b] = site
+            if self.scale_slots is not None:
+                self.scale_slots.add(b)
 
     def on_free(self, blocks) -> None:
         site = _call_site()
@@ -151,6 +166,8 @@ class BlockSanitizer:
                 continue
             self.freed.add(b)
             self.ref[b] = 0
+            if self.scale_slots is not None:
+                self.scale_slots.discard(b)
 
     def on_incref(self, blocks) -> None:
         site = _call_site()
@@ -220,6 +237,27 @@ class BlockSanitizer:
                 f"journal missed a free-list transition on blocks "
                 f"{sorted(drift)} (a free-routing path bypassed the "
                 "audited choke point)")
+        if self.scale_slots is not None:
+            # quantized KV (ISSUE 12): scale slots must partition the
+            # pool exactly as the payload blocks do — a live block
+            # without its scale slot reads garbage scales; a scale slot
+            # on a freed block is a leaked slot the next occupant will
+            # inherit
+            expect = set(range(self.n)) - free
+            leaked_s = self.scale_slots - expect
+            missing_s = expect - self.scale_slots
+            if leaked_s:
+                sites = "; ".join(
+                    f"block {b} allocated at {self._provenance(b)}"
+                    for b in sorted(leaked_s))
+                problems.append(
+                    f"scale slots {sorted(leaked_s)} leaked — live "
+                    f"scale entries on freed blocks ({sites})")
+            if missing_s:
+                problems.append(
+                    f"blocks {sorted(missing_s)} are live without a "
+                    "scale slot — their quantized payload would "
+                    "dequantize through stale scales")
         if problems:
             self._fail(f"conservation at quiesce point '{label}': "
                        + " | ".join(problems), "conservation")
@@ -234,6 +272,8 @@ class BlockSanitizer:
         this in every watchdog dump while a sanitizer is active)."""
         return {"pool_size": self.n,
                 "mode": self.mode,
+                "scale_slots": (len(self.scale_slots)
+                                if self.scale_slots is not None else None),
                 "counters": dict(self.counters),
                 "violations": list(self.violation_log[-16:]),
                 "journal_tail": self.journal_tail()}

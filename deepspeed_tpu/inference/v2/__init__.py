@@ -1,7 +1,7 @@
 """FastGen-equivalent inference (reference: deepspeed/inference/v2/)."""
 
-from .engine_v2 import (InferenceEngineV2, PrefixCacheConfig,  # noqa: F401
-                        RaggedInferenceEngineConfig)
+from .engine_v2 import (InferenceEngineV2, KVCacheConfig,  # noqa: F401
+                        PrefixCacheConfig, RaggedInferenceEngineConfig)
 from .engine_factory import SUPPORTED_MODEL_TYPES, build_engine  # noqa: F401
 from .ragged import (BlockedAllocator, DSStateManager,  # noqa: F401
                      PrefixCache)

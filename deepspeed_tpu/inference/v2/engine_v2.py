@@ -36,7 +36,8 @@ from ..config import DeepSpeedInferenceConfig
 from .paged import (fused_decode_loop, fused_serve_loop,
                     fused_spec_decode_loop, fused_spec_serve_loop,
                     paged_forward)
-from .ragged import (PrefixCache, DSStateManager, SequenceDescriptor)
+from .ragged import (PrefixCache, DSStateManager, SequenceDescriptor,
+                     kv_block_bytes, quantized_block_budget)
 
 PyTree = Any
 
@@ -183,6 +184,43 @@ class SpeculativeConfig(DeepSpeedConfigModel):
         return self
 
 
+class KVCacheConfig(DeepSpeedConfigModel):
+    """Quantized KV cache (ISSUE 12): the paged KV pools store int8 or
+    fp8-e4m3 codes with symmetric per-vector f32 scales riding the
+    block tables in their own scale slabs (``pools["ks"]/["vs"]``, one
+    scale per written (token, kv-head) vector — or per token with
+    ``granularity="token"``). Dequantization is fused into the
+    consumers — in-register inside the Pallas paged-decode fold, a
+    fused multiply on the jnp reference path — so quantized blocks are
+    read straight from HBM with no materialized fp16 copy, and
+    quantize-on-write happens once in the same graph as the pool
+    scatter. With ``grow_pool`` the allocator is sized in QUANTIZED
+    bytes: the HBM budget of ``num_kv_blocks`` full-precision blocks
+    yields 2-4x more quantized blocks, i.e. 2-4x more resident
+    requests per chip. Off by default; the disabled path is
+    byte-identical to an engine without the feature (no scale slabs,
+    same executables). Accuracy model, dtype-selection guidance and
+    the metric guide live in docs/serving.md."""
+    enabled: bool = False
+    # storage format of the KV payload pools: "fp16" keeps the
+    # engine's compute dtype (quantization off even when enabled —
+    # the explicit no-op rung of the dtype ladder); int8 = symmetric
+    # [-127, 127] codes; fp8 = native float8_e4m3fn
+    dtype: Literal["fp16", "int8", "fp8"] = "int8"
+    # scale granularity: "head" = one f32 scale per written
+    # (token, kv-head) vector of head_dim elements (tightest, the
+    # default); "token" = one scale across all kv heads of a token
+    # (1/num_kv_heads of the scale memory, slightly coarser). Both are
+    # write-once — no read-modify-requantize of earlier tokens, which
+    # is what keeps cached quantized blocks bit-stable under sharing.
+    granularity: Literal["head", "token"] = "head"
+    # size the pool in quantized bytes: grow num_kv_blocks to fill the
+    # HBM budget the configured full-precision pool would have used.
+    # False = keep the configured block count (pool bytes shrink
+    # instead — the parity/testing mode).
+    grow_pool: bool = True
+
+
 class GraftsanConfig(DeepSpeedConfigModel):
     """Runtime concurrency/KV-accounting sanitizers (ISSUE 11,
     ``analysis/blocksan.py`` — the runtime half of the graftsan
@@ -249,6 +287,10 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     # default — zero overhead, nothing imported.
     sentinels: bool = False
     sentinel_mode: str = "raise"          # or "warn"
+    # quantized KV cache (ISSUE 12): int8/fp8 pools with per-vector
+    # scales, dequant fused into the paged-decode consumers, allocator
+    # sized in quantized bytes (see docs/serving.md)
+    kv_cache: KVCacheConfig = Field(default_factory=KVCacheConfig)
     # automatic prefix caching: ref-counted KV block sharing with
     # hash-chained reuse across requests (see docs/serving.md)
     prefix_cache: PrefixCacheConfig = Field(
@@ -280,9 +322,36 @@ class InferenceEngineV2:
 
         bs = config.kv_block_size
         max_blocks_per_seq = -(-c.max_seq_len // bs)
+
+        # quantized KV cache (ISSUE 12): pool dtype, scale layout and
+        # the block budget are resolved BEFORE the state manager so the
+        # allocator is sized in quantized bytes — the HBM budget of
+        # num_kv_blocks full-precision blocks yields proportionally
+        # more quantized blocks (grow_pool), i.e. more resident
+        # requests at equal pool bytes.
+        kvc = config.kv_cache
+        self._kv_quant = bool(kvc.enabled and kvc.dtype != "fp16")
+        self._kv_scale_heads = (1 if kvc.granularity == "token"
+                                else c.num_kv_heads)
+        full_bytes = kv_block_bytes(
+            bs, c.num_kv_heads, c.head_dim,
+            np.dtype(self.dtype).itemsize)
+        if self._kv_quant:
+            self._kv_block_bytes = kv_block_bytes(
+                bs, c.num_kv_heads, c.head_dim, 1,
+                scale_heads=self._kv_scale_heads)
+            nb = (quantized_block_budget(config.num_kv_blocks,
+                                         full_bytes,
+                                         self._kv_block_bytes)
+                  if kvc.grow_pool else config.num_kv_blocks)
+        else:
+            self._kv_block_bytes = full_bytes
+            nb = config.num_kv_blocks
+        self.num_kv_blocks = nb
+
         pc = config.prefix_cache
         self.state_manager = DSStateManager(
-            block_size=bs, num_blocks=config.num_kv_blocks,
+            block_size=bs, num_blocks=nb,
             max_blocks_per_seq=max_blocks_per_seq,
             prefix_cache=(PrefixCache(
                 block_size=bs, min_match_blocks=pc.min_match_blocks,
@@ -291,8 +360,7 @@ class InferenceEngineV2:
         # logits of sequences finished as a side effect of another
         # caller's drain loop, held for their owner's next tick()
         self._finished_stash: dict[int, jnp.ndarray] = {}
-        pool_shape = (c.num_layers, config.num_kv_blocks, bs,
-                      c.num_kv_heads, c.head_dim)
+        pool_shape = (c.num_layers, nb, bs, c.num_kv_heads, c.head_dim)
 
         # TP serving (reference: model_implementations/sharding/): the
         # KV pools shard over the kv-heads dim of the v1 engine's tp
@@ -313,10 +381,36 @@ class InferenceEngineV2:
         else:
             pool_spec = P()
         self._pool_sharding = NamedSharding(self.mesh, pool_spec)
-        self.pools = jax.device_put(
-            {"k": jnp.zeros(pool_shape, self.dtype),
-             "v": jnp.zeros(pool_shape, self.dtype)},
-            {"k": self._pool_sharding, "v": self._pool_sharding})
+        if self._kv_quant:
+            from ...ops.pallas.quantization import KV_STORE_DTYPES
+            store = KV_STORE_DTYPES[kvc.dtype]
+            scale_shape = pool_shape[:3] + (self._kv_scale_heads,)
+            # scale slabs shard with their payload's kv-head axis when
+            # per-head (and the pool is head-sharded); per-token scales
+            # have no head axis to shard — replicated
+            scale_spec = (P(None, None, None, "tp")
+                          if pool_spec != P()
+                          and self._kv_scale_heads > 1 else P())
+            scale_sharding = NamedSharding(self.mesh, scale_spec)
+            self._pool_shardings = {
+                "k": self._pool_sharding, "v": self._pool_sharding,
+                "ks": scale_sharding, "vs": scale_sharding}
+            # zero-init scales dequantize untouched slots to exact 0.0
+            # — the same dead-slot semantics as the fp16 pools, so the
+            # kernel's sanitize_pools=False fast path stays valid
+            self.pools = jax.device_put(
+                {"k": jnp.zeros(pool_shape, store),
+                 "v": jnp.zeros(pool_shape, store),
+                 "ks": jnp.zeros(scale_shape, jnp.float32),
+                 "vs": jnp.zeros(scale_shape, jnp.float32)},
+                dict(self._pool_shardings))
+        else:
+            self._pool_shardings = {"k": self._pool_sharding,
+                                    "v": self._pool_sharding}
+            self.pools = jax.device_put(
+                {"k": jnp.zeros(pool_shape, self.dtype),
+                 "v": jnp.zeros(pool_shape, self.dtype)},
+                dict(self._pool_shardings))
         # one jit; XLA caches one executable per bucket shape. tick() is
         # one dispatch per scheduler tick (logits_gather fused into the
         # step); for generation loops where per-dispatch latency matters
@@ -330,8 +424,7 @@ class InferenceEngineV2:
             functools.partial(paged_forward, self.model,
                               use_kernel=(tp <= 1)),
             donate_argnums=(1,),
-            out_shardings=(None, {"k": self._pool_sharding,
-                                  "v": self._pool_sharding}))
+            out_shardings=(None, dict(self._pool_shardings)))
         # fused-decode executables: one per (num_steps, sampling, eos)
         # combination; XLA adds a per-bucket-shape cache underneath
         self._fused_cache: dict = {}
@@ -362,8 +455,13 @@ class InferenceEngineV2:
             from ...analysis import blocksan as _bsan
             if gs.blocksan:
                 self._blocksan = _bsan.BlockSanitizer(
-                    config.num_kv_blocks, mode=gs.mode,
+                    self.num_kv_blocks, mode=gs.mode,
                     journal_size=gs.journal_size)
+                if self._kv_quant:
+                    # the scale pool partitions block-for-block with
+                    # the KV pool; a scale slot outliving (or missing
+                    # from) its block's lifecycle is a finding
+                    self._blocksan.attach_scale_pool()
                 self.state_manager.attach_sanitizer(self._blocksan)
                 # registered process-wide so hang-watchdog dumps embed
                 # the journal tail (telemetry/flightrec.dump_state)
@@ -378,11 +476,10 @@ class InferenceEngineV2:
         # SplitFuse budget, floored to a power of two (bucket shapes must
         # never exceed the configured compute budget)
         self._chunk = 1 << (max(1, config.max_chunk_size).bit_length() - 1)
-        pool_mib = (np.prod(pool_shape) * 2
-                    * np.dtype(self.dtype).itemsize / 2**20)
+        pool_mib = self.kv_pool_bytes() / 2**20
         log_dist(
-            f"InferenceEngineV2: {config.num_kv_blocks} KV blocks x {bs} "
-            f"tokens ({pool_mib:.1f} MiB)")
+            f"InferenceEngineV2: {nb} KV blocks x {bs} tokens "
+            f"({pool_mib:.1f} MiB, kv dtype {self.kv_dtype})")
 
     # ------------------------------------------------------------------
     def _run(self, uids: list[int]) -> jnp.ndarray:
@@ -579,6 +676,30 @@ class InferenceEngineV2:
         evicts them on demand)."""
         return self.state_manager.available_blocks
 
+    # ------------------------------------------------------------------
+    # KV-pool byte truth (ISSUE 12): the numbers ds_kv_pool_bytes /
+    # ds_kv_bytes_per_token export and the bench kvquant stage gates
+    @property
+    def kv_dtype(self) -> str:
+        """Storage format of the KV payload pools ("fp16" family names
+        the engine compute dtype when quantization is off)."""
+        return (self._config.kv_cache.dtype if self._kv_quant
+                else str(np.dtype(self.dtype)))
+
+    def kv_pool_bytes(self) -> int:
+        """Actual HBM bytes of the paged KV pools — payload slabs plus
+        (when quantized) the per-vector scale slabs. Computed from the
+        live arrays, so it is definitionally what the ledger's
+        ``memory_analysis()`` sees as pool operand bytes."""
+        return int(sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                       for l in self.pools.values()))
+
+    def kv_bytes_per_token(self) -> float:
+        """KV bytes one cached token costs across all layers (k+v,
+        scales included) — pool bytes over pool token capacity."""
+        return (self.kv_pool_bytes()
+                / (self.num_kv_blocks * self._config.kv_block_size))
+
     def flush(self, uids) -> None:
         """Release finished sequences' KV blocks; accepts one uid or an
         iterable (reference: engine_v2.flush:242 takes uids)."""
@@ -618,7 +739,7 @@ class InferenceEngineV2:
         key = (num_steps, temperature, top_k, top_p, eos_id)
         if key not in self._fused_cache:
             tp = self._v1.topology.model_parallel_size
-            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            pool_sh = dict(self._pool_shardings)
             self._fused_cache[key] = jax.jit(
                 functools.partial(
                     fused_decode_loop, self.model, num_steps=num_steps,
@@ -638,7 +759,7 @@ class InferenceEngineV2:
         key = ("serve", num_steps, temperature, top_k, top_p, eos_id)
         if key not in self._fused_cache:
             tp = self._v1.topology.model_parallel_size
-            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            pool_sh = dict(self._pool_shardings)
             self._fused_cache[key] = jax.jit(
                 functools.partial(
                     fused_serve_loop, self.model, num_steps=num_steps,
@@ -660,7 +781,7 @@ class InferenceEngineV2:
                temperature, top_k, top_p, eos_id)
         if key not in self._fused_cache:
             tp = self._v1.topology.model_parallel_size
-            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            pool_sh = dict(self._pool_shardings)
             self._fused_cache[key] = jax.jit(
                 functools.partial(
                     fused_spec_decode_loop, self.model,
@@ -683,7 +804,7 @@ class InferenceEngineV2:
                temperature, top_k, top_p, eos_id)
         if key not in self._fused_cache:
             tp = self._v1.topology.model_parallel_size
-            pool_sh = {"k": self._pool_sharding, "v": self._pool_sharding}
+            pool_sh = dict(self._pool_shardings)
             self._fused_cache[key] = jax.jit(
                 functools.partial(
                     fused_spec_serve_loop, self.model,
@@ -977,6 +1098,16 @@ class InferenceEngineV2:
         # chain depth
         st["max_inflight_dispatches"] = int(
             self._config.max_inflight_dispatches)
+        # KV-pool byte truth (ISSUE 12): pool footprint + per-token
+        # cost in the ACTIVE storage format, so a quantized engine's
+        # HBM win (and its block-count growth at equal budget) is read
+        # straight off the serving metrics. kv_dtype is a string —
+        # bridges attach it as the ds_kv_pool_bytes gauge's label;
+        # numeric-only consumers (monitor events, --diff) skip it.
+        st["kv_pool_bytes"] = self.kv_pool_bytes()
+        st["kv_bytes_per_token"] = round(self.kv_bytes_per_token(), 3)
+        st["kv_num_blocks"] = int(self.num_kv_blocks)
+        st["kv_dtype"] = self.kv_dtype
         return st
 
     def reset_serving_metrics(self) -> None:

@@ -33,6 +33,16 @@ def gather_pages(pool: jax.Array, block_tables: jax.Array) -> jax.Array:
     return pool[safe].reshape(b, max_blocks * bs, h, d)
 
 
+def gather_scales(spool: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """[num_blocks, bs, Hs] scale pool -> contiguous [B, smax, Hs]
+    per-token scale view through the same block table the payload pool
+    gathers through (ISSUE 12: the scale pool rides the block table)."""
+    b, max_blocks = block_tables.shape
+    bs, hs = spool.shape[1:]
+    safe = jnp.minimum(block_tables, spool.shape[0] - 1)
+    return spool[safe].reshape(b, max_blocks * bs, hs)
+
+
 def place_in_pages(pages: jax.Array, kv: jax.Array, pos0: jax.Array,
                   true_len: jax.Array) -> jax.Array:
     """Overwrite the gathered page view with this chunk's fresh k/v at
@@ -50,7 +60,8 @@ def place_in_pages(pages: jax.Array, kv: jax.Array, pos0: jax.Array,
 
 def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                            pos0, true_len, *, window: int | None = None,
-                           alibi_slopes=None, sanitize_pools: bool = True):
+                           alibi_slopes=None, sanitize_pools: bool = True,
+                           k_scale=None, v_scale=None):
     """Blocked-flash Pallas kernel (reference:
     inference/v2/kernels/ragged_ops/blocked_flash): attention reads KV
     pages straight from the pool through scalar-prefetched block tables —
@@ -66,6 +77,18 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
     q/k_new/v_new: [B, S_new, H(q/kv), D]; pools [nb, bs, Hkv, D];
     block_tables [B, max_blocks] (entries clamped here); pos0/true_len
     [B]. Returns [B, S_new, Hq, D].
+
+    **Quantized pools (ISSUE 12):** with ``k_scale``/``v_scale``
+    ([nb, bs, Hs] f32, ``Hs`` = Hkv per-head or 1 per-token scales)
+    the pools hold int8/fp8 codes and each K/V tile is dequantized
+    IN-REGISTER inside :func:`fold`'s accumulation — one
+    ``codes.astype(f32) * scale`` per tile, fused with the existing
+    position-mask selects, so quantized blocks stream from HBM at 1
+    byte/element with no materialized fp16 copy anywhere. Scale tiles
+    ride the same scalar-prefetched block table (and the same dead-slot
+    DMA-eliding index map) as their payload. The fresh-chunk fold is
+    unquantized — this chunk's k/v arrive exact; quantization happens
+    once, at the pool write after the layer scan.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -75,6 +98,8 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
     rep = hq // hkv
     nb, bs = k_pool.shape[0], k_pool.shape[1]
     max_blocks = block_tables.shape[1]
+    quant = k_scale is not None
+    hs = k_scale.shape[2] if quant else 0     # scale heads (Hkv or 1)
     counts = (-(-jnp.asarray(pos0, jnp.int32) // bs)).astype(jnp.int32)
     tables = jnp.minimum(block_tables, nb - 1).astype(jnp.int32)
     sc = 1.0 / np.sqrt(d)
@@ -84,7 +109,11 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
               if alibi_slopes is not None else None)
 
     def kernel(counts_ref, tables_ref, pos0_ref, tlen_ref, q_ref, kn_ref,
-               vn_ref, kp_ref, vp_ref, o_ref, m_s, l_s):
+               vn_ref, kp_ref, vp_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_s, l_s = rest
+        else:
+            (o_ref, m_s, l_s), ks_ref, vs_ref = rest, None, None
         bi = pl.program_id(0)
         t = pl.program_id(1)
         count = counts_ref[bi]
@@ -97,7 +126,7 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
             m_s[:] = jnp.full_like(m_s, -1e30)
             l_s[:] = jnp.zeros_like(l_s)
 
-        def fold(k_ref_, v_ref_, base, limit):
+        def fold(k_ref_, v_ref_, base, limit, ks_=None, vs_=None):
             """Accumulate one kv block whose rows sit at absolute
             positions base+[0, blk); positions >= limit are dead.
 
@@ -117,6 +146,23 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                 live &= qpos - kpos < window
             rel = ((kpos - qpos).astype(jnp.float32)
                    if slopes is not None else None)
+
+            # quantized pools (ISSUE 12): dequantize the K/V tile
+            # in-register — one f32 convert + scale multiply per kv
+            # head, fused into the same VPU pass as the masks below.
+            # `g % hs` folds the per-token granularity (Hs == 1) onto
+            # its single scale column at trace time.
+            def kload(g):
+                tile = k_ref_[0, :, g, :]
+                if ks_ is None:
+                    return tile
+                return tile.astype(jnp.float32) * ks_[0, :, g % hs][:, None]
+
+            def vload(g):
+                tile = v_ref_[0, :, g, :]
+                if vs_ is None:
+                    return tile
+                return tile.astype(jnp.float32) * vs_[0, :, g % hs][:, None]
             # rows dead for EVERY q position hold pool garbage; zero
             # them on the v side too — p==0 alone doesn't protect the
             # contraction (0 * NaN = NaN). Computed directly in [blk, 1]
@@ -133,17 +179,18 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
                 any_live = (kcol < limit) & (kcol - p0 < tl)
                 if window is not None:
                     any_live &= kcol - p0 + window > 0
-                vclean = [jnp.where(any_live, v_ref_[0, :, g, :], 0)
+                vclean = [jnp.where(any_live, vload(g), 0)
                           for g in range(hq // rep)]     # per kv head
             else:
-                vclean = [v_ref_[0, :, g, :] for g in range(hq // rep)]
+                vclean = [vload(g) for g in range(hq // rep)]
                 # zero-init pools: the cheap additive mask suffices
                 # (computed once, head-independent)
                 neg = jnp.where(live, 0.0, -1e30)
+            kclean = [kload(g) for g in range(hq // rep)]   # per kv head
             parts = []
             for h in range(hq):
                 qv = q_ref[0, :, h, :]                      # [sq, d]
-                kblk = k_ref_[0, :, h // rep, :]            # [blk, d]
+                kblk = kclean[h // rep]                     # [blk, d]
                 s = jnp.dot(qv, kblk.T,
                             preferred_element_type=jnp.float32) * sc
                 if slopes is not None:
@@ -180,7 +227,7 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
 
         @pl.when(page_live)
         def _():
-            fold(kp_ref, vp_ref, t * bs, p0)
+            fold(kp_ref, vp_ref, t * bs, p0, ks_ref, vs_ref)
 
         @pl.when(t == jnp.maximum(count - 1, 0))
         def _():
@@ -206,12 +253,23 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
         return (tb[b, jnp.clip(t, lo, hi)], 0, 0, 0)
 
     pspec = pl.BlockSpec((1, bs, hkv, d), page_idx)
+    in_specs = [qspec, nspec, nspec, pspec, pspec]
+    operands = [q, k_new, v_new, k_pool, v_pool]
+    if quant:
+        # scale tiles ride the same clamped block-table index map as
+        # their payload pages (dead slots share the DMA elision)
+        def scale_idx(b, t, c, tb, p, tl):
+            return page_idx(b, t, c, tb, p, tl)[:3]
+
+        sspec = pl.BlockSpec((1, bs, hs), scale_idx)
+        in_specs += [sspec, sspec]
+        operands += [k_scale, v_scale]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=grid,
-            in_specs=[qspec, nspec, nspec, pspec, pspec],
+            in_specs=in_specs,
             out_specs=qspec,
             scratch_shapes=[pltpu.VMEM((hq * sq, 128), jnp.float32),
                             pltpu.VMEM((hq * sq, 128), jnp.float32)],
@@ -219,7 +277,7 @@ def paged_attention_kernel(q, k_new, v_new, k_pool, v_pool, block_tables,
         out_shape=jax.ShapeDtypeStruct((b, sq, hq, d), jnp.float32),
         interpret=jax.default_backend() != "tpu",
     )(counts, tables, jnp.asarray(pos0, jnp.int32),
-      jnp.asarray(true_len, jnp.int32), q, k_new, v_new, k_pool, v_pool)
+      jnp.asarray(true_len, jnp.int32), *operands)
     return out.astype(q.dtype)
 
 
@@ -275,10 +333,32 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     one. Attention math is unchanged; rows at slots >= ``true_len``
     carry garbage logits the caller must mask (the accept/reject logic
     only ever reads slots < true_len).
+
+    **Quantized KV pools (ISSUE 12):** when ``pools`` carries scale
+    slabs (``"ks"``/``"vs"``, [L, nb, bs, Hs] f32 — present iff the
+    engine's ``kv_cache`` block is enabled), the payload pools hold
+    int8/fp8 codes. Reads dequantize in the consumer (in-register
+    inside the Pallas kernel's fold; a fused multiply on the gathered
+    view in the jnp reference path) and the bulk scatter below
+    quantizes each fresh (token, head) vector ONCE — write-once
+    per-vector scales, so a block's stored bytes are a deterministic
+    function of the tokens written through it (the prefix cache shares
+    quantized blocks bit-stably) and no read-modify-requantize ever
+    touches earlier tokens. The scale slabs live INSIDE the pools
+    PyTree, so every fused loop's ``lax.while_loop`` carry threads
+    them exactly as it threads the payload pools — all serving modes
+    (per-tick, chained, ring, speculative) run quantized unchanged.
+    A token's own chunk attends to its exact (unquantized) k/v — the
+    patched view / fresh-chunk fold; later chunks read the quantized
+    pool. The quantization noise model is in docs/serving.md.
     """
     b, s = tokens.shape
     positions = pos0[:, None] + jnp.arange(s)[None, :]
     x = model.embed(params, tokens, positions=positions)
+    quant = "ks" in pools
+    if quant:
+        from ...ops.pallas.quantization import kv_quantize
+        kv_dtype = ("int8" if pools["k"].dtype == jnp.int8 else "fp8")
 
     # The pool slabs enter the scan only as read-only xs (per-layer
     # slices): each layer gathers its pages, patches this chunk's fresh
@@ -289,7 +369,10 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     alibi = getattr(model, "_alibi_slopes", None)
 
     def body(x, xs):
-        p, k_pool, v_pool = xs
+        if quant:
+            p, k_pool, v_pool, k_scale, v_scale = xs
+        else:
+            (p, k_pool, v_pool), k_scale, v_scale = xs, None, None
         p = model._maybe_dequant(p, x.dtype)
         h = model._norm(x, p["ln1_scale"], p.get("ln1_bias"))
         q, k, v = model._qkv(p, h, positions)
@@ -297,19 +380,32 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         if use_kernel and q.shape[-1] % 8 == 0 and bs_ % 8 == 0:
             # blocked-flash kernel: reads pages via the block table, no
             # gathered [B, smax, H, D] materialization; ALiBi rides as
-            # static per-head slopes
+            # static per-head slopes; quantized pools dequantize
+            # in-register inside the fold (scales ride the same table)
             a = paged_attention_kernel(
                 q, k, v, k_pool, v_pool, block_tables, pos0, true_len,
                 window=model.config.sliding_window, alibi_slopes=alibi,
                 # the engine's pools are zero-initialized (engine_v2
                 # __init__), so dead-slot garbage is unreachable and the
                 # sanitize selects would tax the decode hot loop
-                sanitize_pools=False)
+                sanitize_pools=False,
+                k_scale=k_scale, v_scale=v_scale)
         else:
-            k_pages = place_in_pages(gather_pages(k_pool, block_tables),
-                                     k, pos0, true_len)
-            v_pages = place_in_pages(gather_pages(v_pool, block_tables),
-                                     v, pos0, true_len)
+            k_pages = gather_pages(k_pool, block_tables)
+            v_pages = gather_pages(v_pool, block_tables)
+            if quant:
+                # jnp reference path: dequantize the gathered view (XLA
+                # fuses the multiply into the attention consumer); the
+                # fresh chunk is patched in exact afterwards, matching
+                # the kernel's unquantized fresh-fold
+                ks = gather_scales(k_scale, block_tables)
+                vs = gather_scales(v_scale, block_tables)
+                k_pages = (k_pages.astype(jnp.float32)
+                           * ks[..., :, None]).astype(k.dtype)
+                v_pages = (v_pages.astype(jnp.float32)
+                           * vs[..., :, None]).astype(v.dtype)
+            k_pages = place_in_pages(k_pages, k, pos0, true_len)
+            v_pages = place_in_pages(v_pages, v, pos0, true_len)
             a = paged_attention(q, k_pages, v_pages, pos0,
                                 window=model.config.sliding_window,
                                 alibi_slopes=alibi)
@@ -320,8 +416,10 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         x, _ = model._mlp_residual(p, x)
         return x, (k, v)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], pools["k"], pools["v"]))
+    xs = (params["layers"], pools["k"], pools["v"])
+    if quant:
+        xs = xs + (pools["ks"], pools["vs"])
+    x, (new_k, new_v) = jax.lax.scan(body, x, xs)
 
     # bulk scatter: all layers' chunk k/v into the pools in one update
     nb, bs = pools["k"].shape[1], pools["k"].shape[2]
@@ -329,12 +427,26 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
     off = positions % bs
     valid = jnp.arange(s)[None, :] < true_len[:, None]
     blk = jnp.where(valid, blk, nb)                     # OOB -> dropped
-    new_pools = {
-        "k": pools["k"].at[:, blk, off].set(
-            new_k.astype(pools["k"].dtype), mode="drop"),
-        "v": pools["v"].at[:, blk, off].set(
-            new_v.astype(pools["v"].dtype), mode="drop"),
-    }
+    if quant:
+        # quantize-on-write: each fresh (token, head) vector gets its
+        # own symmetric scale, scattered into the scale pool in the
+        # SAME graph (per-vector write-once — see the docstring)
+        hs = pools["ks"].shape[-1]
+        qk, sk = kv_quantize(new_k, kv_dtype, hs)      # [L,B,S,H(s)]
+        qv, sv = kv_quantize(new_v, kv_dtype, hs)
+        new_pools = {
+            "k": pools["k"].at[:, blk, off].set(qk, mode="drop"),
+            "v": pools["v"].at[:, blk, off].set(qv, mode="drop"),
+            "ks": pools["ks"].at[:, blk, off].set(sk, mode="drop"),
+            "vs": pools["vs"].at[:, blk, off].set(sv, mode="drop"),
+        }
+    else:
+        new_pools = {
+            "k": pools["k"].at[:, blk, off].set(
+                new_k.astype(pools["k"].dtype), mode="drop"),
+            "v": pools["v"].at[:, blk, off].set(
+                new_v.astype(pools["v"].dtype), mode="drop"),
+        }
     if all_logits:
         # speculative verify: every slot's next-token distribution
         return model.unembed(params, x), new_pools
@@ -379,6 +491,12 @@ def fused_decode_loop(model, params: PyTree, pools: PyTree,
     (``DSStateManager.reserve``) so the table is static across the
     fused dispatch while the per-token block/offset arithmetic happens
     in-graph. The loop exits early once every row is inactive.
+
+    ``pools`` may carry quantized payload + scale slabs (ISSUE 12;
+    see :func:`paged_forward`) — the whole dict rides the carry, so
+    the scale pools thread through every chained dispatch exactly as
+    the payload pools do. This holds for all the fused loops below
+    (serve ring, spec, spec-serve) for the same structural reason.
 
     Host-free contract (enforced, not just documented): a dispatch of
     this loop performs NO host<->device transfer — operands arrive as

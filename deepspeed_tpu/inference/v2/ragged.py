@@ -44,6 +44,31 @@ PREFIX_STAT_KEYS = ("prefix_hits", "prefix_misses", "prefix_evictions",
 _CHAIN_ROOT = 0x9E3779B97F4A7C15
 
 
+def kv_block_bytes(block_size: int, num_kv_heads: int, head_dim: int,
+                   payload_itemsize: float,
+                   scale_heads: int = 0) -> int:
+    """HBM bytes ONE block costs per layer, k+v pools together:
+    payload plus (for quantized pools) the f32 per-vector scale slab
+    riding the same block index. The allocator deals in blocks; this
+    is the block -> bytes conversion every sizing/telemetry consumer
+    shares (engine pool build, ``ds_kv_pool_bytes``, the bench
+    ``kvquant`` stage)."""
+    payload = block_size * num_kv_heads * head_dim * payload_itemsize
+    scales = block_size * scale_heads * 4
+    return int(2 * (payload + scales))
+
+
+def quantized_block_budget(num_blocks: int, full_block_bytes: int,
+                           quant_block_bytes: int) -> int:
+    """Blocks the QUANTIZED pool may hold inside the HBM budget of
+    ``num_blocks`` full-precision blocks (ISSUE 12: the allocator is
+    sized in quantized bytes, so the same budget yields 2-4x more
+    resident blocks — never fewer than configured)."""
+    return max(int(num_blocks),
+               int(num_blocks) * int(full_block_bytes)
+               // max(int(quant_block_bytes), 1))
+
+
 @dataclass
 class SequenceDescriptor:
     """reference: ragged/sequence_descriptor.py"""

@@ -141,7 +141,12 @@ SERVING_METRIC_KEYS = ("dispatches_per_token", "fused_occupancy",
                        "spec_hit_slots",
                        "prefix_hit_rate", "prefix_hits", "prefix_misses",
                        "prefix_evictions", "prefill_tokens_saved",
-                       "prefix_cached_blocks", "prefix_evictable_blocks")
+                       "prefix_cached_blocks", "prefix_evictable_blocks",
+                       # quantized KV cache (ISSUE 12) — numeric pool
+                       # footprint only (kv_dtype is a string label and
+                       # stays out of the float event stream)
+                       "kv_pool_bytes", "kv_bytes_per_token",
+                       "kv_num_blocks")
 
 
 def serving_events(metrics: dict, step: int,

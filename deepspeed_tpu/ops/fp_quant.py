@@ -170,6 +170,86 @@ def fp_dequantize(codes: jax.Array, scales: jax.Array, *, q_bits: int = 8,
     return x.astype(dtype)
 
 
+# -------------------------------------------------------- pool rows
+def fp_quantize_rows(x: jax.Array, *, q_bits: int = 8,
+                     mantissa_bits: int = 3, group_size: int = 512):
+    """Row-blocked variant of :func:`fp_quantize` for POOL shapes
+    (ISSUE 12 satellite): ``x``'s leading dims are independent rows
+    (e.g. one KV block's ``block_size x head_dim`` payload per row)
+    and each row's trailing axis is padded to a multiple of
+    ``group_size`` INDEPENDENTLY — pad-and-mask — so no quantization
+    block ever straddles a row boundary.
+
+    :func:`fp_quantize` flattens the whole array before blocking: when
+    the per-row element count (``head_dim x block_size`` for a KV
+    pool) is not a multiple of the quant block, its groups straddle
+    rows — one row's absmax then sets a NEIGHBOUR row's scale, so a
+    write to block B silently changes block A's stored codes. That is
+    the PR 8 ``_flat_padded`` chunk-boundary-straddle lesson applied
+    to pools: pool rows are the sharing/caching unit (the prefix cache
+    hands whole blocks to other sequences), so their bytes must be a
+    function of their own contents ONLY. Padding is masked out of the
+    row by construction (zeros never raise an absmax, and
+    :func:`fp_dequantize_rows` trims them per row before reshaping).
+
+    Returns ``(codes [rows..., padded_or_packed], scales f32
+    [rows..., blocks_per_row])``.
+    """
+    lead, n = x.shape[:-1], x.shape[-1]
+    if n == 0:
+        raise ValueError("fp_quantize_rows needs a non-empty row axis")
+    pad = (-n) % group_size
+    rows = x.reshape(-1, n).astype(jnp.float32)
+    rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    nb = (n + pad) // group_size
+    blocks = rows.reshape(rows.shape[0], nb, group_size)
+    amax = jnp.max(jnp.abs(blocks), axis=-1)             # [R, nb]
+
+    if q_bits == 8:
+        _table(8, mantissa_bits)
+        dt = (jnp.float8_e4m3fn if mantissa_bits == 3 else jnp.float8_e5m2)
+        fmax = float(jnp.finfo(dt).max)
+        scales = jnp.maximum(amax / fmax, 1e-12)
+        codes = (blocks / scales[..., None]).astype(dt)
+        return (codes.reshape(*lead, nb * group_size),
+                scales.reshape(*lead, nb))
+
+    table = _table(q_bits, mantissa_bits)
+    fmax = float(table[-1])
+    scales = jnp.maximum(amax / fmax, 1e-12)
+    y = blocks / scales[..., None]
+    mids = jnp.asarray((table[1:] + table[:-1]) / 2)
+    idx = jnp.searchsorted(mids, jnp.abs(y))
+    sign = (y < 0).astype(jnp.int32)
+    codes = (sign << (q_bits - 1)) | idx.astype(jnp.int32)
+    packed = _pack(codes.reshape(-1, nb * group_size), q_bits)
+    return (packed.reshape(*lead, packed.shape[-1]),
+            scales.reshape(*lead, nb))
+
+
+def fp_dequantize_rows(codes: jax.Array, scales: jax.Array, *,
+                       row_len: int, q_bits: int = 8,
+                       mantissa_bits: int = 3,
+                       dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`fp_quantize_rows`: per-row trim back to
+    ``row_len`` trailing elements (the pad-and-mask contract — rows
+    stay independent through the roundtrip)."""
+    lead = codes.shape[:-1]
+    nb = scales.shape[-1]
+    if q_bits == 8:
+        vals = codes.astype(jnp.float32)
+    else:
+        c = _unpack(codes.reshape(-1, codes.shape[-1]), q_bits)
+        table = _table(q_bits, mantissa_bits)
+        mag_idx = c & (2 ** (q_bits - 1) - 1)
+        sign = jnp.where((c >> (q_bits - 1)) > 0, -1.0, 1.0)
+        vals = (sign * jnp.take(jnp.asarray(table), mag_idx)).reshape(
+            *lead, -1)
+    group = vals.shape[-1] // nb
+    vals = vals.reshape(*lead, nb, group) * scales[..., None]
+    return vals.reshape(*lead, nb * group)[..., :row_len].astype(dtype)
+
+
 class FP_Quantize:
     """API-parity wrapper (reference: deepspeed/ops/fp_quantizer/quantize.py
     FP_Quantize.quantize/dequantize with q_bits 6/8/12)."""

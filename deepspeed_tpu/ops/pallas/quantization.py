@@ -120,6 +120,93 @@ def dequantize_int8(q, s, meta, use_pallas: bool | None = None):
     return x.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
+# ------------------------------------------------------------------
+# KV-pool quantization (ISSUE 12): symmetric per-vector quant/dequant
+# for the paged KV cache. Unlike the wire quantizers above (flat
+# QBLOCK groups bracketing a collective), the KV pool is quantized
+# WRITE-ONCE per token vector — each written (position, kv-head)
+# vector of head_dim elements gets its own scale (granularity "head"),
+# or one scale spans the whole token across heads (granularity
+# "token"). Per-vector scales are what make incremental pool writes
+# sound: a block fills one token at a time across many dispatches, and
+# a shared per-block scale would need a read-modify-requantize of
+# every earlier token whenever a later one raised the block absmax —
+# destroying the write-once determinism the prefix cache shares blocks
+# under. Quantization blocks therefore never straddle tokens (the PR 8
+# boundary-straddle lesson applied to pools), and a cached block's
+# bytes are a pure function of the tokens written through it.
+#
+# Dequantization is plain jnp (``codes.astype(f32) * scale``) so XLA
+# fuses it into the consumer; the paged-decode attention kernel
+# (inference/v2/paged.paged_attention_kernel) performs the same
+# multiply in-register on its pool tiles — quantized blocks are read
+# straight from HBM with no materialized fp16 copy.
+
+KV_STORE_DTYPES = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
+# symmetric range the per-vector absmax maps onto: int8 uses the
+# ZeRO++ [-127, 127] grid; fp8-e4m3 saturates at the format max (448)
+KV_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def kv_quantize(x, kv_dtype: str, scale_heads: int):
+    """Quantize fresh KV vectors for the paged pool.
+
+    ``x`` is ``[..., H, D]`` (any leading batch/layer/seq dims);
+    returns ``(codes [..., H, D] in the storage dtype, scales f32
+    [..., scale_heads])`` where ``scale_heads`` is ``H`` (granularity
+    "head": absmax per (token, kv-head) vector) or ``1`` (granularity
+    "token": one absmax across all heads of the token). The scale
+    layout matches the engine's scale pools, so the caller scatters
+    codes and scales through the same block table."""
+    store = KV_STORE_DTYPES[kv_dtype]
+    qmax = KV_QMAX[kv_dtype]
+    h = x.shape[-2]
+    xf = x.astype(jnp.float32)
+    if scale_heads == 1:
+        amax = jnp.max(jnp.abs(xf), axis=(-2, -1), keepdims=True)[..., 0]
+    else:
+        assert scale_heads == h, (scale_heads, h)
+        amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / qmax, 1e-12)              # [..., Hs]
+    y = xf / scale[..., :, None]
+    if kv_dtype == "int8":
+        codes = jnp.clip(jnp.round(y), -127, 127).astype(store)
+    else:
+        # e4m3 has no inf: clip before the cast so overflow saturates
+        # instead of producing NaN payload bytes
+        codes = jnp.clip(y, -qmax, qmax).astype(store)
+    return codes, scale
+
+
+def kv_dequantize(codes, scales, dtype=jnp.float32):
+    """Inverse of :func:`kv_quantize`: ``codes [..., H, D]`` times the
+    broadcast per-vector ``scales [..., Hs]`` (``Hs`` is H or 1). Plain
+    jnp so XLA fuses the multiply into the first consumer."""
+    return (codes.astype(jnp.float32)
+            * scales[..., :, None]).astype(dtype)
+
+
+def kv_bytes_per_token(num_kv_heads: int, head_dim: int, kv_dtype: str,
+                       scale_heads: int = 0) -> float:
+    """Storage bytes ONE token's k+v vectors cost PER LAYER in a given
+    format — the format-comparison counterpart of
+    ``ragged.kv_block_bytes`` (the engine sizes pools through that;
+    the exported ``ds_kv_bytes_per_token`` gauge is all-layers, from
+    the live arrays). Tests cross-check the two layouts against each
+    other through this. "fp16"/"bf16"/"fp32" are the unquantized
+    baselines (no scales); int8/fp8 add one f32 scale per
+    ``scale_heads`` (0 = per-head granularity default)."""
+    elems = num_kv_heads * head_dim
+    if kv_dtype in ("fp32", "float32"):
+        return 2.0 * elems * 4
+    if kv_dtype in ("fp16", "float16", "bf16", "bfloat16"):
+        return 2.0 * elems * 2
+    if kv_dtype in KV_STORE_DTYPES:
+        hs = scale_heads or num_kv_heads
+        return 2.0 * (elems * 1 + hs * 4)
+    raise ValueError(f"unknown kv dtype {kv_dtype!r}")
+
+
 def quantize_fp8(x):
     """fp8-e4m3 block quantization: native float8 codes + f32 scales.
     Same contract as quantize_int8 — a thin meta adapter over

@@ -165,6 +165,26 @@ def collect_serving(reg: MetricsRegistry, serving_metrics: dict,
             reg.gauge(f"ds_serving_{key}",
                       f"decode-loop efficiency ratio {key}").set(
                 serving_metrics[key], engine=engine_label)
+    # quantized KV cache (ISSUE 12): pool footprint gauges carry the
+    # storage format as a label so fp16/int8/fp8 pools chart as
+    # distinct series at one glance
+    if "kv_pool_bytes" in serving_metrics:
+        kv_dtype = str(serving_metrics.get("kv_dtype", "unknown"))
+        reg.gauge("ds_kv_pool_bytes",
+                  "HBM bytes of the paged KV pools (payload + scale "
+                  "slabs)").set(serving_metrics["kv_pool_bytes"],
+                                dtype=kv_dtype, engine=engine_label)
+        reg.gauge("ds_kv_bytes_per_token",
+                  "KV bytes one cached token costs across all layers "
+                  "(k+v, scales included)").set(
+            serving_metrics.get("kv_bytes_per_token", 0.0),
+            dtype=kv_dtype, engine=engine_label)
+        reg.gauge("ds_kv_num_blocks",
+                  "blocks in the paged KV pool (grown past "
+                  "num_kv_blocks when the quantized pool fills the "
+                  "full-precision HBM budget)").set(
+            serving_metrics.get("kv_num_blocks", 0),
+            dtype=kv_dtype, engine=engine_label)
 
 
 def collect_ledger(reg: MetricsRegistry, peak_flops: float = 0.0) -> None:

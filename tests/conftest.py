@@ -133,6 +133,17 @@ _SLOW = {
     # tier-1; the stochastic/admission-order/EOS/cancel engine sweeps
     # are the heavy tail (the spec path also runs in the bench `spec`
     # stage on every bench invocation)
+    # quantized KV cache (ISSUE 12): quant math, sizing, kernel parity
+    # and the short-horizon greedy pin stay tier-1; every multi-engine
+    # serving-mode/prefix/park/spec variant is the heavy tail (the
+    # same paths also run in the bench `kvquant` stage)
+    ("test_kv_quant.py", "test_quant_all_serving_modes_bit_agree"),
+    ("test_kv_quant.py", "test_quant_prefix_warm_hit_deterministic"),
+    ("test_kv_quant.py", "test_quant_park_restore_roundtrip"),
+    ("test_kv_quant.py", "test_quant_zero_recompile_steady_state"),
+    ("test_kv_quant.py",
+     "test_quant_speculative_counts_and_determinism"),
+    ("test_device_truth.py", "test_quantized_kv_pool_ledger_footprint"),
     ("test_spec_decode.py", "test_spec_stochastic_schedule_invariance"),
     ("test_spec_decode.py", "test_spec_admission_order_invariance"),
     ("test_spec_decode.py", "test_spec_eos_and_constrained_ring_parity"),

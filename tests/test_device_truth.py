@@ -299,6 +299,49 @@ def test_fused_decode_ledger_entries():
     assert "v2_dispatch" in kinds and "v2_drain" in kinds
 
 
+def test_quantized_kv_pool_ledger_footprint():
+    """Quantized KV cache (ISSUE 12 satellite): the ledger's
+    ``memory_analysis()`` truth must SEE the quantized pool's HBM win —
+    at equal block count (grow_pool=False), the fused dispatch's
+    argument bytes shrink by ~the pool-byte difference the engine's
+    own kv_pool_bytes() accounting predicts (the fp32 pool is 3.2x the
+    int8+scales pool here)."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    telemetry.configure(executable_ledger=True)
+    model = Llama(size="tiny", max_seq_len=256)
+    rng = np.random.default_rng(1)
+    args: dict[str, int] = {}
+    pools: dict[str, int] = {}
+    for name, kv in (("fp", {"enabled": False}),
+                     ("q", {"enabled": True, "dtype": "int8",
+                            "grow_pool": False})):
+        e = InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            dtype="float32", kv_block_size=64, num_kv_blocks=64,
+            max_chunk_size=64, kv_cache=kv))
+        uids = [0, 1]
+        e.put(uids, [rng.integers(0, model.config.vocab_size,
+                                  8).tolist() for _ in uids])
+        for u in uids:
+            e.state_manager.extend(u, [1])
+        e.decode_fused(uids, k_steps=2)
+        led = telemetry.get_ledger()
+        ent = [en for en in led.entries()
+               if en.name == "v2/fused_dispatch"]
+        assert ent, "fused dispatch never registered"
+        args[name] = max(en.memory.get("argument", 0) for en in ent)
+        pools[name] = e.kv_pool_bytes()
+        e.flush(uids)
+        telemetry.shutdown()
+        telemetry.configure(executable_ledger=True)
+    expected_drop = pools["fp"] - pools["q"]
+    assert expected_drop > 0.6 * pools["fp"]      # >= ~3x smaller pool
+    measured_drop = args["fp"] - args["q"]
+    assert measured_drop == pytest.approx(expected_drop, rel=0.1), \
+        (args, pools)
+
+
 # ---------------------------------------------------------------------
 # flight recorder + hang watchdog + straggler skew
 # ---------------------------------------------------------------------

@@ -103,6 +103,40 @@ def test_blocksan_cap_path_leak_names_allocation_site():
     assert "extend" in msg and "test_graftsan" in msg
 
 
+def test_blocksan_scale_pool_tracks_kv_partition():
+    """Quantized-KV scale-slot audit (ISSUE 12): with a scale pool
+    attached, clean alloc/flush roundtrips conserve BOTH partitions,
+    and a seeded fault severing one scale slot from its live block (or
+    leaving a stale slot on a freed block) is a named finding."""
+    mgr, san = _mgr(cache=PrefixCache(8))
+    san.attach_scale_pool()
+    mgr.extend(0, list(range(20)))
+    mgr.seqs[0].seen = 20
+    mgr.publish_full_blocks(mgr.seqs[0])
+    blocks = list(mgr.seqs[0].blocks)
+    assert san.scale_slots == set(blocks)
+    mgr.flush(0)
+    # LRU-parked published blocks keep their scale slots (a cached
+    # quantized block dequantizes through them on a warm hit); the
+    # freed tail's slots died with the free
+    assert san.counters["violations"] == 0
+    assert san.scale_slots == set(mgr.cache.lru)
+    # fault 1: a block still LIVE at the quiesce (seq 2's) whose scale
+    # slot went missing — flushing the unrelated seq 1 runs the check
+    mgr.extend(1, list(range(8)))
+    mgr.extend(2, list(range(8)))
+    san.scale_slots.discard(mgr.seqs[2].blocks[0])
+    with pytest.raises(BlockSanError, match="without a scale slot"):
+        mgr.flush(1)
+    # fault 2: a stale scale slot on a freed block is a leak finding
+    mgr2, san2 = _mgr()
+    san2.attach_scale_pool()
+    mgr2.extend(0, list(range(8)))
+    san2.scale_slots.add(15)          # block 15 was never allocated
+    with pytest.raises(BlockSanError, match="scale slots .* leaked"):
+        mgr2.flush(0)
+
+
 def test_blocksan_missed_transition_detected():
     """A free-routing path that bypasses the audited choke point
     (raw _free.append) shows up as mirror drift at the next quiesce —
@@ -135,8 +169,8 @@ def test_blocksan_journal_and_snapshot_schema():
     assert [e["op"] for e in tail] == ["allocate", "incref", "decref"]
     assert all("site" in e and ":" in e["site"] for e in tail)
     snap = san.snapshot()
-    assert set(snap) == {"pool_size", "mode", "counters", "violations",
-                         "journal_tail"}
+    assert set(snap) == {"pool_size", "mode", "scale_slots", "counters",
+                         "violations", "journal_tail"}
     assert snap["pool_size"] == 16
 
 

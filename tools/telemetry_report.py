@@ -129,7 +129,7 @@ def serving_summary(metrics: dict) -> dict:
     perf number."""
     out = {k: v for k, v in sorted(metrics.items())
            if "ds_serving_" in k or "ds_blocksan_" in k
-           or "ds_affinity_" in k}
+           or "ds_affinity_" in k or "ds_kv_" in k}
 
     def total(stem: str):
         vals = [v for k, v in metrics.items() if stem in k
@@ -319,6 +319,13 @@ _GATES = {
         ("boundary_gap", -1, 0.15),
         ("preempt_stall", -1, 0.15),
         ("prefill_p", -1, 0.15),
+        # quantized KV cache (ISSUE 12, bench `kvquant` stage): the
+        # per-cached-token byte cost must not creep back up and the
+        # resident-batch capacity at equal pool bytes must not shrink
+        # (the stage's headline 2-4x lever). Tight thresholds — both
+        # are deterministic layout arithmetic, not timing.
+        ("kv_bytes_per_token", -1, 0.02),
+        ("max_resident_batch", +1, 0.02),
         ("tokens_per_sec", +1, 0.05),
         ("fused_occupancy", +1, 0.05),
     ),
