@@ -624,9 +624,9 @@ def serve_openloop_bench(ds, on_tpu: bool):
     # and its measured TTFT — the acceptance bound is 5%)
     breakdown: dict = {}
     if rec is not None:
+        from deepspeed_tpu.telemetry.reqtrace import COMPONENT_KEYS
         pcts = rec.component_percentiles()
-        for name in ("queue_wait", "prefill", "first_drain",
-                     "decode_active", "boundary_gap", "preempt_stall"):
+        for name in COMPONENT_KEYS:
             row = pcts.get(name)
             breakdown[f"{name}_p50_ms"] = (
                 round(row["p50"] * 1e3, 3) if row else None)
@@ -635,7 +635,7 @@ def serve_openloop_bench(ds, on_tpu: bool):
         attr = rec.ttft_attribution()
         breakdown["ttft_dominant_component"] = attr.get(
             "dominant_component")
-        recon = [abs((tr.queue_wait_s + tr.prefill_s
+        recon = [abs((tr.queue_wait_s + tr.prefill_s + tr.migrate_s
                       + tr.first_drain_s) - tr.ttft_s) / tr.ttft_s
                  for tr in rec.completed() if tr.ttft_s]
         breakdown["access_log_requests"] = len(rec.completed())
@@ -660,6 +660,259 @@ def serve_openloop_bench(ds, on_tpu: bool):
             "preemptions": m["preemptions"],
             "chain_depth": depth, "fused_k": K,
             "fused_admission": True, **breakdown}
+
+
+def disagg_bench(ds, on_tpu: bool):
+    """Disaggregated serving (ISSUE 13): two acceptance figures.
+
+    (A) Decode-ITL flatness under long-prompt pressure — mixed chat +
+    long-prompt traffic, measured twice as the long prompts grow 10x:
+    against a single co-located engine (long-prompt chunked prefill
+    steals decode ticks at every dispatch boundary, so chat ITL p99
+    degrades) and against the prefill/decode split (long prompts
+    prefill on the dedicated engine and migrate in as KV block sets —
+    decode ticks undisturbed, ITL p99 flat).
+
+    (B) N-replica scaling behind the prefix-affinity router —
+    aggregate tokens/s on N=2 replicas at the same per-replica offered
+    load vs the single-replica figure (`replica_scaling_x`, acceptance
+    >= 0.8), with per-replica placements and prefix hit rates (the
+    shared-system-prompt wave lands on the replica holding the chain
+    warm)."""
+    import asyncio
+
+    import numpy as np
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.serving import (AsyncInferenceServer,
+                                       InferenceRouter, PrefillEngine,
+                                       RouterConfig, ServingConfig)
+
+    if on_tpu:
+        model = Llama(hidden_size=1024, num_layers=12, num_heads=8,
+                      num_kv_heads=8, intermediate_size=2816,
+                      vocab_size=32000, max_seq_len=4096)
+        bs_kv, nb, chunk, B, K = 64, 384, 256, 16, 8
+        chat_len, chat_new, n_chat = 64, 64, 12
+        long_lens, long_new, n_long, long_gap = (256, 2560), 8, 4, 0.2
+        scale_req, scale_new, scale_k, scale_rps = 24, 64, 8, 4.0
+    else:
+        # big enough that prefill is real COMPUTE (a 320-token prompt's
+        # chunked prefill stalls decode for many chain gaps), small
+        # enough that the stall windows stay short relative to the run
+        # — on this 2-core rig the prefill "mesh" shares silicon with
+        # decode, so an oversized model turns the A comparison into a
+        # pure CPU-contention measurement (a TPU deployment puts the
+        # prefill engine on its own chips)
+        model = Llama(size="tiny", hidden_size=128, num_layers=3,
+                      num_heads=4, num_kv_heads=4,
+                      intermediate_size=344, vocab_size=2048,
+                      max_seq_len=512)
+        bs_kv, nb, chunk, B, K = 8, 192, 32, 8, 4
+        chat_len, chat_new, n_chat = 16, 16, 6
+        long_lens, long_new, n_long, long_gap = (32, 320), 4, 3, 0.2
+        # deeper fused K for the scaling runs: host work per token is
+        # the 2-core rig's scaling ceiling, and K amortizes it
+        scale_req, scale_new, scale_k, scale_rps = 12, 32, 16, 2.5
+    dtype = "bfloat16" if on_tpu else "float32"
+
+    def mk(params=None):
+        return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            dtype=dtype, kv_block_size=bs_kv, num_kv_blocks=nb,
+            max_chunk_size=chunk, max_ragged_sequence_count=B,
+            fused_decode_steps=K, prefix_cache={"enabled": True}),
+            params=params)
+
+    e_single = mk()
+    params = e_single.params
+    e_pre, e_d0, e_d1 = mk(params), mk(params), mk(params)
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+
+    def prompts(n, length):
+        return [rng.integers(0, vocab, length).tolist()
+                for _ in range(n)]
+
+    # ---- (A) chat ITL p99 vs long-prompt length, single vs disagg ----
+    chat_prompts = prompts(n_chat, chat_len)
+
+    async def mixed_run(router, long_len):
+        itls: list[float] = []
+        longs = prompts(n_long, long_len)
+
+        async def chat(i):
+            h = await router.submit(chat_prompts[i],
+                                    max_new_tokens=chat_new)
+            prev = None
+            async for _t in h:
+                now = time.perf_counter()
+                if prev is not None:
+                    itls.append((now - prev) * 1e3)
+                prev = now
+
+        async def long_stream():
+            for p in longs:
+                await asyncio.sleep(long_gap)
+                h = await router.submit(p, max_new_tokens=long_new)
+                await h.tokens()
+
+        async with router:
+            await asyncio.gather(long_stream(),
+                                 *(chat(i) for i in range(n_chat)))
+        return itls
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(len(xs) * q))], 2)
+
+    def single_router():
+        return InferenceRouter(
+            [AsyncInferenceServer(e_single, ServingConfig(k_steps=K))],
+            RouterConfig())
+
+    def disagg_router():
+        return InferenceRouter(
+            [AsyncInferenceServer(e_d0, ServingConfig(k_steps=K))],
+            RouterConfig(disaggregation={
+                "enabled": True,
+                # chat stays co-located; long prompts migrate
+                "prefill_threshold_tokens": chat_len + 1}),
+            prefill=PrefillEngine(e_pre, name="prefill0"))
+
+    itl: dict[str, dict[int, float]] = {"single": {}, "disagg": {}}
+    migrate_bytes = migrate_blocks = handoffs = 0
+    for mode, mk_router in (("single", single_router),
+                            ("disagg", disagg_router)):
+        # warm pass (compiles prefill buckets + the serve loop) at the
+        # SHORT length, outside every measured window
+        asyncio.run(mixed_run(mk_router(), long_lens[0]))
+        for L in long_lens:
+            # best-of-2 windows per point (noisy-rig discipline)
+            best = None
+            for _ in range(2):
+                router = mk_router()
+                p99 = pct(asyncio.run(mixed_run(router, L)), 0.99)
+                best = p99 if best is None else min(best, p99)
+                if mode == "disagg":
+                    pm = router.prefill.metrics()
+                    migrate_bytes += pm["exported_bytes"]
+                    migrate_blocks += pm["exported_blocks"]
+                    handoffs += pm["prefills"]
+            itl[mode][L] = best
+    l0, l1 = long_lens
+    single_drift = itl["single"][l1] / max(itl["single"][l0], 1e-6)
+    disagg_drift = itl["disagg"][l1] / max(itl["disagg"][l0], 1e-6)
+    # migration byte economics: the hand-off moves KV blocks in their
+    # storage format — bytes/token rides kv_bytes_per_token exactly
+    # (quantized engines migrate quantized; no dequantize leg)
+    migrate_bpt = (migrate_bytes / max(migrate_blocks * bs_kv, 1)
+                   if migrate_blocks else None)
+
+    # ---- (B) N-replica scaling + per-replica prefix hit rates --------
+    shared = rng.integers(0, vocab, 2 * bs_kv).tolist()
+
+    def scale_prompts(n):
+        # half shared-system-prompt traffic (the affinity key), half
+        # unique chat
+        out = []
+        for i in range(n):
+            if i % 2 == 0:
+                out.append(shared
+                           + rng.integers(0, vocab, 4).tolist())
+            else:
+                out.append(rng.integers(0, vocab, chat_len).tolist())
+        return out
+
+    async def scale_run(engines, rounds=2):
+        """Open-loop Poisson traffic (the serve_openloop discipline)
+        at ``scale_rps`` requests/s PER REPLICA: N replicas face N x
+        the single-replica offered load, and sustained aggregate
+        tokens/s is the scaling figure — best-of-``rounds`` windows
+        after one closed-loop warm wave (compiles + prefix-cache
+        seed), TTFT p99 reported so 'sustained' is checkable (a
+        saturated config shows up as queue growth there first)."""
+        servers = [AsyncInferenceServer(
+            e, ServingConfig(k_steps=scale_k)) for e in engines]
+        router = InferenceRouter(servers, RouterConfig())
+        n = scale_req * len(engines)
+        rate = scale_rps * len(engines)
+
+        async def warm():
+            hs = [await router.submit(p, max_new_tokens=scale_new)
+                  for p in scale_prompts(n)]
+            for h in hs:
+                await h.tokens()
+
+        async def openloop_window():
+            reqs = scale_prompts(n)
+            arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+            ttfts: list[float] = []
+
+            async def client(i):
+                await asyncio.sleep(float(arrivals[i]))
+                t_sub = time.perf_counter()
+                h = await router.submit(reqs[i],
+                                        max_new_tokens=scale_new)
+                toks = []
+                async for t in h:
+                    if not toks:
+                        ttfts.append((time.perf_counter() - t_sub)
+                                     * 1e3)
+                    toks.append(t)
+                return len(toks)
+
+            for e in engines:
+                e.reset_serving_metrics()
+            t0 = time.perf_counter()
+            counts = await asyncio.gather(*(client(i)
+                                            for i in range(n)))
+            wall = time.perf_counter() - t0
+            return (sum(counts) / max(wall, 1e-9),
+                    pct(sorted(ttfts), 0.99))
+
+        async with router:
+            await warm()
+            best, ttft = 0.0, None
+            for _ in range(rounds):
+                tps, t99 = await openloop_window()
+                if tps > best:
+                    best, ttft = tps, t99
+            return best, ttft, router.metrics()
+
+    # single replica on the SAME warmed engine the 2-replica run uses,
+    # so the comparison is compile-free on both sides
+    t1, ttft1, m1 = asyncio.run(scale_run([e_d0]))
+    tn, ttftn, mn = asyncio.run(scale_run([e_d0, e_d1]))
+    n_rep = 2
+    scaling = tn / max(n_rep * t1, 1e-9)
+    per_replica = {
+        name: {"decoded_tokens": row["decoded_tokens"],
+               "placed": row["placed"],
+               "prefix_hit_rate": round(row["prefix_hit_rate"], 3)}
+        for name, row in mn["replicas"].items()}
+
+    return {"metric": "disagg_chat_itl_p99_ms_at_10x",
+            "value": itl["disagg"][l1], "unit": "ms",
+            "chat_itl_p99_ms": {m: {str(L): v for L, v in d.items()}
+                                for m, d in itl.items()},
+            "long_prompt_lens": list(long_lens),
+            "single_itl_p99_drift_x10_ratio": round(single_drift, 3),
+            "disagg_itl_p99_drift_x10_ratio": round(disagg_drift, 3),
+            "itl_flat_under_10x": bool(disagg_drift <= 1.15),
+            "prefill_handoffs": handoffs,
+            "migrate_bytes_per_token": (round(migrate_bpt, 3)
+                                        if migrate_bpt else None),
+            "kv_bytes_per_token": round(e_pre.kv_bytes_per_token(), 3),
+            "single_replica_tokens_per_sec": round(t1, 1),
+            "aggregate_tokens_per_sec_2rep": round(tn, 1),
+            "openloop_rps_per_replica": scale_rps,
+            "scale_ttft_p99_ms": {"1rep": ttft1, "2rep": ttftn},
+            "replica_scaling_x": round(scaling, 3),
+            "replicas": n_rep, "per_replica": per_replica,
+            "fused_k": K, "requests_per_replica": scale_req}
 
 
 def serving_bench(ds, on_tpu: bool):
@@ -2019,6 +2272,7 @@ STAGES = [("headline", headline_bench),
           ("spec", spec_bench),
           ("kvquant", kvquant_bench),
           ("serve_openloop", serve_openloop_bench),
+          ("disagg", disagg_bench),
           ("moe_serving", moe_serving_bench),
           ("offload", offload_smoke),
           ("autotune", autotune_bench),

@@ -38,6 +38,7 @@ This module must stay importable without jax (the linter half of
 
 from __future__ import annotations
 
+import itertools
 import os
 import sys
 import threading
@@ -204,6 +205,37 @@ class BlockSanitizer:
     def on_cache_evict(self, block: int) -> None:
         self._journal("lru_evict", (block,), _call_site())
 
+    # -- cross-engine hand-off accounting (ISSUE 13) -------------------
+    def on_export(self, uid: int, blocks, tokens: int) -> int:
+        """A sequence's KV block set left this pool for another engine
+        (``InferenceEngineV2.export_request``). The blocks themselves
+        are released through the normal flush choke right after — this
+        hook records the hand-off in the PROCESS-WIDE transit ledger
+        with the export call site, so a serialized block set that never
+        reaches an ``import_request`` is a named finding
+        (:func:`check_transit`), not a silent drop. Returns the
+        hand-off id that rides the :class:`KVExportState`."""
+        site = _call_site()
+        self._journal("export", blocks, site)
+        hid = next(_HANDOFF_IDS)
+        with _TRANSIT_LOCK:
+            _TRANSIT[hid] = {"uid": int(uid),
+                             "blocks": len(tuple(blocks)),
+                             "tokens": int(tokens), "site": site,
+                             "mode": self.mode}
+        return hid
+
+    def on_import(self, uid: int, blocks,
+                  handoff_id: Optional[int]) -> None:
+        """A migrated block set landed in this pool
+        (``import_request``): journal the arrival and mark the
+        exporter's transit entry delivered. The blocks were allocated
+        through the audited ``allocate`` hook just before, so
+        conservation on THIS pool covers them from here on."""
+        self._journal("import", blocks, _call_site())
+        if handoff_id is not None:
+            record_import(handoff_id)
+
     # -- quiesce-point conservation ------------------------------------
     def check_conservation(self, allocator, cache, label: str) -> None:
         """Pool conservation at a quiesce point: free + referenced +
@@ -276,6 +308,7 @@ class BlockSanitizer:
                                 if self.scale_slots is not None else None),
                 "counters": dict(self.counters),
                 "violations": list(self.violation_log[-16:]),
+                "pending_handoffs": pending_handoffs(),
                 "journal_tail": self.journal_tail()}
 
 
@@ -325,6 +358,56 @@ class ThreadAffinityChecker:
             raise AffinityError(msg)
         from ..utils.logging import logger
         logger.warning(msg)
+
+
+# --- cross-engine hand-off transit ledger (ISSUE 13) ----------------------
+# Exports and imports happen on DIFFERENT pools (often different
+# sanitizers), so in-transit accounting is process-wide: on_export
+# records here, import_request clears — even when the importing pool
+# runs unsanitized (the engine clears by handoff_id directly).
+
+_HANDOFF_IDS = itertools.count(1)
+_TRANSIT: dict[int, dict] = {}
+_TRANSIT_LOCK = threading.Lock()
+
+
+def record_import(handoff_id: int) -> None:
+    """Mark one hand-off delivered (idempotent; unknown ids — e.g. a
+    cross-process import — are a no-op)."""
+    with _TRANSIT_LOCK:
+        _TRANSIT.pop(int(handoff_id), None)
+
+
+def pending_handoffs() -> list[dict]:
+    """Exports not yet imported (legitimately non-empty mid-flight)."""
+    with _TRANSIT_LOCK:
+        return [dict(v, handoff_id=k) for k, v in _TRANSIT.items()]
+
+
+def check_transit(mode: str = "raise") -> list[str]:
+    """Assert no hand-off was dropped in transit: every export must
+    have reached an import by the time a caller (tests, a router
+    drain, a shutdown path) declares the system quiescent. Each
+    finding names the EXPORT call site — the provenance that turns a
+    slow pool-capacity mystery into a file:line. Reported entries are
+    consumed (report-once)."""
+    with _TRANSIT_LOCK:
+        pend = dict(_TRANSIT)
+        _TRANSIT.clear()
+    msgs = []
+    for hid, info in sorted(pend.items()):
+        msg = (f"hand-off {hid}: {info['blocks']} block(s) / "
+               f"{info['tokens']} tokens for uid {info['uid']} "
+               f"exported at {info['site']} were never imported "
+               "(dropped in transit)")
+        msgs.append(msg)
+        _count_violation("ds_blocksan_violations_total",
+                         "dropped-handoff")
+        if mode == "raise":
+            raise BlockSanError(f"blocksan: {msg}")
+        from ..utils.logging import logger
+        logger.warning(f"blocksan: {msg}")
+    return msgs
 
 
 # --- process-wide handle for forensics (hang dumps) -----------------------

@@ -36,8 +36,9 @@ from ..config import DeepSpeedInferenceConfig
 from .paged import (fused_decode_loop, fused_serve_loop,
                     fused_spec_decode_loop, fused_spec_serve_loop,
                     paged_forward)
-from .ragged import (PrefixCache, DSStateManager, SequenceDescriptor,
-                     kv_block_bytes, quantized_block_budget)
+from .ragged import (KVExportState, PrefixCache, DSStateManager,
+                     SequenceDescriptor, kv_block_bytes,
+                     quantized_block_budget)
 
 PyTree = Any
 
@@ -708,6 +709,204 @@ class InferenceEngineV2:
         for u in uids:
             self.state_manager.flush(int(u))
             self._finished_stash.pop(int(u), None)
+
+    # ------------------------------------------------------------------
+    # cross-mesh KV migration (ISSUE 13): park()/restore generalized so
+    # the KV BYTES move between engines instead of being recomputed —
+    # the transport between the prefill engine and the decode replicas
+    # (and between decode replicas) in disaggregated serving. The
+    # hand-off is host-mediated (device_get -> wire -> device scatter);
+    # on a multi-slice TPU deployment this is exactly the ICI/DCN
+    # boundary the bytes would cross anyway.
+
+    def export_request(self, uid: int, *, n_generated: int = 0,
+                       source: str = "") -> KVExportState:
+        """Serialize one sequence's KV block set and release it from
+        this engine: the blocks holding written KV (positions < seen)
+        are gathered from the pools — quantized codes and scale slabs
+        AS-IS, no dequantize — and the sequence is flushed (blocksan
+        conservation runs at that quiesce; with the prefix cache on,
+        published full blocks stay warm in the LRU like any park).
+        Export happens at a dispatch boundary: exactly one pending
+        token, which becomes the importing engine's first fused-
+        dispatch input, so greedy continuation is bit-identical."""
+        if self._affinity is not None:
+            self._affinity.check("v2/export_request")
+        mgr = self.state_manager
+        seq = mgr.seqs.get(int(uid))
+        if seq is None:
+            raise RuntimeError(f"export_request: unknown uid {uid}")
+        if seq.pending != 1:
+            raise RuntimeError(
+                f"export_request: sequence {uid} must have exactly one "
+                f"pending token (a dispatch boundary), got {seq.pending}")
+        bs = mgr.block_size
+        n_payload = min(-(-seq.seen // bs), len(seq.blocks))
+        if n_payload:
+            idx = jnp.asarray(np.asarray(seq.blocks[:n_payload],
+                                         np.int32))
+            payload = jax.device_get(
+                {k: jnp.take(v, idx, axis=1)
+                 for k, v in self.pools.items()})
+        else:
+            # nothing written yet (single-token prompt): layout-only
+            # payload, zero wire bytes
+            payload = {k: np.zeros((v.shape[0], 0)
+                                   + tuple(v.shape[2:]),
+                                   np.dtype(v.dtype))
+                       for k, v in self.pools.items()}
+        handoff_id = None
+        if self._blocksan is not None:
+            handoff_id = self._blocksan.on_export(
+                int(uid), seq.blocks[:n_payload], seq.seen)
+        state = KVExportState(
+            tokens=list(seq.tokens), n_generated=int(n_generated),
+            seen=int(seq.seen), block_size=bs, kv_dtype=self.kv_dtype,
+            payload=payload, handoff_id=handoff_id,
+            source=source or f"engine-{id(self):x}")
+        mgr.flush(int(uid))
+        return state
+
+    def _import_fn(self, width: int):
+        """Donated pool scatter for one import, cached per power-of-two
+        block-index width (pad indices point past the pool; mode='drop'
+        discards their writes) — bounded executables, pools updated
+        in place."""
+        key = ("kv_import", width)
+        if key not in self._fused_cache:
+            def scatter(pools, idx, payload):
+                return {k: pools[k].at[:, idx].set(payload[k],
+                                                   mode="drop")
+                        for k in pools}
+            self._fused_cache[key] = jax.jit(
+                scatter, donate_argnums=(0,),
+                out_shardings=dict(self._pool_shardings))
+        return self._fused_cache[key]
+
+    def import_request(self, uid: int, state: KVExportState) -> int:
+        """Admit a migrated sequence position-exactly: allocate blocks
+        for the full history, scatter the travelled payload (quantized
+        blocks + scales land untouched in their storage dtype), and
+        re-publish the full-block chain into this engine's prefix
+        cache. Returns the pending input token of the next fused
+        dispatch. Raises — before any pool mutation — on a KV-layout
+        mismatch or when the pool cannot hold the sequence."""
+        if self._affinity is not None:
+            self._affinity.check("v2/import_request")
+        mgr = self.state_manager
+        if state.kv_dtype != self.kv_dtype:
+            raise ValueError(
+                f"import_request: migrated KV dtype "
+                f"{state.kv_dtype!r} != this engine's "
+                f"{self.kv_dtype!r} — migration never converts "
+                "payload formats")
+        if state.block_size != mgr.block_size:
+            raise ValueError(
+                f"import_request: migrated block size "
+                f"{state.block_size} != {mgr.block_size}")
+        if set(state.payload) != set(self.pools):
+            raise ValueError(
+                f"import_request: payload slabs "
+                f"{sorted(state.payload)} != pool slabs "
+                f"{sorted(self.pools)}")
+        for k, a in state.payload.items():
+            pool = self.pools[k]
+            want = (pool.shape[0],) + tuple(pool.shape[2:])
+            got = (a.shape[0],) + tuple(a.shape[2:])
+            if want != got:
+                raise ValueError(
+                    f"import_request: payload slab {k!r} shape "
+                    f"{got} != pool layout {want}")
+        n_payload = state.payload_blocks
+        seq = mgr.import_sequence(int(uid), state.tokens, state.seen,
+                                  n_payload)
+        try:
+            if n_payload:
+                width = _bucket(n_payload)
+                idx = np.full((width,), self.num_kv_blocks, np.int32)
+                idx[:n_payload] = seq.blocks[:n_payload]
+                pay = {}
+                for k, a in state.payload.items():
+                    if width > n_payload:
+                        pad = np.zeros((a.shape[0],
+                                        width - n_payload)
+                                       + tuple(a.shape[2:]), a.dtype)
+                        a = np.concatenate([a, pad], axis=1)
+                    pay[k] = jnp.asarray(a)
+                self.pools = self._import_fn(width)(
+                    self.pools, jnp.asarray(idx), pay)
+        except BaseException:
+            mgr.flush(int(uid))     # no leak on a failed scatter
+            raise
+        if self._blocksan is not None:
+            self._blocksan.on_import(int(uid),
+                                     seq.blocks[:n_payload],
+                                     state.handoff_id)
+        elif state.handoff_id is not None:
+            # the EXPORTER was sanitized: clear its in-transit entry
+            # even though this pool runs unsanitized, or the hand-off
+            # would read as dropped
+            from ...analysis import blocksan as _bsan
+            _bsan.record_import(state.handoff_id)
+        mgr._quiesce("import")
+        return int(state.tokens[-1])
+
+    def sample_first_tokens(self, firsts: dict, temperature: float,
+                            top_k: int, top_p: float,
+                            seed: int) -> dict[int, int]:
+        """Sample each uid's first generated token from its last-prompt
+        logits with the SAME op and position keying as the in-graph
+        fused loop (one batched device call). Shared by the serve
+        loop's co-located prefill and the disaggregated prefill engine,
+        so a hand-off's first token is bit-identical to the co-located
+        one — sampling is position-keyed per (seed, uid, position),
+        invariant to which engine ran the prefill."""
+        from ...ops import sampling
+        if not firsts:
+            return {}
+        mgr = self.state_manager
+        uids_f = list(firsts)
+        base = self._base_key(seed)
+        row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(
+            jnp.asarray(np.asarray(uids_f, np.uint32)))
+        keys = sampling.position_keys(
+            row_keys,
+            jnp.asarray(np.asarray([mgr.seqs[u].seen for u in uids_f])))
+        toks_dev = sampling.sample_tokens_batched(
+            jnp.stack([firsts[u] for u in uids_f]).astype(jnp.float32),
+            keys, temperature=temperature, top_k=top_k, top_p=top_p)
+        return {u: int(t)
+                for u, t in zip(uids_f, jax.device_get(toks_dev))}
+
+    def prefill_request(self, uid: int, prompt, *,
+                        temperature: Optional[float] = None,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None,
+                        seed: int = 0) -> int:
+        """Disaggregated-prefill producer half (ISSUE 13): chunked
+        prefill of one prompt on THIS engine plus the first generated
+        token, leaving the sequence at the exact dispatch-boundary
+        state (one pending token) ``export_request`` ships — the same
+        state the co-located serve loop reaches before its first fused
+        dispatch, so the downstream decode is bit-identical either
+        way. Returns the first token."""
+        temperature, top_k, top_p, _ = self._sampling_args(
+            temperature, top_k, top_p, None)
+        uid = int(uid)
+        self.schedule([uid], [[int(t) for t in prompt]])
+        mgr = self.state_manager
+        try:
+            logits = None
+            while mgr.seqs[uid].pending:
+                logits = self._run([uid])
+            tok = self.sample_first_tokens(
+                {uid: logits[0]}, temperature, top_k, top_p, seed)[uid]
+            mgr.extend(uid, [tok])
+        except BaseException:
+            self.flush(uid)
+            raise
+        self.serving_stats["decoded_tokens"] += 1
+        return tok
 
     # ------------------------------------------------------------------
     # fused multi-step decode: K ticks per host dispatch, sampling and

@@ -27,6 +27,8 @@ attention already reads KV strictly through per-sequence block tables.
 
 from __future__ import annotations
 
+import json
+import struct
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Optional
@@ -67,6 +69,129 @@ def quantized_block_budget(num_blocks: int, full_block_bytes: int,
     return max(int(num_blocks),
                int(num_blocks) * int(full_block_bytes)
                // max(int(quant_block_bytes), 1))
+
+
+# cross-mesh KV migration wire format (ISSUE 13). Version bumps on any
+# layout change — import refuses a mismatched version outright.
+MIGRATION_WIRE_VERSION = 1
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """numpy dtype from its name, falling back to the ml_dtypes
+    extension types (float8_e4m3fn etc.) jax registers — the pool
+    payload of a quantized engine travels in exactly its storage
+    dtype, never dequantized."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class KVExportState:
+    """One sequence's serialized KV block set — the unit of cross-mesh
+    migration (ISSUE 13): ``DSStateManager.park()`` generalized so the
+    KV BYTES travel with the token history instead of being recomputed.
+
+    ``payload`` holds the sequence's full-and-tail blocks gathered from
+    the exporting engine's pools, one array per pool slab keyed exactly
+    like ``InferenceEngineV2.pools`` (``k``/``v`` payload, plus
+    ``ks``/``vs`` scale slabs on quantized engines) with the pool's
+    block axis narrowed to this sequence's blocks: quantized codes and
+    their write-once scales travel AS-IS — no dequantize leg, so the
+    wire cost is ``kv_bytes_per_token`` of the storage format, the
+    whole point of migrating after PR 12. Import is position-exact:
+    the sequence resumes on the importing engine with identical
+    ``tokens``/``seen``/pool bytes, so greedy continuation is
+    bit-identical to never having moved.
+
+    ``n_generated`` splits ``tokens`` into prompt and
+    already-generated suffix (the pending token last); the importing
+    scheduler seeds its request bookkeeping from it."""
+    tokens: list[int]
+    n_generated: int
+    seen: int
+    block_size: int
+    kv_dtype: str
+    payload: dict[str, np.ndarray]
+    handoff_id: Optional[int] = None      # blocksan transit tag
+    source: str = ""                      # exporting engine/replica
+
+    @property
+    def prompt_tokens(self) -> list[int]:
+        return self.tokens[:len(self.tokens) - self.n_generated]
+
+    @property
+    def generated_tokens(self) -> list[int]:
+        return self.tokens[len(self.tokens) - self.n_generated:]
+
+    @property
+    def payload_blocks(self) -> int:
+        """Blocks of KV payload travelling (the pending token's block
+        tail is re-reserved on import, not shipped empty)."""
+        return int(next(iter(self.payload.values())).shape[1]) \
+            if self.payload else 0
+
+    @property
+    def payload_bytes(self) -> int:
+        """Wire bytes of the KV payload (scale slabs included) — the
+        figure the migration-cost assertion compares against
+        ``kv_bytes_per_token``."""
+        return int(sum(a.nbytes for a in self.payload.values()))
+
+    def bytes_per_token(self) -> float:
+        """Payload bytes per migrated KV token (block granularity —
+        the tail block ships whole, like it is stored)."""
+        toks = self.payload_blocks * self.block_size
+        return self.payload_bytes / max(toks, 1)
+
+    # -- wire format ---------------------------------------------------
+    def to_bytes(self) -> bytes:
+        """One self-describing buffer: little-endian u32 header length,
+        JSON header (token history, layout, per-array name/shape/dtype
+        manifest), then the raw array bytes in manifest order. Arrays
+        round-trip bit-exactly in their storage dtype."""
+        arrays = [(k, self.payload[k]) for k in sorted(self.payload)]
+        header = json.dumps({
+            "version": MIGRATION_WIRE_VERSION,
+            "tokens": [int(t) for t in self.tokens],
+            "n_generated": int(self.n_generated),
+            "seen": int(self.seen),
+            "block_size": int(self.block_size),
+            "kv_dtype": self.kv_dtype,
+            "source": self.source,
+            "handoff_id": self.handoff_id,
+            "arrays": [{"name": k, "shape": list(a.shape),
+                        "dtype": a.dtype.name} for k, a in arrays],
+        }).encode()
+        parts = [struct.pack("<I", len(header)), header]
+        parts += [np.ascontiguousarray(a).tobytes() for _, a in arrays]
+        return b"".join(parts)
+
+    @classmethod
+    def from_bytes(cls, buf: bytes) -> "KVExportState":
+        (hlen,) = struct.unpack_from("<I", buf, 0)
+        head = json.loads(buf[4:4 + hlen].decode())
+        if head["version"] != MIGRATION_WIRE_VERSION:
+            raise ValueError(
+                f"KV migration wire version {head['version']} != "
+                f"{MIGRATION_WIRE_VERSION} — refusing a cross-version "
+                "import")
+        off = 4 + hlen
+        payload = {}
+        for spec in head["arrays"]:
+            dt = _resolve_dtype(spec["dtype"])
+            n = int(np.prod(spec["shape"])) * dt.itemsize
+            payload[spec["name"]] = np.frombuffer(
+                buf[off:off + n], dtype=dt).reshape(spec["shape"])
+            off += n
+        return cls(tokens=head["tokens"],
+                   n_generated=head["n_generated"], seen=head["seen"],
+                   block_size=head["block_size"],
+                   kv_dtype=head["kv_dtype"], payload=payload,
+                   handoff_id=head.get("handoff_id"),
+                   source=head.get("source", ""))
 
 
 @dataclass
@@ -560,6 +685,48 @@ class DSStateManager:
         if seq is not None:
             self._release_blocks(seq.blocks)
             self._quiesce("flush")
+
+    def import_sequence(self, uid: int, tokens: list[int], seen: int,
+                        payload_blocks: int) -> SequenceDescriptor:
+        """Accounting half of a cross-mesh KV import (ISSUE 13):
+        allocate blocks covering the FULL migrated history (the engine
+        scatters the payload into the first ``payload_blocks`` of
+        them), rebuild the descriptor position-exactly, and RE-PUBLISH
+        the sequence's full blocks into this manager's prefix cache —
+        the importing replica's cache warms with the migrated chain, so
+        follow-up same-prefix traffic lands warm here (the router's
+        affinity key). Raises before any allocation when the sequence
+        cannot fit; the caller owns payload transfer and quiesce."""
+        uid = int(uid)
+        if uid in self.seqs:
+            raise RuntimeError(
+                f"import_sequence: uid {uid} already live on this "
+                "engine — migrated uids must be fresh")
+        tokens = [int(t) for t in tokens]
+        seen = int(seen)
+        if not tokens or seen != len(tokens) - 1:
+            raise RuntimeError(
+                f"import_sequence: uid {uid} must arrive with exactly "
+                f"one pending token (seen {seen}, {len(tokens)} tokens)"
+                " — export happens at a dispatch boundary")
+        n_total = -(-len(tokens) // self.block_size)
+        if payload_blocks > n_total:
+            raise RuntimeError(
+                f"import_sequence: uid {uid} ships {payload_blocks} "
+                f"payload blocks for a {n_total}-block history")
+        if n_total > self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"import_sequence: uid {uid} needs {n_total} blocks, "
+                f"max {self.max_blocks_per_seq}")
+        blocks = self.allocator.allocate(n_total)
+        seq = SequenceDescriptor(uid=uid, tokens=tokens, seen=seen,
+                                 blocks=blocks)
+        self.seqs[uid] = seq
+        # prefix-chain re-publish: the migrated full blocks index under
+        # the same hash chain they carried on the exporter (content-
+        # keyed), first-publisher-wins against anything already cached
+        self.publish_full_blocks(seq)
+        return seq
 
     def park(self, uid: int) -> list[int]:
         """Preemption swap-out (ISSUE 6): release a LIVE sequence's KV

@@ -52,9 +52,11 @@ from ...utils.telemetry_probe import (NULL_CM as _NULLCM,
                                       active_telemetry as _telemetry)
 
 # scheduler-level counters surfaced through serving_metrics() /
-# AsyncInferenceServer.metrics() — one schema for both consumers
+# AsyncInferenceServer.metrics() — one schema for both consumers.
+# "imports" counts migrated sequences admitted through the external-
+# prefill path (ISSUE 13 disaggregation).
 LOOP_COUNTER_KEYS = ("preemptions", "restores", "cancellations",
-                     "admitted", "chain_drains")
+                     "admitted", "chain_drains", "imports")
 
 
 @dataclass
@@ -71,6 +73,17 @@ class ServeRequest:
     order: int = 0
     generated: list[int] = field(default_factory=list)
     preemptions: int = 0
+    # cross-mesh migration (ISSUE 13): a KVExportState awaiting
+    # admission — consumed (import_request) the first time the request
+    # is admitted; a later preemption/restore re-prefills from the
+    # host-side history like any parked request
+    kv_import: Optional[object] = None
+    # re-emit the already-generated suffix at admission (closed-loop
+    # callers; the router streams it itself before the hand-off)
+    emit_carried: bool = False
+    # admitted via import this round: the prefill pass must skip it
+    # (its single pending token is the next fused-dispatch input)
+    was_imported: bool = False
 
     @property
     def budget(self) -> int:
@@ -105,9 +118,13 @@ class FusedServeLoop:
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 strict: bool = False, preemption: bool = True):
+                 strict: bool = False, preemption: bool = True,
+                 replica: str = ""):
         cfg = engine._config
         self.e = engine
+        # replica label (ISSUE 13): stamped on every request trace this
+        # loop admits, so the access log names the serving replica
+        self.replica = str(replica)
         self.k = max(1, int(k_steps if k_steps is not None
                             else (cfg.fused_decode_steps or 8)))
         (self.temperature, self.top_k, self.top_p,
@@ -205,6 +222,49 @@ class FusedServeLoop:
             self._rt.enqueue(int(uid), priority=int(priority),
                              prompt_tokens=len(toks),
                              max_new_tokens=max(1, int(max_new_tokens)))
+        return int(uid)
+
+    def submit_imported(self, state, max_new_tokens: int = 32, *,
+                        priority: int = 1, uid: Optional[int] = None,
+                        emit_carried: bool = False) -> int:   # graftsan: domain=worker
+        """Queue a MIGRATED sequence (a
+        :class:`~.ragged.KVExportState` from another engine's
+        ``export_request``) — the external-prefill admission path
+        (ISSUE 13). The KV payload is imported at ADMISSION time, not
+        here, so a queued hand-off holds no pool blocks while it
+        waits; once admitted it joins the fused loop position-exactly
+        with no prefill pass (greedy continuation is bit-identical to
+        a co-located run). ``emit_carried`` re-emits the
+        already-generated suffix as the request's first TokenEvent
+        (closed-loop callers; the router streams those tokens itself
+        during the hand-off). ``max_new_tokens`` is the request's
+        TOTAL generation budget, carried tokens included."""
+        toks = [int(t) for t in state.tokens]
+        n_gen = int(state.n_generated)
+        prompt = toks[:len(toks) - n_gen]
+        generated = toks[len(toks) - n_gen:]
+        if not prompt:
+            raise ValueError(
+                "submit_imported() needs at least one prompt token")
+        max_new = max(1, int(max_new_tokens))
+        if max_new <= n_gen:
+            raise ValueError(
+                f"imported request already generated {n_gen} of "
+                f"{max_new} tokens — nothing left to decode (finish "
+                "such requests without a hand-off)")
+        if uid is None:
+            uid = next(self._uid)
+        req = ServeRequest(uid=int(uid), prompt=prompt,
+                           max_new_tokens=max_new,
+                           priority=int(priority),
+                           order=next(self._order),
+                           generated=generated, kv_import=state,
+                           emit_carried=bool(emit_carried))
+        self.waiting.append(req)
+        if self._rt is not None:
+            self._rt.enqueue(int(uid), priority=int(priority),
+                             prompt_tokens=len(prompt),
+                             max_new_tokens=max_new)
         return int(uid)
 
     def cancel(self, uid: int) -> None:
@@ -350,9 +410,9 @@ class FusedServeLoop:
                 # priority occupant to free its row
                 if self._try_preempt(req, 0, ev, free_rows=True):
                     free = mgr.available_blocks - sum(
-                        mgr.admission_cost(r.admission_tokens,
-                                           -(-(len(r.admission_tokens)
-                                               + r.budget) // bs))
+                        self._admission_cost(
+                            mgr, r, -(-(len(r.admission_tokens)
+                                        + r.budget) // bs))
                         for r in batch)
                     continue
                 break
@@ -371,13 +431,13 @@ class FusedServeLoop:
                 ev.append(TokenEvent(req.uid, [], finished=True,
                                      error=msg))
                 continue
-            cost = mgr.admission_cost(toks, need)
+            cost = self._admission_cost(mgr, req, need)
             if cost > free:
                 if self._try_preempt(req, cost - free, ev):
                     free = mgr.available_blocks - sum(
-                        mgr.admission_cost(r.admission_tokens,
-                                           -(-(len(r.admission_tokens)
-                                               + r.budget) // bs))
+                        self._admission_cost(
+                            mgr, r, -(-(len(r.admission_tokens)
+                                        + r.budget) // bs))
                         for r in batch)
                     continue        # re-check the same request
                 break
@@ -389,16 +449,56 @@ class FusedServeLoop:
                                waiting=len(self.waiting))
         if not batch:
             return []
-        e.schedule([r.uid for r in batch],
-                   [r.admission_tokens for r in batch])
-        # the whole batch joins the tracked sets BEFORE reserving: a
-        # reserve failure mid-batch must leave every scheduled uid
-        # visible to the block-leak guard
+        fresh = [r for r in batch if r.kv_import is None]
+        if fresh:
+            e.schedule([r.uid for r in fresh],
+                       [r.admission_tokens for r in fresh])
+        # the whole batch joins the tracked sets BEFORE importing /
+        # reserving: a failure mid-batch must leave every scheduled
+        # uid visible to the block-leak guard
         for i, r in enumerate(batch):
             if self.ring_mode and i >= stage_from:
                 self.staged[r.uid] = r
             else:
                 self.live[r.uid] = r
+        qd = len(self.waiting)
+        for r in [r for r in batch if r.kv_import is not None]:
+            # external-prefill admission (ISSUE 13): the migrated KV
+            # payload lands NOW — position-exact, no prefill pass
+            state, r.kv_import = r.kv_import, None
+            if self._rt is not None:
+                self._rt.admitted(r.uid, queue_depth=qd,
+                                  replica=self.replica)
+            try:
+                e.import_request(r.uid, state)
+            except (RuntimeError, ValueError) as err:
+                # defensive: a layout mismatch must fail the request,
+                # not wedge the loop (headroom races cannot happen —
+                # the loop is single-threaded and cost was checked)
+                self.live.pop(r.uid, None)
+                self.staged.pop(r.uid, None)
+                batch.remove(r)
+                if self._rt is not None:
+                    self._rt.finished(r.uid, "failed", error=str(err))
+                ev.append(TokenEvent(r.uid, [], finished=True,
+                                     error=str(err)))
+                continue
+            r.was_imported = True
+            self.counters["imports"] += 1
+            if self._rt is not None:
+                self._rt.migrated(r.uid, replica=self.replica,
+                                  nbytes=state.payload_bytes,
+                                  blocks=state.payload_blocks,
+                                  source=state.source)
+            if r.emit_carried and r.generated:
+                ev.append(TokenEvent(r.uid, list(r.generated)))
+                if self._lat is not None:
+                    self._lat.tokens(r.uid, len(r.generated),
+                                     first=True)
+                if self._rt is not None:
+                    self._rt.tokens_landed(r.uid, len(r.generated))
+        if not batch:
+            return []
         for r in batch:
             mgr.reserve(r.uid, r.budget)
         self.counters["admitted"] += len(batch)
@@ -407,13 +507,24 @@ class FusedServeLoop:
                                          and r.generated)
         if self._rt is not None:
             qd = len(self.waiting)
-            for r in batch:
+            for r in fresh:
                 seen = mgr.seqs[r.uid].seen
                 self._rt.admitted(
                     r.uid, queue_depth=qd, cached_tokens=seen,
                     cached_blocks=seen // bs,
-                    restore=r.preemptions > 0 and bool(r.generated))
+                    restore=r.preemptions > 0 and bool(r.generated),
+                    replica=self.replica)
         return [r.uid for r in batch]
+
+    def _admission_cost(self, mgr, req: ServeRequest,
+                        need: int) -> int:
+        """Blocks one admission consumes from the available headroom:
+        a migrated request (ISSUE 13) allocates its FULL history fresh
+        at import — no prefix-cache credit — while everything else
+        gets the cache-credited cost."""
+        if req.kv_import is not None:
+            return need
+        return mgr.admission_cost(req.admission_tokens, need)
 
     def _try_preempt(self, req: ServeRequest, short_blocks: int,
                      ev: list[TokenEvent],
@@ -444,6 +555,9 @@ class FusedServeLoop:
             self.staged.pop(v.uid, None)
             self.live.pop(v.uid, None)
             v.preemptions += 1
+            # a once-imported victim restores through the normal
+            # re-prefill path (its KV left the pool with the park)
+            v.was_imported = False
             self.waiting.append(v)
             self.counters["preemptions"] += 1
             if self._lat is not None:
@@ -470,9 +584,16 @@ class FusedServeLoop:
         generated token — sampled with the same op and position keying
         as the in-graph loop, so it belongs to the same stochastic
         stream (port of the PR 1 closure)."""
-        from ...ops import sampling
         e, mgr, tel = self.e, self.e.state_manager, self._tel
-        filling = list(uids_new)
+        # migrated admissions (ISSUE 13) arrive ALREADY at the
+        # dispatch-boundary state (one pending token, first token(s)
+        # generated on the exporting side) — running them through the
+        # prefill pass would consume their pending dispatch input
+        filling = []
+        for u in uids_new:
+            req = self.live.get(u) or self.staged.get(u)
+            if req is not None and not req.was_imported:
+                filling.append(u)
         firsts: dict[int, jnp.ndarray] = {}
         with (tel.span("v2/prefill", rows=len(filling))
               if tel is not None else _NULLCM):
@@ -490,18 +611,9 @@ class FusedServeLoop:
             # prefill compute done; first-token sampling/stream-out
             # lands in the first_drain component
             self._rt.prefill_done(uids_f)
-        base = e._base_key(self.seed)
-        row_keys = jax.vmap(lambda u: jax.random.fold_in(base, u))(
-            jnp.asarray(np.asarray(uids_f, np.uint32)))
-        keys = sampling.position_keys(
-            row_keys,
-            jnp.asarray(np.asarray([mgr.seqs[u].seen for u in uids_f])))
-        toks_dev = sampling.sample_tokens_batched(
-            jnp.stack([firsts[u] for u in uids_f]).astype(jnp.float32),
-            keys, temperature=self.temperature, top_k=self.top_k,
-            top_p=self.top_p)
-        for u, tok in zip(uids_f, jax.device_get(toks_dev)):
-            tok = int(tok)
+        toks = e.sample_first_tokens(firsts, self.temperature,
+                                     self.top_k, self.top_p, self.seed)
+        for u, tok in ((u, toks[u]) for u in uids_f):
             req = self.live.get(u) or self.staged.get(u)
             req.generated.append(tok)
             e.serving_stats["decoded_tokens"] += 1

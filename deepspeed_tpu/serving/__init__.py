@@ -1,7 +1,12 @@
-"""Async continuous-batching serving layer (ISSUE 6) — the
-FastGen/DeepSpeed-MII front end over inference v2 (see
-docs/serving.md)."""
+"""Async continuous-batching serving layer (ISSUE 6) and the
+disaggregated multi-replica deployment layer over it (ISSUE 13:
+prefill/decode split, prefix-affinity router, cross-mesh KV
+migration) — the FastGen/DeepSpeed-MII front end over inference v2
+(see docs/serving.md)."""
 
-from .config import ServingConfig  # noqa: F401
+from .config import (DisaggregationConfig, RouterConfig,  # noqa: F401
+                     ServingConfig)
+from .router import (InferenceRouter, PrefillEngine,  # noqa: F401
+                     RoutedHandle)
 from .server import (AsyncInferenceServer, RequestCancelled,  # noqa: F401
                      RequestFailed, RequestHandle)

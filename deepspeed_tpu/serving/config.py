@@ -45,6 +45,10 @@ class ServingConfig(DeepSpeedConfigModel):
     # base PRNG seed for stochastic sampling (position-keyed, so
     # restarts/preemptions resume the same stream)
     seed: int = 0
+    # replica label (ISSUE 13): stamped on every request trace this
+    # server admits so the access log / bench report name the serving
+    # replica. The router assigns replica0..N-1 when left empty.
+    replica: str = ""
     # worker-thread sleep while idle or waiting on admission headroom
     idle_poll_s: float = Field(0.002, gt=0.0)
     # --- serving SLO targets (ISSUE 10) ------------------------------
@@ -55,3 +59,55 @@ class ServingConfig(DeepSpeedConfigModel):
     # same for the request's MEAN inter-token latency ->
     # ds_serving_slo_itl_breaches_total. 0 = no target.
     slo_itl_ms: float = Field(0.0, ge=0.0)
+
+
+class DisaggregationConfig(DeepSpeedConfigModel):
+    """Prefill/decode disaggregation (ISSUE 13): with a
+    :class:`~deepspeed_tpu.serving.PrefillEngine` attached to the
+    router, qualifying prompts run chunked prefill on the dedicated
+    prefill engine/mesh and migrate to a decode replica as a
+    serialized KV block set (``export_request``/``import_request``) —
+    long-prompt admission stops stealing decode ticks. Quantized KV
+    blocks travel in their storage format (no dequantize), and greedy
+    continuation on the decode side is bit-identical to a co-located
+    run."""
+    enabled: bool = False
+    # prompts with at least this many tokens take the disaggregated
+    # path; shorter prompts prefill co-located on their decode replica
+    # (a short prompt's hand-off costs more than its prefill steals).
+    # 0 = every prompt migrates.
+    prefill_threshold_tokens: int = Field(0, ge=0)
+
+
+class RouterConfig(DeepSpeedConfigModel):
+    """Prefix-affinity multi-replica router
+    (``deepspeed_tpu.serving.InferenceRouter``) fronting N decode
+    ``AsyncInferenceServer`` replicas (ISSUE 13): requests place onto
+    the replica whose prefix cache already holds the longest
+    hash-chained match for the prompt (same-system-prompt traffic
+    lands where the blocks are warm), with least-loaded fallback,
+    per-replica admission backpressure, and drain-and-reroute when a
+    replica's pool is exhausted. See docs/serving.md."""
+    # a cached-prefix match shorter than this many full blocks does
+    # not steer placement (least-loaded wins instead)
+    min_affinity_blocks: int = Field(1, ge=1)
+    # per-replica admission backpressure: a replica with this many
+    # open requests is skipped at placement. 0 = only the replica's
+    # own max_queue applies.
+    max_open_per_replica: int = Field(0, ge=0)
+    # drain watermark: a replica whose schedulable KV headroom falls
+    # below this many blocks stops receiving NEW work (it drains its
+    # residents) unless every replica is below it. 0 = disabled.
+    drain_free_block_watermark: int = Field(0, ge=0)
+    # a request that fails on its replica (pool exhausted, replica
+    # died) is transparently resubmitted — prompt + tokens already
+    # streamed, same uid, so greedy and position-keyed stochastic
+    # streams continue exactly — to the next-best replica this many
+    # times before the failure surfaces to the client
+    reroute_retries: int = Field(2, ge=0)
+    # asyncio backoff while every replica is backpressured
+    retry_backoff_s: float = Field(0.005, gt=0.0)
+    # prefill/decode disaggregation (requires a PrefillEngine on the
+    # router)
+    disaggregation: DisaggregationConfig = Field(
+        default_factory=DisaggregationConfig)

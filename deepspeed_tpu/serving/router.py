@@ -1,0 +1,503 @@
+"""Disaggregated, multi-replica serving (ISSUE 13 tentpole): a
+prefix-affinity router fronting N decode ``AsyncInferenceServer``
+replicas, plus the dedicated prefill engine whose finished sequences
+migrate to a decode replica as serialized KV block sets — the
+MII/FastGen deployment layer over inference v2.
+
+Three pieces:
+
+- :class:`PrefillEngine` — wraps an ``InferenceEngineV2`` reserved for
+  chunked prefill (its own mesh/devices on TPU; long-prompt admission
+  stops stealing decode ticks). One dedicated worker thread owns every
+  engine call (the thread-affinity contract); ``prefill()`` runs the
+  chunked prefill + first-token sampling bit-identically to a
+  co-located serve loop and returns the sequence as a
+  ``KVExportState`` — quantized KV blocks and scale slabs travel
+  as-is, no dequantize.
+
+- :class:`InferenceRouter` — places each request on the replica whose
+  hash-chained prefix cache holds the LONGEST match for the prompt
+  (same-system-prompt traffic lands where the blocks are warm), with
+  least-loaded fallback, per-replica admission backpressure
+  (``max_open_per_replica``), a drain watermark that steers new work
+  away from a pool-exhausted replica, and drain-and-reroute: a request
+  failing on its replica resubmits — prompt + tokens already streamed,
+  SAME uid, so the position-keyed stream continues exactly — to the
+  next-best replica.
+
+- :class:`RoutedHandle` — the client-side stream: one async iterator
+  per request regardless of how many engines served it (prefill
+  hand-off, migrations and reroutes are invisible except in the
+  request trace, where ``migrate``/``handoff`` events and the replica
+  label record every hop).
+
+Everything here is host-only orchestration (graftlint host-only
+package audit applies): all JAX work happens inside the engines, on
+their owning threads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence
+
+from ..utils.logging import log_dist
+from ..utils.telemetry_probe import active_telemetry as _telemetry
+from .config import RouterConfig
+from .server import AsyncInferenceServer, RequestFailed
+
+_DONE = object()
+
+# router decision/outcome counters (metrics() schema)
+ROUTER_COUNTER_KEYS = (
+    "routed_affinity", "routed_least_loaded", "backpressure_skips",
+    "drain_skips", "reroutes", "prefill_handoffs", "migrated_bytes",
+    "completed", "failed", "cancelled")
+
+
+class PrefillEngine:
+    """See module docstring. Construct over a dedicated
+    ``InferenceEngineV2``; sampling parameters default to that
+    engine's config (they must match the decode replicas' for the
+    hand-off to be bit-identical — greedy always is)."""
+
+    def __init__(self, engine, *, name: str = "prefill0",
+                 temperature: Optional[float] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed: int = 0):
+        self.engine = engine
+        self.name = str(name)
+        self._sampling = (temperature, top_k, top_p)
+        self.seed = int(seed)
+        # ONE worker thread owns every engine/JAX call — max_workers=1
+        # pins all prefill dispatch to a single thread, satisfying the
+        # graftsan thread-affinity contract without a rebind dance
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"ds-prefill-{name}")
+        self.stats = {"prefills": 0, "exported_bytes": 0,
+                      "exported_blocks": 0, "prefill_tokens": 0}
+        self._lock = threading.Lock()
+
+    def _work(self, uid: int, prompt: list[int]):  # graftsan: domain=worker
+        """Worker-thread body: chunked prefill + first token + export.
+        The engine is left empty (export flushes) — the prefill pool
+        only ever holds in-flight prompts."""
+        t, k, p = self._sampling
+        tok = self.engine.prefill_request(uid, prompt, temperature=t,
+                                          top_k=k, top_p=p,
+                                          seed=self.seed)
+        state = self.engine.export_request(uid, n_generated=1,
+                                           source=self.name)
+        with self._lock:
+            self.stats["prefills"] += 1
+            self.stats["exported_bytes"] += state.payload_bytes
+            self.stats["exported_blocks"] += state.payload_blocks
+            self.stats["prefill_tokens"] += len(prompt)
+        return tok, state
+
+    async def prefill(self, uid: int, prompt: Sequence[int]):
+        """Run one prompt through the prefill mesh; returns
+        ``(first_token, KVExportState)`` without blocking the event
+        loop."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._ex, self._work, int(uid),
+            [int(t) for t in prompt])
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return dict(self.stats, name=self.name)
+
+    def close(self) -> None:
+        self._ex.shutdown(wait=True)
+        aff = getattr(self.engine, "_affinity", None)
+        if aff is not None:
+            # release engine ownership (the worker thread is gone) so
+            # a later driver on another thread re-binds instead of
+            # tripping the thread-affinity sanitizer — the same exit
+            # contract as the async server's worker
+            aff.unbind()
+
+
+class RoutedHandle:
+    """Per-request stream across replicas: ``async for tok in handle``
+    yields int token ids exactly once each, no matter which engine
+    produced them. ``replica`` names the decode replica currently
+    serving the request (updates on reroute)."""
+
+    def __init__(self, uid: int):
+        self.uid = uid
+        self.replica: Optional[str] = None
+        self.error: Optional[str] = None
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._finished = False
+        self._inner = None            # live replica RequestHandle
+        self._cancelled = False
+
+    def _push(self, tokens: list[int]) -> None:
+        # one queue item per token: a multi-token delivery must not
+        # interleave with a later push (re-queueing a chunk tail
+        # behind newer items would reorder the stream)
+        for t in tokens:
+            self._q.put_nowait(int(t))
+
+    def _finish(self, error: Optional[str] = None) -> None:
+        self.error = error
+        self._q.put_nowait(_DONE)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> int:
+        from .server import RequestCancelled
+        while True:
+            if self._finished:
+                raise StopAsyncIteration
+            item = await self._q.get()
+            if item is _DONE:
+                self._finished = True
+                if self.error == "cancelled":
+                    raise RequestCancelled(f"request {self.uid}")
+                if self.error:
+                    raise RequestFailed(self.error)
+                raise StopAsyncIteration
+            return item
+
+    async def tokens(self) -> list[int]:
+        return [t async for t in self]
+
+    def cancel(self) -> None:
+        """Drop the request on whichever replica currently runs it."""
+        self._cancelled = True
+        if self._inner is not None:
+            self._inner.cancel()
+
+
+class InferenceRouter:
+    """See module docstring. Typical use::
+
+        replicas = [AsyncInferenceServer(e) for e in engines]
+        router = InferenceRouter(replicas,
+                                 RouterConfig(disaggregation={
+                                     "enabled": True}),
+                                 prefill=PrefillEngine(prefill_engine))
+        async with router:
+            h = await router.submit(prompt_ids, max_new_tokens=256)
+            async for tok in h:
+                ...
+
+    The router owns the replicas' lifecycle (started on ``__aenter__``,
+    drained and stopped on exit). Every request gets a router-global
+    uid, so one request keeps one trace across the prefill hand-off,
+    migration and any reroute."""
+
+    def __init__(self, replicas: Sequence[AsyncInferenceServer],
+                 config=None, *,
+                 prefill: Optional[PrefillEngine] = None):
+        if not replicas:
+            raise ValueError("InferenceRouter needs >= 1 replica")
+        if config is None:
+            config = RouterConfig()
+        elif isinstance(config, dict):
+            config = RouterConfig(**config)
+        self.config = config
+        self.prefill = prefill
+        if (config.disaggregation.enabled and prefill is None):
+            raise ValueError(
+                "disaggregation.enabled requires a PrefillEngine "
+                "(router(..., prefill=PrefillEngine(engine)))")
+        self.replicas: list[tuple[str, AsyncInferenceServer]] = []
+        for i, srv in enumerate(replicas):
+            if not srv.config.replica:
+                srv.config.replica = f"replica{i}"
+            self.replicas.append((srv.config.replica, srv))
+        self._uid = itertools.count()
+        self._tasks: set = set()
+        self.stats = dict.fromkeys(ROUTER_COUNTER_KEYS, 0)
+        self.placed: dict[str, int] = {n: 0 for n, _ in self.replicas}
+        tel = _telemetry()
+        self._rt = (tel.get_request_recorder() if tel is not None
+                    else None)
+
+    # -- lifecycle -----------------------------------------------------
+    async def __aenter__(self):
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.stop(drain=exc[0] is None)
+
+    async def start(self) -> None:
+        for _, srv in self.replicas:
+            await srv.start()
+        log_dist(f"InferenceRouter: {len(self.replicas)} replica(s) "
+                 f"[{', '.join(n for n, _ in self.replicas)}]"
+                 + (f" + prefill engine '{self.prefill.name}'"
+                    if self.prefill is not None else ""))
+
+    async def stop(self, drain: bool = True) -> None:
+        if self._tasks:
+            if drain:
+                await asyncio.gather(*self._tasks,
+                                     return_exceptions=True)
+            else:
+                for t in self._tasks:
+                    t.cancel()
+                await asyncio.gather(*self._tasks,
+                                     return_exceptions=True)
+        for _, srv in self.replicas:
+            await srv.stop(drain=drain)
+        if self.prefill is not None:
+            self.prefill.close()
+
+    # -- placement -----------------------------------------------------
+    def _place(self, tokens: list[int], record: bool = True):
+        """Ordered candidate replicas for one request. Affinity first:
+        the replica with the longest cached prefix chain (>=
+        ``min_affinity_blocks``) wins; ties and no-affinity traffic go
+        least-loaded. Backpressured replicas (open-request cap, drain
+        watermark) are skipped unless nothing else accepts.
+        ``record=False`` on backoff re-polls keeps the skip counters
+        meaning 'placement decisions', not 'poll ticks'."""
+        cfg = self.config
+        rows, drained = [], []
+        for name, srv in self.replicas:
+            if not srv.accepting:
+                continue
+            open_ = srv.open_requests
+            if cfg.max_open_per_replica \
+                    and open_ >= cfg.max_open_per_replica:
+                if record:
+                    self.stats["backpressure_skips"] += 1
+                continue
+            row = (name, srv, srv.prefix_affinity(tokens), open_)
+            if cfg.drain_free_block_watermark \
+                    and srv.free_blocks < cfg.drain_free_block_watermark:
+                # pool nearly exhausted: let it drain — route new work
+                # elsewhere (kept as last resort if everyone is dry)
+                if record:
+                    self.stats["drain_skips"] += 1
+                drained.append(row)
+                continue
+            rows.append(row)
+        if not rows:
+            rows = drained
+        if not rows:
+            return [], "none"
+        best_aff = max(r[2] for r in rows)
+        if best_aff >= cfg.min_affinity_blocks:
+            rows.sort(key=lambda r: (-r[2], r[3], r[0]))
+            return [(n, s) for n, s, _, _ in rows], "affinity"
+        rows.sort(key=lambda r: (r[3], r[0]))
+        return [(n, s) for n, s, _, _ in rows], "least_loaded"
+
+    # -- request intake ------------------------------------------------
+    async def submit(self, prompt: Sequence[int], *,
+                     max_new_tokens: Optional[int] = None,
+                     priority: Optional[int] = None) -> RoutedHandle:
+        """Route one generation request; returns its streaming handle
+        immediately (placement, prefill hand-off and any reroutes run
+        in a background task)."""
+        toks = [int(t) for t in prompt]
+        if not toks:
+            raise ValueError("submit() needs at least one prompt token")
+        uid = next(self._uid)
+        handle = RoutedHandle(uid)
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.replicas[0][1]
+                      .config.default_max_new_tokens)
+        task = asyncio.ensure_future(
+            self._drive(handle, toks, max_new, priority))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return handle
+
+    async def generate(self, prompt: Sequence[int], **kw) -> list[int]:
+        h = await self.submit(prompt, **kw)
+        return await h.tokens()
+
+    async def _drive(self, handle: RoutedHandle, prompt: list[int],
+                     max_new: int, priority) -> None:
+        """One request's whole journey: optional disaggregated
+        prefill, placement, streaming, drain-and-reroute."""
+        cfg = self.config
+        uid = handle.uid
+        got: list[int] = []
+        state = None
+        try:
+            if self._rt is not None:
+                # the router's submit time opens the trace; every
+                # engine-side event lands on this one record
+                self._rt.enqueue(uid, priority=int(priority or 0),
+                                 prompt_tokens=len(prompt),
+                                 max_new_tokens=max_new)
+            dis = cfg.disaggregation
+            if (self.prefill is not None and dis.enabled
+                    and len(prompt) >= dis.prefill_threshold_tokens):
+                if self._rt is not None:
+                    # the prefill leg's lifecycle is the router's to
+                    # record (PrefillEngine is trace-agnostic): admit
+                    # before, prefill_done after, so the TTFT
+                    # decomposition attributes the prefill wall
+                    # instead of folding it into queue_wait
+                    self._rt.admitted(uid, replica=self.prefill.name)
+                tok0, state = await self.prefill.prefill(uid, prompt)
+                self.stats["prefill_handoffs"] += 1
+                self.stats["migrated_bytes"] += state.payload_bytes
+                if self._rt is not None:
+                    self._rt.prefill_done([uid])
+                    self._rt.handoff(uid, source=self.prefill.name)
+                    self._rt.tokens_landed(uid, 1)
+                got.append(tok0)
+                handle._push([tok0])
+                eos = self.replicas[0][1].config.eos_token_id
+                if max_new <= 1 or (eos is not None and tok0 == eos):
+                    # satisfied by prefill alone: no decode hand-off
+                    self._consume_state(state)
+                    state = None
+                    if self._rt is not None:
+                        self._rt.finished(uid, "completed")
+                    self.stats["completed"] += 1
+                    handle._finish()
+                    return
+            reroutes = 0
+            polls = 0
+            failed_on: set[str] = set()
+            while True:
+                if handle._cancelled:
+                    raise _Cancelled()
+                cands, rule = self._place(prompt, record=polls == 0)
+                polls += 1
+                # a replica that just failed this request must not get
+                # it straight back (its affinity score still wins —
+                # the blocks are warm — but its pool just proved dry);
+                # when everything has failed once, anyone may retry
+                filtered = [(n, s) for n, s in cands
+                            if n not in failed_on]
+                cands = filtered or cands
+                if not cands:
+                    if not any(s.accepting for _, s in self.replicas):
+                        raise RequestFailed(
+                            "no replica is accepting requests")
+                    await asyncio.sleep(cfg.retry_backoff_s)
+                    continue
+                placed = False
+                for name, srv in cands:
+                    try:
+                        if state is not None:
+                            h = await srv.submit_imported(
+                                state, max_new_tokens=max_new,
+                                priority=priority, uid=uid)
+                            state = None
+                        elif got:
+                            # reroute continuation: the already-
+                            # streamed tokens join the prompt, same
+                            # uid — the position-keyed stream resumes
+                            # exactly where the dead replica left off
+                            h = await srv.submit(
+                                prompt + got,
+                                max_new_tokens=max_new - len(got),
+                                priority=priority, uid=uid)
+                        else:
+                            h = await srv.submit(
+                                prompt, max_new_tokens=max_new,
+                                priority=priority, uid=uid)
+                    except RuntimeError:
+                        # replica-level admission refusal (queue full,
+                        # stopping): try the next candidate
+                        self.stats["backpressure_skips"] += 1
+                        continue
+                    placed = True
+                    key = ("routed_affinity" if rule == "affinity"
+                           else "routed_least_loaded")
+                    self.stats[key] += 1
+                    self.placed[name] = self.placed.get(name, 0) + 1
+                    handle.replica = name
+                    handle._inner = h
+                    break
+                if not placed:
+                    await asyncio.sleep(cfg.retry_backoff_s)
+                    continue
+                try:
+                    async for t in h:
+                        got.append(t)
+                        handle._push([t])
+                    self.stats["completed"] += 1
+                    handle._finish()
+                    return
+                except RequestFailed as err:
+                    # drain-and-reroute: the replica's pool rejected or
+                    # dropped the request mid-stream — move it on
+                    handle._inner = None
+                    failed_on.add(name)
+                    reroutes += 1
+                    self.stats["reroutes"] += 1
+                    if reroutes > cfg.reroute_retries:
+                        raise RequestFailed(
+                            f"request {uid} failed after {reroutes - 1} "
+                            f"reroute(s): {err}") from err
+        except _Cancelled:
+            self._consume_state(state)
+            self.stats["cancelled"] += 1
+            if self._rt is not None:
+                self._rt.finished(uid, "cancelled")
+            handle._finish(error="cancelled")
+        except asyncio.CancelledError:
+            self._consume_state(state)
+            self.stats["cancelled"] += 1
+            handle._finish(error="cancelled")
+            raise
+        except BaseException as err:   # noqa: BLE001 — surfaced on the stream
+            self._consume_state(state)
+            from .server import RequestCancelled
+            if isinstance(err, RequestCancelled):
+                self.stats["cancelled"] += 1
+                handle._finish(error="cancelled")
+                return
+            self.stats["failed"] += 1
+            if self._rt is not None:
+                self._rt.finished(uid, "failed", error=str(err))
+            handle._finish(error=str(err))
+
+    @staticmethod
+    def _consume_state(state) -> None:
+        """A hand-off that will never be imported (finished at
+        prefill, cancelled, or terminally failed before placement)
+        still reached its terminal consumer: clear its blocksan
+        transit entry, or a correctly-completed request would read as
+        dropped-in-transit (and leak a ledger entry) at the next
+        check_transit()."""
+        if state is None or state.handoff_id is None:
+            return
+        from ..analysis import blocksan
+        blocksan.record_import(state.handoff_id)
+
+    # -- observability -------------------------------------------------
+    def metrics(self) -> dict:
+        """Router counters plus one row per replica (open requests,
+        placements, the replica's own serving metrics subset) and the
+        prefill engine's stats."""
+        out = dict(self.stats)
+        out["replicas"] = {}
+        for name, srv in self.replicas:
+            m = srv.metrics()
+            out["replicas"][name] = {
+                "open_requests": srv.open_requests,
+                "placed": self.placed.get(name, 0),
+                "free_blocks": srv.free_blocks,
+                "decoded_tokens": m.get("decoded_tokens", 0),
+                "imports": m.get("imports", 0),
+                "prefix_hit_rate": m.get("prefix_hit_rate", 0.0),
+                "prefill_tokens_saved": m.get("prefill_tokens_saved",
+                                              0),
+            }
+        if self.prefill is not None:
+            out["prefill"] = self.prefill.metrics()
+        return out
+
+
+class _Cancelled(Exception):
+    """Internal: the routed request was cancelled before placement."""

@@ -161,7 +161,8 @@ class AsyncInferenceServer:
             self.engine, k_steps=cfg.k_steps,
             temperature=cfg.temperature, top_k=cfg.top_k,
             top_p=cfg.top_p, eos_id=cfg.eos_token_id, seed=cfg.seed,
-            strict=False, preemption=cfg.preemption)
+            strict=False, preemption=cfg.preemption,
+            replica=cfg.replica)
         tel = _telemetry()
         self._rt = (tel.get_request_recorder() if tel is not None
                     else None)
@@ -201,11 +202,10 @@ class AsyncInferenceServer:
         if self._worker_error is not None:
             raise self._worker_error
 
-    async def submit(self, prompt: Sequence[int], *,
-                     max_new_tokens: Optional[int] = None,
-                     priority: Optional[int] = None) -> RequestHandle:
-        """Queue one generation request; returns its streaming handle.
-        Raises when the server is stopped or ``max_queue`` is hit."""
+    def _admit_handle(self, max_new_tokens, priority,
+                      uid, prompt_tokens: int):
+        """Shared submit-side bookkeeping: accept/backpressure checks,
+        handle + trace registration. Returns (handle, max_new, prio)."""
         if not self._accepting:
             raise RuntimeError("server is not accepting requests")
         if self._worker_error is not None:
@@ -216,11 +216,15 @@ class AsyncInferenceServer:
             raise RuntimeError(
                 f"serving queue full ({self._open} open requests >= "
                 f"max_queue {cfg.max_queue})")
-        uid = next(self._uid)
+        # callers spanning several replicas (the router) pass their own
+        # globally-unique uid so one request keeps ONE trace across
+        # prefill hand-off, migration and reroute
+        uid = next(self._uid) if uid is None else int(uid)
+        if uid in self._handles:
+            raise RuntimeError(f"request uid {uid} already open")
         handle = RequestHandle(uid, self)
         self._handles[uid] = handle
         self._open += 1
-        toks = [int(t) for t in prompt]
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else cfg.default_max_new_tokens)
         prio = int(priority if priority is not None
@@ -228,10 +232,52 @@ class AsyncInferenceServer:
         if self._rt is not None:
             # the trace's enqueue timestamp is the client-visible
             # submit time — mailbox marshalling counts as queue wait
+            # (idempotent: a router-owned trace keeps its original id)
             handle.trace_id = self._rt.enqueue(
-                uid, priority=prio, prompt_tokens=len(toks),
+                uid, priority=prio, prompt_tokens=prompt_tokens,
                 max_new_tokens=max_new)
-        self._post(("submit", uid, toks, max_new, prio))
+        return handle, max_new, prio
+
+    async def submit(self, prompt: Sequence[int], *,
+                     max_new_tokens: Optional[int] = None,
+                     priority: Optional[int] = None,
+                     uid: Optional[int] = None) -> RequestHandle:
+        """Queue one generation request; returns its streaming handle.
+        Raises when the server is stopped or ``max_queue`` is hit."""
+        toks = [int(t) for t in prompt]
+        handle, max_new, prio = self._admit_handle(
+            max_new_tokens, priority, uid, len(toks))
+        self._post(("submit", handle.uid, toks, max_new, prio))
+        return handle
+
+    async def submit_imported(self, state, *,
+                              max_new_tokens: Optional[int] = None,
+                              priority: Optional[int] = None,
+                              uid: Optional[int] = None,
+                              emit_carried: bool = False
+                              ) -> RequestHandle:
+        """Queue a MIGRATED sequence (a ``KVExportState`` from another
+        engine's ``export_request``) — the decode half of a
+        disaggregated hand-off (ISSUE 13). The KV payload lands in
+        this replica's pool at admission, position-exactly; with
+        ``emit_carried`` the already-generated tokens re-emit at the
+        head of the stream (the router leaves it off — it already
+        streamed them during the hand-off)."""
+        n_gen = int(state.n_generated)
+        n_prompt = len(state.tokens) - n_gen
+        if n_prompt <= 0:
+            raise ValueError(
+                "submit_imported() needs at least one prompt token")
+        max_new_chk = int(max_new_tokens if max_new_tokens is not None
+                          else self.config.default_max_new_tokens)
+        if max_new_chk <= n_gen:
+            raise ValueError(
+                f"imported request already generated {n_gen} of "
+                f"{max_new_chk} tokens — finish it without a hand-off")
+        handle, max_new, prio = self._admit_handle(
+            max_new_tokens, priority, uid, n_prompt)
+        self._post(("submit_imported", handle.uid, state, max_new,
+                    prio, bool(emit_carried)))
         return handle
 
     async def generate(self, prompt: Sequence[int], **kw) -> list[int]:
@@ -241,13 +287,44 @@ class AsyncInferenceServer:
 
     def metrics(self) -> dict:
         """Engine serving counters merged with the scheduler's
-        (preemptions/restores/cancellations/admitted/chain_drains) and
-        the open-request gauge."""
+        (preemptions/restores/cancellations/admitted/chain_drains/
+        imports) and the open-request gauge."""
         m = dict(self.engine.serving_metrics())
         if self.session is not None:
             m.update(self.session.counters)
         m["open_requests"] = self._open
+        m["replica"] = self.config.replica
         return m
+
+    # -- router-facing placement probes (ISSUE 13; all host-only) ------
+    @property
+    def accepting(self) -> bool:
+        """True while submits are admitted (started, not stopping,
+        worker alive)."""
+        return bool(self._accepting) and self._worker_error is None
+
+    @property
+    def open_requests(self) -> int:
+        """Queued + running requests (the router's load signal)."""
+        return self._open
+
+    @property
+    def free_blocks(self) -> int:
+        """Schedulable KV headroom of this replica's pool (truly free
+        plus evictable prefix-cached blocks; GIL-atomic reads of
+        worker-owned accounting — a placement HINT, not a
+        reservation)."""
+        return self.engine.free_blocks
+
+    def prefix_affinity(self, tokens) -> int:
+        """FULL leading blocks of ``tokens`` this replica's prefix
+        cache already holds (the hash-chained match from PR 4) — the
+        router's placement key. Pure host-side query against
+        worker-owned dicts (point ``get`` lookups only, GIL-atomic);
+        the match is re-walked under the worker at admission, so a
+        stale answer costs placement quality, never correctness."""
+        return len(self.engine.state_manager.prefix_match(
+            [int(t) for t in tokens]))
 
     # ------------------------------------------------------------------
     def _post(self, msg: tuple) -> None:
@@ -335,6 +412,10 @@ class AsyncInferenceServer:
             if m[0] == "submit":
                 _, uid, prompt, max_new, prio = m
                 s.submit(prompt, max_new, priority=prio, uid=uid)
+            elif m[0] == "submit_imported":
+                _, uid, state, max_new, prio, emit = m
+                s.submit_imported(state, max_new, priority=prio,
+                                  uid=uid, emit_carried=emit)
             elif m[0] == "cancel":
                 s.cancel(m[1])
             elif m[0] == "stop":

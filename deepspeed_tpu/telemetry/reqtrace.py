@@ -61,13 +61,18 @@ ACCESS_LOG_KEYS = (
     "trace_id", "uid", "priority", "prompt_tokens", "output_tokens",
     "max_new_tokens", "cached_blocks", "cached_tokens",
     "queue_depth_at_admit", "preemptions", "drains", "dispatches",
-    "spec_tokens_extra", "outcome", "error", "enqueue_unix_s",
-    "ttft_ms", "itl_mean_ms", "total_ms", "queue_wait_ms",
-    "prefill_ms", "first_drain_ms", "decode_active_ms",
-    "boundary_gap_ms", "preempt_stall_ms")
+    "spec_tokens_extra", "replica", "migrate_bytes", "outcome",
+    "error", "enqueue_unix_s", "ttft_ms", "itl_mean_ms", "total_ms",
+    "queue_wait_ms", "prefill_ms", "migrate_ms", "first_drain_ms",
+    "decode_active_ms", "boundary_gap_ms", "preempt_stall_ms")
 
-# the latency components the percentile gauges / bench breakdown report
-COMPONENT_KEYS = ("queue_wait", "prefill", "first_drain",
+# the latency components the percentile gauges / bench breakdown
+# report. "migrate" (ISSUE 13) is the cross-mesh KV hand-off leg of a
+# disaggregated request — export, wire, import, and the importing
+# replica's admission queueing; zero for co-located requests, so the
+# TTFT telescoping TTFT = queue_wait + prefill + migrate + first_drain
+# stays exact either way.
+COMPONENT_KEYS = ("queue_wait", "prefill", "migrate", "first_drain",
                   "decode_active", "boundary_gap", "preempt_stall")
 
 _EVENT_CAP = 256            # per-request event-list bound
@@ -82,10 +87,11 @@ class RequestTrace:
     __slots__ = (
         "uid", "trace_id", "priority", "prompt_tokens",
         "max_new_tokens", "t_enqueue", "enqueue_unix",
-        "t_admit", "t_prefill_done", "t_first", "t_last", "t_finish",
-        "queue_depth_at_admit", "cached_tokens", "cached_blocks",
-        "preemptions", "tokens", "drains", "dispatches",
-        "spec_tokens_extra", "decode_active_s", "boundary_gap_s",
+        "t_admit", "t_prefill_done", "t_migrate_done", "t_first",
+        "t_last", "t_finish", "queue_depth_at_admit", "cached_tokens",
+        "cached_blocks", "preemptions", "tokens", "drains",
+        "dispatches", "spec_tokens_extra", "replica", "migrate_bytes",
+        "migrate_blocks", "decode_active_s", "boundary_gap_s",
         "preempt_stall_s", "park_open_t", "parks", "events",
         "outcome", "error", "_t_prev_token", "_state")
 
@@ -101,6 +107,7 @@ class RequestTrace:
         self.enqueue_unix = time.time()
         self.t_admit: Optional[float] = None        # first admission
         self.t_prefill_done: Optional[float] = None
+        self.t_migrate_done: Optional[float] = None  # KV import landed
         self.t_first: Optional[float] = None
         self.t_last: Optional[float] = None
         self.t_finish: Optional[float] = None
@@ -112,6 +119,9 @@ class RequestTrace:
         self.drains = 0
         self.dispatches = 0
         self.spec_tokens_extra = 0
+        self.replica = ""
+        self.migrate_bytes = 0
+        self.migrate_blocks = 0
         self.decode_active_s = 0.0
         self.boundary_gap_s = 0.0
         self.preempt_stall_s = 0.0
@@ -145,10 +155,28 @@ class RequestTrace:
         return self.t_prefill_done - self.t_admit
 
     @property
-    def first_drain_s(self) -> float:
-        if self.t_prefill_done is None or self.t_first is None:
+    def migrate_s(self) -> float:
+        """Cross-mesh hand-off leg (ISSUE 13): prefill done (or
+        admission, when the prefill ran on another process) -> KV
+        import landed on the serving replica. 0 for co-located
+        requests, keeping the TTFT telescoping exact either way."""
+        if self.t_migrate_done is None:
             return 0.0
-        return self.t_first - self.t_prefill_done
+        start = self.t_prefill_done if self.t_prefill_done is not None \
+            else self.t_admit
+        if start is None:
+            return 0.0
+        return self.t_migrate_done - start
+
+    @property
+    def first_drain_s(self) -> float:
+        if self.t_first is None:
+            return 0.0
+        start = self.t_migrate_done if self.t_migrate_done is not None \
+            else self.t_prefill_done
+        if start is None:
+            return 0.0
+        return self.t_first - start
 
     @property
     def itl_mean_s(self) -> Optional[float]:
@@ -159,6 +187,7 @@ class RequestTrace:
     def components(self) -> dict[str, float]:
         return {"queue_wait": self.queue_wait_s,
                 "prefill": self.prefill_s,
+                "migrate": self.migrate_s,
                 "first_drain": self.first_drain_s,
                 "decode_active": self.decode_active_s,
                 "boundary_gap": self.boundary_gap_s,
@@ -184,12 +213,15 @@ class RequestTrace:
                 "preemptions": self.preemptions,
                 "drains": self.drains, "dispatches": self.dispatches,
                 "spec_tokens_extra": self.spec_tokens_extra,
+                "replica": self.replica,
+                "migrate_bytes": self.migrate_bytes,
                 "outcome": self.outcome, "error": self.error,
                 "enqueue_unix_s": round(self.enqueue_unix, 6),
                 "ttft_ms": ms(ttft), "itl_mean_ms": ms(itl),
                 "total_ms": ms(total),
                 "queue_wait_ms": ms(self.queue_wait_s),
                 "prefill_ms": ms(self.prefill_s),
+                "migrate_ms": ms(self.migrate_s),
                 "first_drain_ms": ms(self.first_drain_s),
                 "decode_active_ms": ms(self.decode_active_s),
                 "boundary_gap_ms": ms(self.boundary_gap_s),
@@ -249,7 +281,7 @@ class RequestTraceRecorder:
 
     def admitted(self, uid: int, queue_depth: int = 0,
                  cached_tokens: int = 0, cached_blocks: int = 0,
-                 restore: bool = False) -> None:
+                 restore: bool = False, replica: str = "") -> None:
         now = self._clock()
         with self._lock:
             tr = self._active.get(uid)
@@ -259,11 +291,56 @@ class RequestTraceRecorder:
                               {"queue_depth": queue_depth,
                                "cached_blocks": cached_blocks}))
             tr._state = "live"
+            if replica:
+                # the access log names the replica that SERVED the
+                # request: last admission wins (a preempted request
+                # may restore elsewhere after a drain-and-reroute)
+                tr.replica = str(replica)
             if tr.t_admit is None:
                 tr.t_admit = now
                 tr.queue_depth_at_admit = int(queue_depth)
                 tr.cached_tokens = int(cached_tokens)
                 tr.cached_blocks = int(cached_blocks)
+
+    def migrated(self, uid: int, *, replica: str = "", nbytes: int = 0,
+                 blocks: int = 0, source: str = "") -> None:
+        """Cross-mesh KV hand-off landed (ISSUE 13): the migrated
+        block set was imported into ``replica``'s pool. Closes the
+        ``migrate`` leg of the TTFT telescoping — but ONLY when the
+        import gated the first token (first one wins; the event list
+        records every hop). A hand-off whose first token was streamed
+        EARLY by the router (before the import landed) charges the
+        hand-off wait to the inter-token gap accounting instead —
+        setting ``t_migrate_done`` after ``t_first`` would drive
+        ``first_drain``/``prefill`` negative."""
+        now = self._clock()
+        with self._lock:
+            tr = self._active.get(uid)
+            if tr is None:
+                return
+            tr.events.append((now, "migrate",
+                              {"replica": replica, "bytes": int(nbytes),
+                               "blocks": int(blocks),
+                               "source": source}))
+            if replica:
+                tr.replica = str(replica)
+            tr.migrate_bytes = tr.migrate_bytes or int(nbytes)
+            tr.migrate_blocks = tr.migrate_blocks or int(blocks)
+            if tr.t_migrate_done is None and tr.t_first is None:
+                tr.t_migrate_done = now
+
+    def handoff(self, uid: int, *, source: str = "",
+                target: str = "") -> None:
+        """The EXPORT side of a hand-off (the prefill engine or a
+        draining replica serialized the request's KV) — event-list
+        only; the timing lands in ``migrate`` when the import
+        completes."""
+        now = self._clock()
+        with self._lock:
+            tr = self._active.get(uid)
+            if tr is not None:
+                tr.events.append((now, "handoff",
+                                  {"source": source, "target": target}))
 
     def prefill_done(self, uids) -> None:
         now = self._clock()
@@ -320,10 +397,13 @@ class RequestTraceRecorder:
                 # speculative drafts (ISSUE 9) landing in this drain
                 tr.spec_tokens_extra += max(0, n - steps)
             if tr.t_first is None:
-                if tr.t_prefill_done is None:
+                if tr.t_prefill_done is None \
+                        and tr.t_migrate_done is None:
                     # driver never reported prefill separately (the
                     # per-tick generate path): fold it into prefill so
-                    # the TTFT components still telescope exactly
+                    # the TTFT components still telescope exactly. A
+                    # migrated request without a local prefill event
+                    # instead charges admit -> import to `migrate`.
                     tr.t_prefill_done = now
                 tr.t_first = now
             else:
@@ -528,7 +608,7 @@ class RequestTraceRecorder:
         p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
         tail = [tr for tr in rows if tr.ttft_s >= p99] or rows
         comps = {}
-        for name in ("queue_wait", "prefill", "first_drain"):
+        for name in ("queue_wait", "prefill", "migrate", "first_drain"):
             comps[name] = (sum(tr.components()[name] for tr in tail)
                            / len(tail))
         dominant = max(comps, key=comps.get)
@@ -583,8 +663,17 @@ class RequestTraceRecorder:
             slice_(tid, "req/prefill", tr.t_admit, tr.t_prefill_done,
                    {**base, "cached_blocks": tr.cached_blocks,
                     "prompt_tokens": tr.prompt_tokens})
-            slice_(tid, "req/first_drain", tr.t_prefill_done, tr.t_first,
-                   dict(base))
+            if tr.t_migrate_done is not None:
+                slice_(tid, "req/migrate",
+                       tr.t_prefill_done if tr.t_prefill_done
+                       is not None else tr.t_admit,
+                       tr.t_migrate_done,
+                       {**base, "replica": tr.replica,
+                        "bytes": tr.migrate_bytes,
+                        "blocks": tr.migrate_blocks})
+            slice_(tid, "req/first_drain",
+                   tr.t_migrate_done if tr.t_migrate_done is not None
+                   else tr.t_prefill_done, tr.t_first, dict(base))
             slice_(tid, "req/decode", tr.t_first, tr.t_last,
                    {**base, "tokens": tr.tokens,
                     "drains": tr.drains,

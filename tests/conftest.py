@@ -143,6 +143,13 @@ _SLOW = {
     ("test_kv_quant.py", "test_quant_zero_recompile_steady_state"),
     ("test_kv_quant.py",
      "test_quant_speculative_counts_and_determinism"),
+    # disaggregated serving (ISSUE 13): wire/roundtrip/republish/
+    # router-unit/reqtrace tests stay tier-1 (shared engine pair, one
+    # extra int8 pair); the N-replica async end-to-end and the
+    # preemption-of-imported variant are the engine-heavy tail (the
+    # same paths also run in the bench `disagg` stage)
+    ("test_disagg.py", "test_router_two_replica_disagg_end_to_end"),
+    ("test_disagg.py", "test_imported_request_preemption_restore"),
     ("test_device_truth.py", "test_quantized_kv_pool_ledger_footprint"),
     ("test_spec_decode.py", "test_spec_stochastic_schedule_invariance"),
     ("test_spec_decode.py", "test_spec_admission_order_invariance"),

@@ -1,0 +1,594 @@
+"""Disaggregated serving (ISSUE 13): cross-mesh KV migration
+(export/import roundtrip bit-identical greedy continuation, fp32 AND
+int8 pools, wire format, prefix-chain re-publish), the serve loop's
+external-prefill admission path, the prefix-affinity router
+(affinity/fallback/backpressure/reroute units over fake replicas),
+blocksan hand-off accounting, and the reqtrace ``migrate`` leg of the
+TTFT telescoping. Engine-heavy N-replica variants live in
+conftest._SLOW."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.ragged import KVExportState
+from deepspeed_tpu.inference.v2.serve_loop import FusedServeLoop
+from deepspeed_tpu.models import Llama
+
+PROMPT = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11]
+
+# one model + params + warmed engine pair shared by the engine-backed
+# tests in this file (tier-1 budget: engine builds and fused-loop
+# compiles are the expensive part, the migrations themselves are
+# milliseconds). Tests must leave every engine empty.
+_SHARED: dict = {}
+
+
+def _cfg(**over):
+    kw = dict(dtype="float32", kv_block_size=8, num_kv_blocks=64,
+              max_chunk_size=16, graftsan={"enabled": True},
+              prefix_cache={"enabled": True})
+    kw.update(over)
+    return RaggedInferenceEngineConfig(**kw)
+
+
+def _pair():
+    """(exporter, importer) fp32 engines over shared params, graftsan
+    + prefix cache on — every quiesce point is conservation-checked."""
+    if "pair" not in _SHARED:
+        model = Llama(size="tiny")
+        ea = InferenceEngineV2(model, _cfg())
+        eb = InferenceEngineV2(model, _cfg(), params=ea.params)
+        _SHARED.update(model=model, pair=(ea, eb))
+    return _SHARED["pair"]
+
+
+def _assert_clean(e, nb=64):
+    assert e.free_blocks == nb and not e.state_manager.seqs, \
+        (e.free_blocks, e.state_manager.seqs)
+
+
+def _drain_transit():
+    from deepspeed_tpu.analysis import blocksan
+    blocksan.check_transit(mode="warn")     # consume leftovers
+
+
+# ---------------------------------------------------------------------
+# wire format (pure host)
+
+def test_kv_export_wire_roundtrip_bit_exact():
+    """to_bytes()/from_bytes() round-trips tokens, layout and every
+    payload array bit-exactly, int8 codes and f32 scale slabs
+    included; a version bump is refused."""
+    rng = np.random.default_rng(0)
+    payload = {"k": rng.integers(-127, 128, (2, 3, 8, 2, 4),
+                                 ).astype(np.int8),
+               "v": rng.integers(-127, 128, (2, 3, 8, 2, 4),
+                                 ).astype(np.int8),
+               "ks": rng.random((2, 3, 8, 2)).astype(np.float32),
+               "vs": rng.random((2, 3, 8, 2)).astype(np.float32)}
+    st = KVExportState(tokens=list(range(25)), n_generated=1, seen=24,
+                       block_size=8, kv_dtype="int8", payload=payload,
+                       handoff_id=7, source="prefill0")
+    st2 = KVExportState.from_bytes(st.to_bytes())
+    assert st2.tokens == st.tokens and st2.seen == 24
+    assert st2.n_generated == 1 and st2.kv_dtype == "int8"
+    assert st2.handoff_id == 7 and st2.source == "prefill0"
+    assert st2.prompt_tokens == list(range(24))
+    assert st2.generated_tokens == [24]
+    for k in payload:
+        assert np.array_equal(st2.payload[k], payload[k]), k
+    assert st2.payload_bytes == st.payload_bytes
+    bad = bytearray(st.to_bytes())
+    # corrupt the version field inside the JSON header
+    idx = bad.find(b'"version": 1')
+    bad[idx:idx + 12] = b'"version": 9'
+    with pytest.raises(ValueError, match="wire version"):
+        KVExportState.from_bytes(bytes(bad))
+
+
+# ---------------------------------------------------------------------
+# cross-engine roundtrip (engine-backed, shared pair)
+
+def test_export_import_bit_identical_continuation(devices8):
+    """Acceptance: prefill on engine A, export at the dispatch
+    boundary, import into engine B (through the wire format), continue
+    decoding — greedy output is bit-identical to a never-migrated run;
+    both pools end conservation-green and empty, and the hand-off
+    transit ledger drains."""
+    ea, eb = _pair()
+    ref = ea.generate_fused([PROMPT], max_new_tokens=12, k_steps=3)[0]
+    _assert_clean(ea)
+
+    t0 = ea.prefill_request(42, PROMPT)
+    assert t0 == ref[0]
+    st = ea.export_request(42, n_generated=1, source="engineA")
+    _assert_clean(ea)          # export released pool A (flush quiesce)
+    assert st.handoff_id is not None        # sanitizer is on
+
+    st = KVExportState.from_bytes(st.to_bytes())    # travel the wire
+    tok_in = eb.import_request(42, st)
+    assert tok_in == t0
+    out = [t0]
+    while len(out) < 12:
+        out.extend(eb.decode_fused([42], k_steps=3,
+                                   budgets={42: 12 - len(out)})[42])
+    assert out == ref
+    eb.flush(42)
+    _assert_clean(eb)
+    from deepspeed_tpu.analysis import blocksan
+    assert blocksan.pending_handoffs() == []
+    blocksan.check_transit()                # green
+
+
+def test_import_republishes_prefix_chain(devices8):
+    """ISSUE 13 satellite: the migrated full blocks re-publish into
+    the importing replica's prefix cache — a follow-up same-prefix
+    prompt on that replica admits warm (prefill tokens saved)."""
+    ea, eb = _pair()
+    ea.prefill_request(50, PROMPT)
+    st = ea.export_request(50, n_generated=1)
+    eb.import_request(50, st)
+    # 11-token history -> one full block (8 tokens) published on B
+    assert eb.state_manager.cache.cached_blocks >= 1
+    eb.reset_serving_metrics()
+    same_prefix = PROMPT + [30, 31, 32, 33, 34]
+    eb.generate_fused([same_prefix], max_new_tokens=4, k_steps=2)
+    m = eb.serving_metrics()
+    assert m["prefix_hits"] >= 1 and m["prefill_tokens_saved"] >= 8, m
+    eb.flush(50)
+    _assert_clean(eb)
+    _drain_transit()
+
+
+def test_export_import_int8_pools_travel_quantized(devices8):
+    """Quantized KV migrates WITHOUT dequantize: int8 codes + f32
+    scale slabs travel as-is, migration bytes/token equals the
+    engine's kv_bytes_per_token exactly, and greedy continuation stays
+    bit-identical."""
+    model = _pair()[0].model
+    params = _pair()[0].params
+    kv = {"enabled": True, "dtype": "int8", "grow_pool": False}
+    qa = InferenceEngineV2(model, _cfg(kv_cache=kv), params=params)
+    qb = InferenceEngineV2(model, _cfg(kv_cache=kv), params=params)
+    ref = qa.generate_fused([PROMPT], max_new_tokens=10, k_steps=3)[0]
+
+    t0 = qa.prefill_request(7, PROMPT)
+    st = qa.export_request(7, n_generated=1)
+    assert set(st.payload) == {"k", "v", "ks", "vs"}
+    assert st.payload["k"].dtype == np.int8
+    assert st.payload["ks"].dtype == np.float32
+    assert st.bytes_per_token() == pytest.approx(
+        qa.kv_bytes_per_token(), rel=1e-9)
+    assert st.kv_dtype == "int8"
+    tok_in = qb.import_request(7, st)
+    out = [tok_in]
+    while len(out) < 10:
+        out.extend(qb.decode_fused([7], k_steps=3,
+                                   budgets={7: 10 - len(out)})[7])
+    assert out == ref
+    qb.flush(7)
+    _assert_clean(qa)
+    _assert_clean(qb)
+    # layout mismatch is refused before any pool mutation: int8 -> fp32
+    qa.prefill_request(8, PROMPT)
+    st8 = qa.export_request(8, n_generated=1)
+    ea, _ = _pair()
+    with pytest.raises(ValueError, match="dtype"):
+        ea.import_request(8, st8)
+    _assert_clean(ea)
+    _drain_transit()
+
+
+def test_dropped_handoff_names_export_site(devices8):
+    """Seeded fault (ISSUE 13 satellite): an export that never reaches
+    an import is a named blocksan finding carrying the EXPORT call
+    site — a dropped-in-transit block set cannot silently vanish."""
+    from deepspeed_tpu.analysis import blocksan
+    ea, _ = _pair()
+    _drain_transit()
+    ea.prefill_request(60, PROMPT)
+    st = ea.export_request(60, n_generated=1)
+    del st                          # drop it on the floor
+    _assert_clean(ea)              # pool A itself stays green
+    with pytest.raises(blocksan.BlockSanError) as e:
+        blocksan.check_transit()
+    msg = str(e.value)
+    assert "never imported" in msg and "export_request" in msg, msg
+    assert blocksan.pending_handoffs() == []    # report-once
+
+
+# ---------------------------------------------------------------------
+# serve loop: external-prefill admission path
+
+def test_serve_loop_external_prefill_admission(devices8):
+    """submit_imported() through the FusedServeLoop: the migrated
+    request skips the prefill pass, its carried first token re-emits
+    (emit_carried), and the stream is bit-identical to a co-located
+    closed-loop run; the imports counter ticks and pools end clean."""
+    ea, eb = _pair()
+    refs = ea.generate_fused([PROMPT, [9, 8, 7]], max_new_tokens=10,
+                             k_steps=3)
+    _assert_clean(ea)
+    t0 = ea.prefill_request(70, PROMPT)
+    st = ea.export_request(70, n_generated=1)
+
+    loop = FusedServeLoop(eb, k_steps=3, strict=True, replica="rB")
+    uid_m = loop.submit_imported(st, max_new_tokens=10,
+                                 emit_carried=True)
+    uid_f = loop.submit([9, 8, 7], 10)     # fresh co-located request
+    got = {uid_m: [], uid_f: []}
+    while loop.has_work():
+        for evt in loop.step():
+            got[evt.uid].extend(evt.tokens)
+    assert got[uid_m] == refs[0]
+    assert got[uid_f] == refs[1]
+    assert got[uid_m][0] == t0
+    assert loop.counters["imports"] == 1
+    _assert_clean(ea)
+    _assert_clean(eb)
+    _drain_transit()
+
+
+# ---------------------------------------------------------------------
+# router units (host-only fake replicas)
+
+class _FakeHandle:
+    def __init__(self, tokens=None, fail=None):
+        self._tokens = list(tokens or [])
+        self._fail = fail
+        self.cancelled = False
+
+    def cancel(self):
+        self.cancelled = True
+
+    def __aiter__(self):
+        self._i = 0
+        return self
+
+    async def __anext__(self):
+        from deepspeed_tpu.serving import RequestFailed
+        if self._i < len(self._tokens):
+            self._i += 1
+            return self._tokens[self._i - 1]
+        if self._fail is not None:
+            raise RequestFailed(self._fail)
+        raise StopAsyncIteration
+
+
+class _FakeReplica:
+    """Duck-typed AsyncInferenceServer for router placement units."""
+
+    def __init__(self, name, affinity=0, open_=0, free=100,
+                 accepting=True, tokens=(1, 2, 3), fail=None,
+                 reject=False):
+        from deepspeed_tpu.serving import ServingConfig
+        self.config = ServingConfig(replica=name)
+        self._affinity = affinity
+        self.open_requests = open_
+        self.free_blocks = free
+        self.accepting = accepting
+        self._tokens = list(tokens)
+        self._fail = fail
+        self._reject = reject
+        self.submits: list = []
+
+    async def start(self):
+        pass
+
+    async def stop(self, drain=True):
+        pass
+
+    def prefix_affinity(self, tokens):
+        return self._affinity
+
+    async def submit(self, prompt, *, max_new_tokens=None,
+                     priority=None, uid=None):
+        if self._reject:
+            raise RuntimeError("serving queue full")
+        self.submits.append(("submit", list(prompt), max_new_tokens,
+                             uid))
+        return _FakeHandle(self._tokens, fail=self._fail)
+
+    async def submit_imported(self, state, *, max_new_tokens=None,
+                              priority=None, uid=None,
+                              emit_carried=False):
+        self.submits.append(("imported", state, max_new_tokens, uid))
+        return _FakeHandle(self._tokens, fail=self._fail)
+
+    def metrics(self):
+        return {"decoded_tokens": 0, "imports": 0,
+                "prefix_hit_rate": 0.0, "prefill_tokens_saved": 0}
+
+
+def _route(replicas, prompt, config=None, **submit_kw):
+    from deepspeed_tpu.serving import InferenceRouter
+
+    async def main():
+        router = InferenceRouter(replicas, config)
+        async with router:
+            h = await router.submit(prompt, **submit_kw)
+            toks = await h.tokens()
+        return toks, h, router
+
+    return asyncio.run(main())
+
+
+def test_router_prefix_affinity_placement():
+    """The replica holding the longest cached prefix chain wins even
+    when it is more loaded; the router counters attribute the
+    decision."""
+    warm = _FakeReplica("warm", affinity=3, open_=5)
+    cold = _FakeReplica("cold", affinity=0, open_=0)
+    toks, h, router = _route([cold, warm], [1, 2, 3],
+                             max_new_tokens=8)
+    assert toks == [1, 2, 3] and h.replica == "warm"
+    assert router.stats["routed_affinity"] == 1
+    assert warm.submits and not cold.submits
+
+
+def test_router_least_loaded_fallback_and_backpressure():
+    """No affinity anywhere -> least-loaded placement; replicas over
+    max_open_per_replica (or draining below the free-block watermark)
+    are skipped."""
+    busy = _FakeReplica("busy", open_=9)
+    idle = _FakeReplica("idle", open_=1)
+    toks, h, router = _route([busy, idle], [5, 6], max_new_tokens=4)
+    assert h.replica == "idle"
+    assert router.stats["routed_least_loaded"] == 1
+
+    # backpressure: the replica over the open cap is skipped even
+    # though its cached prefix would otherwise win the placement
+    capped = _FakeReplica("capped", affinity=3, open_=4)
+    ok = _FakeReplica("ok", open_=2)
+    _, h2, router2 = _route([capped, ok], [5, 6],
+                            config={"max_open_per_replica": 4})
+    assert h2.replica == "ok"
+    assert router2.stats["backpressure_skips"] >= 1
+
+    # drain watermark: pool-exhausted replica stops taking new work
+    dry = _FakeReplica("dry", free=2, open_=0)
+    wet = _FakeReplica("wet", free=50, open_=7)
+    _, h3, router3 = _route([dry, wet], [5, 6],
+                            config={"drain_free_block_watermark": 8})
+    assert h3.replica == "wet"
+    assert router3.stats["drain_skips"] >= 1
+
+
+def test_router_reroutes_failed_request_with_history():
+    """Drain-and-reroute: a mid-stream pool failure resubmits
+    prompt + already-streamed tokens (same uid) to the next replica;
+    the client stream is seamless and no token repeats."""
+    flaky = _FakeReplica("flaky", affinity=2, tokens=(10, 11),
+                         fail="KV pool exhausted")
+    backup = _FakeReplica("backup", tokens=(12, 13))
+    toks, h, router = _route([flaky, backup], [1, 2],
+                             max_new_tokens=4)
+    assert toks == [10, 11, 12, 13]
+    assert h.replica == "backup"
+    assert router.stats["reroutes"] == 1
+    kind, prompt2, max_new2, uid2 = backup.submits[0]
+    assert kind == "submit"
+    assert prompt2 == [1, 2, 10, 11]       # history joins the prompt
+    assert max_new2 == 2                   # budget minus streamed
+    assert uid2 == flaky.submits[0][3]     # SAME uid -> same stream
+    # retries exhausted -> the failure surfaces
+    f1 = _FakeReplica("f1", tokens=(), fail="boom")
+    f2 = _FakeReplica("f2", tokens=(), fail="boom")
+    from deepspeed_tpu.serving import RequestFailed
+
+    async def fail_main():
+        from deepspeed_tpu.serving import InferenceRouter
+        router = InferenceRouter([f1, f2],
+                                 {"reroute_retries": 1})
+        async with router:
+            hh = await router.submit([1], max_new_tokens=4)
+            with pytest.raises(RequestFailed, match="reroute"):
+                await hh.tokens()
+
+    asyncio.run(fail_main())
+
+
+def test_router_requires_prefill_engine_for_disaggregation():
+    from deepspeed_tpu.serving import InferenceRouter
+    with pytest.raises(ValueError, match="PrefillEngine"):
+        InferenceRouter([_FakeReplica("a")],
+                        {"disaggregation": {"enabled": True}})
+
+
+# ---------------------------------------------------------------------
+# reqtrace: the migrate leg of the TTFT telescoping
+
+def test_reqtrace_migrate_telescoping_exact():
+    """TTFT = queue_wait + prefill + migrate + first_drain, exactly,
+    with the migrate leg closed by migrated(); the access log carries
+    migrate_ms, migrate_bytes and the serving replica."""
+    from deepspeed_tpu.telemetry.reqtrace import (ACCESS_LOG_KEYS,
+                                                  RequestTraceRecorder)
+    t = [0.0]
+    rec = RequestTraceRecorder(capacity=16, clock=lambda: t[0])
+    rec.enqueue(1, priority=0, prompt_tokens=300, max_new_tokens=8)
+    t[0] = 0.010
+    rec.admitted(1, queue_depth=2)
+    t[0] = 0.050
+    rec.prefill_done([1])
+    rec.handoff(1, source="prefill0")
+    t[0] = 0.065
+    rec.migrated(1, replica="replica1", nbytes=4096, blocks=5,
+                 source="prefill0")
+    t[0] = 0.080
+    rec.tokens_landed(1, 1)
+    t[0] = 0.100
+    rec.tokens_landed(1, 1, window_start=0.081, steps=1)
+    t[0] = 0.101
+    rec.finished(1, "completed")
+    (tr,) = rec.completed()
+    assert tr.replica == "replica1"
+    assert tr.migrate_bytes == 4096 and tr.migrate_blocks == 5
+    c = tr.components()
+    assert c["queue_wait"] == pytest.approx(0.010, abs=1e-12)
+    assert c["prefill"] == pytest.approx(0.040, abs=1e-12)
+    assert c["migrate"] == pytest.approx(0.015, abs=1e-12)
+    assert c["first_drain"] == pytest.approx(0.015, abs=1e-12)
+    assert (c["queue_wait"] + c["prefill"] + c["migrate"]
+            + c["first_drain"]) == pytest.approx(tr.ttft_s, abs=1e-12)
+    row = tr.access_log_row()
+    assert set(row) == set(ACCESS_LOG_KEYS)
+    assert row["replica"] == "replica1"
+    assert row["migrate_ms"] == pytest.approx(15.0, abs=1e-9)
+    assert [e[1] for e in tr.events] == [
+        "enqueue", "admit", "prefill_done", "handoff", "migrate",
+        "drain", "drain", "finish"]
+
+
+def test_reqtrace_early_streamed_handoff_stays_nonnegative():
+    """The router streams the prefill-side first token BEFORE the
+    import lands: the migrate event arriving after t_first must not
+    open the migrate leg (it would drive first_drain/prefill
+    negative) — the hand-off wait charges the token-gap components,
+    every component stays >= 0 and the telescoping stays exact."""
+    from deepspeed_tpu.telemetry.reqtrace import RequestTraceRecorder
+    t = [0.0]
+    rec = RequestTraceRecorder(capacity=4, clock=lambda: t[0])
+    rec.enqueue(3, prompt_tokens=300, max_new_tokens=8)
+    t[0] = 0.010
+    rec.admitted(3, replica="prefill0")
+    t[0] = 0.050
+    rec.prefill_done([3])
+    rec.handoff(3, source="prefill0")
+    t[0] = 0.052
+    rec.tokens_landed(3, 1)                 # streamed during hand-off
+    t[0] = 0.120
+    rec.migrated(3, replica="replica1", nbytes=4096, blocks=5)
+    t[0] = 0.140
+    rec.tokens_landed(3, 1, window_start=0.121, steps=1)
+    t[0] = 0.141
+    rec.finished(3)
+    (tr,) = rec.completed()
+    c = tr.components()
+    assert all(v >= 0 for v in c.values()), c
+    assert c["migrate"] == 0.0
+    assert tr.migrate_bytes == 4096         # bytes still recorded
+    assert tr.replica == "replica1"         # decode replica wins
+    assert (c["queue_wait"] + c["prefill"] + c["migrate"]
+            + c["first_drain"]) == pytest.approx(tr.ttft_s, abs=1e-12)
+    assert sum(c.values()) == pytest.approx(
+        tr.t_finish - tr.t_enqueue, abs=1e-12)
+
+
+def test_reqtrace_migrate_without_local_prefill():
+    """A cross-process hand-off (no local prefill event) charges
+    admit -> import to migrate and still telescopes exactly — the
+    first token must NOT fold the gap into prefill."""
+    from deepspeed_tpu.telemetry.reqtrace import RequestTraceRecorder
+    t = [0.0]
+    rec = RequestTraceRecorder(capacity=4, clock=lambda: t[0])
+    rec.enqueue(2, prompt_tokens=10, max_new_tokens=4)
+    t[0] = 0.020
+    rec.admitted(2, replica="replica0")
+    t[0] = 0.070
+    rec.migrated(2, replica="replica0", nbytes=100, blocks=1)
+    t[0] = 0.090
+    rec.tokens_landed(2, 1)
+    t[0] = 0.091
+    rec.finished(2)
+    (tr,) = rec.completed()
+    c = tr.components()
+    assert c["prefill"] == 0.0
+    assert c["migrate"] == pytest.approx(0.050, abs=1e-12)
+    assert c["first_drain"] == pytest.approx(0.020, abs=1e-12)
+    assert sum(c[k] for k in ("queue_wait", "prefill", "migrate",
+                              "first_drain")) == pytest.approx(
+        tr.ttft_s, abs=1e-12)
+
+
+# ---------------------------------------------------------------------
+# engine-heavy N-replica variants (conftest._SLOW)
+
+def test_router_two_replica_disagg_end_to_end(devices8):
+    """Full stack: prefill engine + 2 decode replicas behind the
+    router with disaggregation on — greedy outputs bit-identical to
+    single-engine refs for co-located AND migrated prompts, imports
+    land on both replicas, every pool ends clean, transit drains."""
+    from deepspeed_tpu.serving import (AsyncInferenceServer,
+                                       InferenceRouter, PrefillEngine,
+                                       RouterConfig, ServingConfig)
+    ea, eb = _pair()
+    model, params = _SHARED["model"], ea.params
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [6, 7, 8, 9, 10, 11],
+               [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13]]
+    refs = [ea.generate_fused([p], max_new_tokens=10, k_steps=3)[0]
+            for p in prompts]
+    e_pre = InferenceEngineV2(model, _cfg(), params=params)
+    e_r1 = InferenceEngineV2(model, _cfg(), params=params)
+
+    async def main():
+        reps = [AsyncInferenceServer(eb, ServingConfig(k_steps=3)),
+                AsyncInferenceServer(e_r1, ServingConfig(k_steps=3))]
+        router = InferenceRouter(
+            reps, RouterConfig(disaggregation={
+                "enabled": True, "prefill_threshold_tokens": 6}),
+            prefill=PrefillEngine(e_pre))
+        async with router:
+            hs = [await router.submit(p, max_new_tokens=10)
+                  for p in prompts]
+            outs = [await h.tokens() for h in hs]
+            # satisfied-at-prefill: max_new=1 never reaches a decode
+            # replica — its transit entry must still be consumed
+            # (check_transit below would name it otherwise)
+            h1 = await router.submit(prompts[3], max_new_tokens=1)
+            assert len(await h1.tokens()) == 1
+            return outs, router.metrics()
+
+    outs, m = asyncio.run(main())
+    assert outs == refs
+    assert m["prefill_handoffs"] == 3          # incl. the max_new=1 one
+    assert sum(r["imports"] for r in m["replicas"].values()) == 2
+    assert m["prefill"]["prefills"] == 3
+    for e in (ea, eb, e_pre, e_r1):
+        _assert_clean(e)
+    from deepspeed_tpu.analysis import blocksan
+    blocksan.check_transit()                   # nothing dropped
+
+
+def test_imported_request_preemption_restore(devices8):
+    """A migrated request parked by a higher-priority arrival restores
+    through the normal re-prefill path and finishes bit-identically
+    (the kv_import is one-shot; blocksan stays green throughout)."""
+    from deepspeed_tpu.serving import (AsyncInferenceServer,
+                                       ServingConfig)
+    ea, _ = _pair()
+    model, params = _SHARED["model"], ea.params
+    e_small = InferenceEngineV2(
+        model, _cfg(num_kv_blocks=10), params=params)
+    ref_lo = ea.generate_fused([PROMPT], max_new_tokens=40,
+                               k_steps=4)[0]
+    ref_hi = ea.generate_fused([[9, 8, 7]], max_new_tokens=40,
+                               k_steps=4)[0]
+    t0 = ea.prefill_request(90, PROMPT)
+    st = ea.export_request(90, n_generated=1)
+
+    async def main():
+        async with AsyncInferenceServer(
+                e_small, ServingConfig(k_steps=4)) as s:
+            lo = await s.submit_imported(st, max_new_tokens=40,
+                                         priority=2,
+                                         emit_carried=True)
+            first = await lo.__anext__()
+            hi = await s.submit([9, 8, 7], max_new_tokens=40,
+                                priority=0)
+            out_hi = await hi.tokens()
+            out_lo = [first] + await lo.tokens()
+            return out_lo, out_hi, s.metrics()
+
+    out_lo, out_hi, m = asyncio.run(main())
+    assert out_lo[0] == t0
+    assert out_lo == ref_lo and out_hi == ref_hi
+    assert m["imports"] == 1
+    assert m["preemptions"] >= 1 and m["restores"] >= 1, m
+    _assert_clean(e_small, nb=10)
+    _assert_clean(ea)
+    _drain_transit()

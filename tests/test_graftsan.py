@@ -170,7 +170,8 @@ def test_blocksan_journal_and_snapshot_schema():
     assert all("site" in e and ":" in e["site"] for e in tail)
     snap = san.snapshot()
     assert set(snap) == {"pool_size", "mode", "scale_slots", "counters",
-                         "violations", "journal_tail"}
+                         "violations", "pending_handoffs",
+                         "journal_tail"}
     assert snap["pool_size"] == 16
 
 

@@ -319,6 +319,15 @@ _GATES = {
         ("boundary_gap", -1, 0.15),
         ("preempt_stall", -1, 0.15),
         ("prefill_p", -1, 0.15),
+        # disaggregated serving (ISSUE 13, bench `disagg` stage): the
+        # cross-mesh KV hand-off leg of the TTFT telescoping must not
+        # creep up, and N-replica aggregate throughput must keep
+        # scaling (replica_scaling = aggregate / (N x single-replica)).
+        # The disagg ITL-flatness ratio (disagg_itl_p99_drift_...)
+        # gates through the existing "itl_p99" stem; the deliberately-
+        # unmitigated single-engine control figures are excluded below.
+        ("migrate", -1, 0.15),
+        ("replica_scaling", +1, 0.05),
         # quantized KV cache (ISSUE 12, bench `kvquant` stage): the
         # per-cached-token byte cost must not creep back up and the
         # resident-batch capacity at equal pool bytes must not shrink
@@ -360,7 +369,11 @@ _GATES = {
 # tunnel RTT (serve7b `per_tick_p50_ms`, serving `v2_tick_p50_ms`) and
 # would flap the gate on dispatch-path jitter unrelated to the engine.
 _GATE_EXCLUDE = {
-    "serving": ("per_tick", "v2_tick"),
+    # ... plus the disagg stage's CONTROL-arm figures: the single-
+    # engine drift ratio and raw per-length chat ITL points exist to
+    # show the degradation disaggregation removes — inherently noisy
+    # and not a product metric (the disagg_* drift ratio still gates)
+    "serving": ("per_tick", "v2_tick", "single_itl", "chat_itl_p99_ms"),
     # the all-measured error includes the short-step base candidate,
     # the noisiest row — informational, the top-K figure gates
     "autotune": ("rel_err_all",),
