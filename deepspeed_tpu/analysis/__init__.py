@@ -3,12 +3,17 @@
 Two halves with opposite costs:
 
 - :mod:`.linter` / :mod:`.rules` — pure-``ast`` static analysis
-  (GL001-GL053: host syncs in jit-reachable code, recompile hazards,
-  donation gaps, dtype promotion, telemetry-probe enforcement, and the
+  (GL001-GL063: host syncs in jit-reachable code, recompile hazards,
+  donation gaps, dtype promotion, telemetry-probe enforcement, the
   graftsan thread-domain pass — device calls/blocking off the worker
-  thread, cross-domain races, lock-order inversions). Imports only the
-  stdlib; run via ``python tools/graftlint.py`` or the tier-1 gate in
-  ``tests/test_graftlint.py``. Catalog: docs/static-analysis.md.
+  thread, cross-domain races, lock-order inversions — and the
+  shardlint SPMD pass, ISSUE 15: mesh-axis vocabulary validation,
+  rank-divergent collectives, vmap/scan collective hazards,
+  sharding-spec hygiene). Imports only the stdlib; run via
+  ``python tools/graftlint.py`` (``--select spmd`` for the SPMD group
+  alone), ``python tools/lint_all.py`` for the whole static gate, or
+  the tier-1 gate in ``tests/test_graftlint.py``. Catalog:
+  docs/static-analysis.md.
 - :mod:`.sentinels` — runtime enforcement on the hot paths the linter
   cannot see into: a recompile sentinel (piggybacking on the telemetry
   bridges' jax.monitoring compile listener) asserting warmed-up steps
@@ -20,6 +25,13 @@ Two halves with opposite costs:
   provenance, and the thread-affinity checker. Stdlib-only like the
   linter; opt-in via ``RaggedInferenceEngineConfig.graftsan`` or env
   ``DS_GRAFTSAN=1``.
+- :mod:`.meshsan` — the SPMD rules' runtime half (ISSUE 15): declared
+  per-executable traffic contracts cross-checked against the telemetry
+  ledger's optimized-HLO collective walk (undeclared-axis traffic,
+  GSPMD silent-reshard all-to-alls, wire-dtype downgrades), plus
+  per-collective stall attribution in hang-watchdog dumps.
+  Stdlib-only; opt-in via the ``meshsan`` config blocks or env
+  ``DS_MESHSAN=1``.
 
 Import note: this ``__init__`` stays jax-free so the CLI lints without
 paying a jax import; reach sentinels via

@@ -111,6 +111,15 @@ _SUPPRESS_FILE_RE = re.compile(
 # thread-domain annotation (ISSUE 11): see module docstring
 _DOMAIN_RE = re.compile(r"#\s*graftsan:\s*domain=([a-z_]+)")
 
+# mesh-axis vocabulary annotation (ISSUE 15, shardlint): declares extra
+# valid axis names for the SPMD rules (GL060/GL063) — the escape hatch
+# for axes built dynamically (f-strings, config values) that the static
+# declaration scan below cannot see. Anywhere in the file; additive.
+# Syntax (the <...> placeholders keep THIS comment out of the vocab):
+#
+#     # shardlint: axes=<name>,<name>
+_AXES_ANNOT_RE = re.compile(r"#\s*shardlint:\s*axes=([A-Za-z0-9_, ]+)")
+
 # the domain vocabulary; unknown names in an annotation are ignored so
 # a typo degrades to "no domain" (no false findings) instead of crashing
 DOMAINS = frozenset({"worker", "asyncio", "daemon", "any"})
@@ -363,6 +372,90 @@ def collect_traced_names(tree: ast.AST) -> set[str]:
 _BUILTIN_NAMES = frozenset(dir(__import__("builtins")))
 
 
+# --------------------------------------------------------------------
+# mesh-axis vocabulary (ISSUE 15, shardlint pass 1)
+# --------------------------------------------------------------------
+
+# an assignment target / parameter whose name mentions axis/axes is an
+# axis DECLARATION site (AXIS_ORDER, BATCH_AXES, INNER_AXIS, sp_axis=...)
+_AXISY_NAME_RE = re.compile(r"ax[ie]s", re.IGNORECASE)
+
+
+def _string_literals(node: ast.AST) -> set[str]:
+    """String constants in ``node``: a bare literal, or the string
+    elements of a (possibly nested) tuple/list/set literal. Dynamic
+    elements contribute nothing."""
+    out: set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            out |= _string_literals(e)
+    return out
+
+
+def collect_axis_declarations(tree: ast.AST, source: str) -> set[str]:
+    """Pass-1 API for the driver (ISSUE 15): the mesh-axis names this
+    module DECLARES — the vocabulary GL060/GL063 check axis uses
+    against. Declaration sites, never use sites (a typo'd ``lax.psum``
+    axis must not make itself valid):
+
+    - ``Mesh(devices, axis_names)`` literal names (``shard_map``'s
+      ``axis_names`` is deliberately NOT a source — it is a USE site
+      over axes some mesh declares, and a source role would let a
+      typo'd shard_map legalize itself);
+    - assignments and parameter defaults whose NAME mentions axis/axes
+      (``AXIS_ORDER = ("pp", "dp", ...)``, ``INNER_AXIS = "zps"``,
+      ``sp_axis: str = "sp"``) with literal string / tuple-of-string
+      values;
+    - ``# shardlint: axes=...`` annotations (the dynamic-axis escape
+      hatch).
+
+    Over-inclusion only weakens the check (an extra vocabulary entry
+    can never cause a false finding), so the name heuristic leans
+    permissive."""
+    axes: set[str] = set()
+    for _i, comment in _comment_lines(source):
+        if "shardlint" not in comment:
+            continue
+        m = _AXES_ANNOT_RE.search(comment)
+        if m:
+            axes |= {a.strip() for a in m.group(1).split(",")
+                     if a.strip()}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] in ("Mesh", "AbstractMesh",
+                                       "make_mesh"):
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        axes |= _string_literals(kw.value)
+                if len(node.args) >= 2:
+                    axes |= _string_literals(node.args[1])
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) \
+                        and _AXISY_NAME_RE.search(t.id):
+                    axes |= _string_literals(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name) \
+                    and _AXISY_NAME_RE.search(node.target.id):
+                axes |= _string_literals(node.value)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            a = node.args
+            pos = a.posonlyargs + a.args
+            for param, default in zip(pos[len(pos) - len(a.defaults):],
+                                      a.defaults):
+                if _AXISY_NAME_RE.search(param.arg):
+                    axes |= _string_literals(default)
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None \
+                        and _AXISY_NAME_RE.search(param.arg):
+                    axes |= _string_literals(default)
+    return axes
+
+
 def collect_domain_exports(tree: ast.AST, source: str) -> dict[str, set]:
     """Pass-1 API for the driver (ISSUE 11): ONE cross-module
     propagation hop for thread domains. For every function this module
@@ -426,15 +519,29 @@ class ModuleIndex:
     ``external_domains``: ``{function name: {domains}}`` from pass 1's
     :func:`collect_domain_exports` over the whole run — how a domain
     annotated in one module reaches the functions it calls in another.
+
+    ``axis_vocab``: the mesh-axis vocabulary from pass 1's
+    :func:`collect_axis_declarations` over the whole run (ISSUE 15) —
+    how ``parallel/mesh.py``'s ``AXIS_ORDER`` validates a literal axis
+    string used in another module. ``None``/empty means "no vocabulary
+    declared anywhere": the axis-validity rules stay quiet (a
+    vocabulary must exist to be violated), so linting a lone file with
+    no declarations never false-fires.
     """
 
     def __init__(self, path: str, source: str,
                  external_traced_names: Optional[set[str]] = None,
-                 external_domains: Optional[dict] = None):
+                 external_domains: Optional[dict] = None,
+                 axis_vocab: Optional[set[str]] = None):
         self.path = path
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
+        # standalone construction (no driver pass 1): the module's own
+        # declarations still count, so a single-file index is usable
+        self.axis_vocab: set[str] = (
+            set(axis_vocab) if axis_vocab is not None
+            else collect_axis_declarations(self.tree, source))
         self.suppressions = Suppressions(source)
         self._external = external_traced_names or set()
         self._external_domains = external_domains or {}

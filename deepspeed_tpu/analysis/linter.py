@@ -27,7 +27,8 @@ from collections import Counter
 from dataclasses import dataclass, field
 from typing import Iterable, Optional, Sequence
 
-from .core import (Context, Finding, ModuleIndex, collect_domain_exports,
+from .core import (Context, Finding, ModuleIndex,
+                   collect_axis_declarations, collect_domain_exports,
                    collect_traced_names)
 from .rules import ALL_RULES, RULES_BY_ID
 
@@ -102,9 +103,13 @@ def lint_paths(paths: Sequence[str], *,
     # pass 1: global traced-name registry + cross-module thread-domain
     # exports (ISSUE 11: one propagation hop — names called from
     # annotated/async functions carry the caller's domain package-wide)
+    # + the mesh-axis vocabulary (ISSUE 15: axis names declared
+    # ANYWHERE in the run — parallel/mesh.py's AXIS_ORDER validates a
+    # literal axis string used in any other module)
     sources: dict[str, str] = {}
     traced_names: set[str] = set()
     domain_exports: dict[str, set] = {}
+    axis_vocab: set[str] = set()
     for path in files:
         try:
             with open(path, encoding="utf-8") as f:
@@ -121,6 +126,7 @@ def lint_paths(paths: Sequence[str], *,
             for name, doms in collect_domain_exports(
                     tree, sources[path]).items():
                 domain_exports.setdefault(name, set()).update(doms)
+            axis_vocab |= collect_axis_declarations(tree, sources[path])
         except SyntaxError:
             pass    # reported in pass 2
 
@@ -132,7 +138,8 @@ def lint_paths(paths: Sequence[str], *,
         try:
             index = ModuleIndex(rel, sources[path],
                                 external_traced_names=traced_names,
-                                external_domains=domain_exports)
+                                external_domains=domain_exports,
+                                axis_vocab=axis_vocab)
         except SyntaxError as e:
             result.errors.append(Finding(
                 rule="GL000", path=rel, line=e.lineno or 0, col=0,

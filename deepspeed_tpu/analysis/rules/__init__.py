@@ -1,16 +1,29 @@
 """graftlint rule registry: one module per rule family, each exporting
 ``RULES``; the catalog below is the linter's (and the docs') single
-source of truth. IDs are stable — retired rules are never reused."""
+source of truth. IDs are stable — retired rules are never reused.
+
+``RULE_GROUPS`` names each family for the CLI's ``--select`` (e.g.
+``--select spmd`` runs only the GL060-family SPMD pass in CI)."""
 
 from __future__ import annotations
 
 from . import (concurrency, donation, dtype_rules, host_sync, recompile,
-               telemetry_rules)
+               spmd, telemetry_rules)
 
 ALL_RULES = (host_sync.RULES + recompile.RULES + donation.RULES
              + dtype_rules.RULES + telemetry_rules.RULES
-             + concurrency.RULES)
+             + concurrency.RULES + spmd.RULES)
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+RULE_GROUPS = {
+    "host-sync": tuple(r.id for r in host_sync.RULES),
+    "recompile": tuple(r.id for r in recompile.RULES),
+    "donation": tuple(r.id for r in donation.RULES),
+    "dtype": tuple(r.id for r in dtype_rules.RULES),
+    "telemetry": tuple(r.id for r in telemetry_rules.RULES),
+    "concurrency": tuple(r.id for r in concurrency.RULES),
+    "spmd": tuple(r.id for r in spmd.RULES),
+}
 
 assert len(RULES_BY_ID) == len(ALL_RULES), "duplicate rule id"

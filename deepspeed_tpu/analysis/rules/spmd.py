@@ -1,0 +1,545 @@
+"""SPMD sharding/collective correctness rules (GL060-GL063, ISSUE 15
+tentpole part 1 — shardlint).
+
+The roadmap's multi-mesh tentpoles (MoE over ``ep``, elastic reshard
+restore, the fsdp×zps hierarchical wire) all die silently on SPMD
+mistakes a type checker cannot see: a typo'd axis string raises only at
+trace time (or worse, traces fine under ``shard_map``'s dynamic axis
+env and deadlocks a pod), a collective guarded by a rank-dependent
+branch wedges every other participant forever, and a sharding-spec typo
+makes GSPMD silently replicate (or reshard) a tensor that was supposed
+to stay put. These rules check the *source* against a package-wide
+**mesh-axis vocabulary** collected in the linter's pass 1
+(:func:`..core.collect_axis_declarations`: ``Mesh``/``shard_map``
+``axis_names``, axis-named assignments/defaults like
+``AXIS_ORDER = ("pp", "dp", ...)``, and ``# shardlint: axes=...``
+annotations). Only LITERAL axis strings are checked — a variable axis
+is invisible to the AST and stays exempt; declare its values with the
+annotation when you want coverage. An empty vocabulary disables
+GL060/GL063 entirely (nothing declared -> nothing to violate), so
+single-file lints of undeclared code never false-fire.
+
+- GL060: axis string passed to a ``lax`` collective /
+  ``axis_index`` / ``shard_map(axis_names=...)`` not in the vocabulary
+  (``"fdsp"`` dies at lint time, with a did-you-mean);
+- GL061: collective reachable under a conditional whose predicate
+  derives from ``axis_index``/``process_index``/per-rank state — the
+  classic SPMD deadlock (rank 0 enters the all-reduce, everyone else
+  waits forever);
+- GL062: collective hazards under ``vmap``/``scan`` bodies, and paired
+  quantize/collective calls (the qgZ codes+scales two-hop shape) whose
+  payload and scales travel different routes;
+- GL063: sharding-spec hygiene — ``PartitionSpec`` axis names checked
+  against the same vocabulary, and multi-operand identity-reshard jits
+  without donation (generalizing GL021's single-operand form).
+
+Runtime counterpart: :mod:`..meshsan` checks each compiled
+executable's ACTUAL collective traffic (from the telemetry ledger's
+optimized-HLO walk) against a declared per-executable contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Iterable, Optional
+
+from ..core import (Context, Rule, attr_chain, iter_trace_wrapper_calls,
+                    _func_name_args)
+
+# ``lax`` collectives / axis queries and the positional slot their axis
+# argument rides in (keyword form: ``axis_name`` / ``axis_names``)
+_AXIS_ARG_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1,
+    "ppermute": 1, "pshuffle": 1, "pbroadcast": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+# the subset that actually moves bytes (GL061/GL062 scope; axis_index
+# and friends are queries, not synchronization points)
+_COLLECTIVE_TAILS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all", "ppermute", "pshuffle", "pbroadcast",
+})
+
+# calls whose result is a per-rank value: the seeds of GL061's
+# rank-derived-name inference (process_count is deliberately absent —
+# it is uniform across ranks and branching on it is fine)
+_RANK_SOURCE_TAILS = frozenset({"axis_index", "process_index",
+                                "get_rank"})
+
+
+def _is_lax_rooted(chain: list[str]) -> bool:
+    """``lax.psum`` / ``jax.lax.psum`` — the repo's comm facade wraps
+    these, so the facade's own internals are checked here and its
+    callers (which pass dynamic group names) are not; ``self.psum`` /
+    ``dist.all_gather`` never match."""
+    return "lax" in chain[:-1]
+
+
+def _collective_calls(tree: ast.AST) -> Iterable[tuple[ast.Call, str]]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not chain or chain[-1] not in _COLLECTIVE_TAILS:
+            continue
+        if not _is_lax_rooted(chain):
+            continue
+        yield node, chain[-1]
+
+
+def _axis_expr(call: ast.Call, tail: str) -> Optional[ast.AST]:
+    """The axis argument of a collective/axis-query call, positional or
+    keyword; None when absent."""
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis_names"):
+            return kw.value
+    pos = _AXIS_ARG_POS.get(tail)
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _literal_axis_strings(node: ast.AST) -> list[tuple[str, ast.AST]]:
+    """(axis string, node) for every string literal inside an axis
+    expression — a bare literal or the literal elements of a
+    tuple/list/set (mixed literal/dynamic checks the literal part)."""
+    out: list[tuple[str, ast.AST]] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node))
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            out.extend(_literal_axis_strings(e))
+    return out
+
+
+def _suggest(axis: str, vocab: set[str]) -> str:
+    close = difflib.get_close_matches(axis, sorted(vocab), n=1)
+    return f" (did you mean '{close[0]}'?)" if close else ""
+
+
+# --------------------------------------------------------------------
+# GL060
+# --------------------------------------------------------------------
+
+
+class UnknownMeshAxis(Rule):
+    id = "GL060"
+    name = "unknown-mesh-axis"
+    summary = ("literal axis string passed to a lax collective / "
+               "axis_index / shard_map(axis_names=...) that no mesh "
+               "declaration or `# shardlint: axes=` annotation defines "
+               "— a typo'd axis raises at trace time at best, "
+               "deadlocks a pod at worst")
+
+    def check(self, ctx: Context) -> None:
+        vocab = ctx.index.axis_vocab
+        if not vocab:
+            return
+        seen: set[int] = set()
+        for call, tail in _collective_calls(ctx.index.tree):
+            expr = _axis_expr(call, tail)
+            if expr is None:
+                continue
+            for axis, node in _literal_axis_strings(expr):
+                if axis not in vocab and id(node) not in seen:
+                    seen.add(id(node))
+                    ctx.report(
+                        self.id, call,
+                        f"lax.{tail} over unknown mesh axis "
+                        f"'{axis}'{_suggest(axis, vocab)}; declared "
+                        f"axes: {sorted(vocab)} — fix the name or "
+                        "declare it with `# shardlint: axes=...`")
+        # axis QUERIES (axis_index/axis_size) and shard_map axis_names
+        # are not in the byte-moving tail set; same literal-axis check
+        for node in ast.walk(ctx.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            tail = chain[-1]
+            if tail in ("axis_index", "axis_size") \
+                    and _is_lax_rooted(chain):
+                expr = _axis_expr(node, tail)
+                if expr is None:
+                    continue
+                for axis, lit in _literal_axis_strings(expr):
+                    if axis not in vocab and id(lit) not in seen:
+                        seen.add(id(lit))
+                        ctx.report(
+                            self.id, node,
+                            f"lax.{tail} over unknown mesh axis "
+                            f"'{axis}'{_suggest(axis, vocab)}; "
+                            f"declared axes: {sorted(vocab)}")
+            elif tail == "shard_map":
+                for kw in node.keywords:
+                    if kw.arg != "axis_names":
+                        continue
+                    for axis, lit in _literal_axis_strings(kw.value):
+                        if axis not in vocab and id(lit) not in seen:
+                            seen.add(id(lit))
+                            ctx.report(
+                                self.id, node,
+                                f"shard_map over unknown mesh axis "
+                                f"'{axis}'{_suggest(axis, vocab)}; "
+                                f"declared axes: {sorted(vocab)}")
+
+
+# --------------------------------------------------------------------
+# GL061
+# --------------------------------------------------------------------
+
+
+def _rank_derived_locals(index, info) -> set[str]:
+    """Names in ``info`` assigned (directly or transitively) from a
+    rank source — the same forward-fixpoint scheme traced-locals
+    inference uses, seeded from axis_index/process_index/get_rank."""
+    derived: set[str] = set()
+
+    def expr_derived(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                chain = attr_chain(n.func)
+                if chain and chain[-1] in _RANK_SOURCE_TAILS:
+                    return True
+            if isinstance(n, ast.Name) and n.id in derived:
+                return True
+        return False
+
+    def name_targets(t: ast.AST) -> list[str]:
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out: list[str] = []
+            for e in t.elts:
+                out.extend(name_targets(e))
+            return out
+        return []
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(info.node):
+            targets, value = [], None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets, value = [node.target], node.value
+            if value is None or not expr_derived(value):
+                continue
+            for t in targets:
+                for name in name_targets(t):
+                    if name not in derived:
+                        derived.add(name)
+                        changed = True
+    return derived
+
+
+class RankDivergentCollective(Rule):
+    id = "GL061"
+    name = "rank-divergent-collective"
+    summary = ("collective under a conditional whose predicate derives "
+               "from axis_index/process_index/per-rank state — ranks "
+               "that skip the branch never enter the collective, so "
+               "the ranks that did wait forever (the classic SPMD "
+               "multi-host deadlock)")
+
+    def check(self, ctx: Context) -> None:
+        index = ctx.index
+        for info in index.functions.values():
+            derived = None     # computed lazily, once per function
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call) \
+                        or index.enclosing_function(node) is not info.node:
+                    continue
+                chain = attr_chain(node.func)
+                if not chain or chain[-1] not in _COLLECTIVE_TAILS \
+                        or not _is_lax_rooted(chain):
+                    continue
+                # walk up to every enclosing if/while/ternary WITHIN
+                # this function and test the predicate for rank taint
+                cur = index.parent(node)
+                guard = None
+                while cur is not None and cur is not info.node:
+                    test = None
+                    if isinstance(cur, (ast.If, ast.While, ast.IfExp)):
+                        test = cur.test
+                    if test is not None:
+                        if derived is None:
+                            derived = _rank_derived_locals(index, info)
+                        if self._rank_tainted(test, derived):
+                            guard = cur
+                            break
+                    cur = index.parent(cur)
+                if guard is not None:
+                    ctx.report(
+                        self.id, node,
+                        f"lax.{chain[-1]} reachable only under a "
+                        "rank-dependent predicate (line "
+                        f"{guard.lineno}): ranks that skip the branch "
+                        "never join the collective and the rest "
+                        "deadlock; make the collective unconditional "
+                        "(mask the OPERAND with jnp.where instead) or "
+                        "suppress with the uniformity argument")
+
+    @staticmethod
+    def _rank_tainted(test: ast.AST, derived: set[str]) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call):
+                chain = attr_chain(n.func)
+                if chain and chain[-1] in _RANK_SOURCE_TAILS:
+                    return True
+            if isinstance(n, ast.Name) and n.id in derived:
+                return True
+        return False
+
+
+# --------------------------------------------------------------------
+# GL062
+# --------------------------------------------------------------------
+
+# loop/batching wrappers whose body re-issues its collectives every
+# iteration / batch element
+_LOOP_WRAPPER_TAILS = {"scan", "fori_loop", "while_loop",
+                       "associative_scan"}
+_VMAP_TAILS = {"vmap"}
+
+# ppermute under scan is THE ring-attention / pipeline-schedule idiom
+# (one neighbor hop per step is the algorithm, and its payload is the
+# O(S/P) block being rotated) — exempt under loops, still flagged
+# under vmap
+_LOOP_EXEMPT_TAILS = {"ppermute", "pshuffle"}
+
+
+class CollectiveUnderLoopOrVmap(Rule):
+    id = "GL062"
+    name = "collective-under-vmap-or-scan"
+    summary = ("reduction/gather collective inside a scan/while/vmap "
+               "body — it re-runs every iteration (a latency-bound "
+               "collective per loop step is a silent perf cliff), and "
+               "under vmap without spmd_axis_name it is a trace error "
+               "waiting for a batched input; also flags paired "
+               "quantize/collective calls (qgZ codes+scales) whose "
+               "payload and scales take different routes")
+
+    def check(self, ctx: Context) -> None:
+        self._check_loop_bodies(ctx)
+        self._check_quant_pairs(ctx)
+
+    # -- (a) collectives in loop/vmap bodies -----------------------
+    def _check_loop_bodies(self, ctx: Context) -> None:
+        index = ctx.index
+        # id(FuncInfo) -> (info, wrapper kind); FuncInfo is an unhashable
+        # dataclass
+        body_kind: dict[int, tuple] = {}
+        for call in iter_trace_wrapper_calls(index.tree):
+            chain = attr_chain(call.func)
+            tail = chain[-1]
+            if tail in _VMAP_TAILS:
+                # vmap with an explicit axis name is the author saying
+                # "I know this batches a collective"
+                if any(k.arg in ("axis_name", "spmd_axis_name")
+                       for k in call.keywords):
+                    continue
+                kind = "vmap"
+            elif tail in _LOOP_WRAPPER_TAILS:
+                kind = tail
+            else:
+                continue
+            for name in _func_name_args(call):
+                for info in index._resolve_name_at(call, name):
+                    body_kind.setdefault(id(info), (info, kind))
+            for a in call.args:
+                if isinstance(a, ast.Lambda) and a in index.functions:
+                    info = index.functions[a]
+                    body_kind.setdefault(id(info), (info, kind))
+        if not body_kind:
+            return
+        for info, kind in body_kind.values():
+            # the body function and everything lexically inside it
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if not chain or chain[-1] not in _COLLECTIVE_TAILS \
+                        or not _is_lax_rooted(chain):
+                    continue
+                if kind != "vmap" and chain[-1] in _LOOP_EXEMPT_TAILS:
+                    continue
+                ctx.report(
+                    self.id, node,
+                    f"lax.{chain[-1]} inside a lax.{kind} body "
+                    f"('{info.name}'): it re-issues every "
+                    + ("batch element and needs spmd_axis_name to "
+                       "even trace" if kind == "vmap" else
+                       "iteration — hoist it out of the loop, or "
+                       "suppress with the reason the per-step "
+                       "exchange IS the algorithm"))
+
+    # -- (b) paired quantize/collective route mismatch -------------
+    def _check_quant_pairs(self, ctx: Context) -> None:
+        index = ctx.index
+        for info in index.functions.values():
+            # tuple-unpack assignments: q, s(, ...) = f(...)
+            groups: list[set[str]] = []
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Tuple) \
+                        and isinstance(node.value, ast.Call):
+                    names = {e.id for e in node.targets[0].elts
+                             if isinstance(e, ast.Name)}
+                    if len(names) >= 2:
+                        groups.append(names)
+            if not groups:
+                continue
+            # collective calls whose FIRST operand is one of the pair,
+            # ACCUMULATED per name in source order: the two-hop qgZ
+            # shape exchanges each of q/s twice, and keying on the name
+            # alone would let a later matching hop overwrite (and mask)
+            # a divergent first hop
+            routes: dict[int, dict[str, list[tuple]]] = {}
+            calls: dict[int, dict[str, list[ast.Call]]] = {}
+            ordered = sorted(
+                (n for n in ast.walk(info.node)
+                 if isinstance(n, ast.Call) and n.args),
+                key=lambda n: (n.lineno, n.col_offset))
+            for node in ordered:
+                chain = attr_chain(node.func)
+                if not chain or chain[-1] not in (
+                        "all_to_all", "all_gather", "psum_scatter"):
+                    continue
+                if not _is_lax_rooted(chain):
+                    continue
+                op0 = node.args[0]
+                if not isinstance(op0, ast.Name):
+                    continue
+                for gi, names in enumerate(groups):
+                    if op0.id not in names:
+                        continue
+                    route = self._route(node, chain[-1])
+                    routes.setdefault(gi, {}).setdefault(
+                        op0.id, []).append(route)
+                    calls.setdefault(gi, {}).setdefault(
+                        op0.id, []).append(node)
+            for gi, by_name in routes.items():
+                if len(by_name) < 2:
+                    continue
+                distinct = {tuple(seq) for seq in by_name.values()}
+                if len(distinct) > 1:
+                    names = sorted(by_name)
+                    last = calls[gi][names[-1]][-1]
+                    ctx.report(
+                        self.id, last,
+                        f"paired collectives over {names} (unpacked "
+                        "from one call — the quantized codes+scales "
+                        "shape) take DIFFERENT routes (axis/split/"
+                        "concat args or hop sequences differ): scales "
+                        "that travel a different path than their "
+                        "payload dequantize the wrong blocks")
+
+    @staticmethod
+    def _route(call: ast.Call, tail: str) -> tuple:
+        parts = [tail]
+        expr = _axis_expr(call, tail)
+        parts.append(ast.dump(expr) if expr is not None else "?")
+        for kw in sorted((k for k in call.keywords if k.arg),
+                         key=lambda k: k.arg):
+            if kw.arg in ("split_axis", "concat_axis",
+                          "scatter_dimension", "axis", "tiled"):
+                parts.append(f"{kw.arg}={ast.dump(kw.value)}")
+        for i, a in enumerate(call.args[2:], start=2):
+            parts.append(f"pos{i}={ast.dump(a)}")
+        return tuple(parts)
+
+
+# --------------------------------------------------------------------
+# GL063
+# --------------------------------------------------------------------
+
+
+class ShardingSpecHygiene(Rule):
+    id = "GL063"
+    name = "sharding-spec-hygiene"
+    summary = ("PartitionSpec axis name outside the declared mesh-axis "
+               "vocabulary (GSPMD treats an unknown axis as a silent "
+               "full replication — the tensor you sharded isn't), or a "
+               "multi-operand identity-reshard jit without donation "
+               "(generalizing GL021: source and destination layouts "
+               "both stay live)")
+
+    def check(self, ctx: Context) -> None:
+        self._check_spec_axes(ctx)
+        self._check_reshards(ctx)
+
+    def _check_spec_axes(self, ctx: Context) -> None:
+        vocab = ctx.index.axis_vocab
+        if not vocab:
+            return
+        for node in ast.walk(ctx.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] not in ("PartitionSpec", "P"):
+                continue
+            for a in node.args:
+                for axis, _lit in _literal_axis_strings(a):
+                    if axis not in vocab:
+                        ctx.report(
+                            self.id, node,
+                            f"PartitionSpec axis '{axis}' is not a "
+                            f"declared mesh axis{_suggest(axis, vocab)}"
+                            f"; declared: {sorted(vocab)} — GSPMD "
+                            "will silently replicate this dim")
+
+    def _check_reshards(self, ctx: Context) -> None:
+        for node in ast.walk(ctx.index.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "jit" or not node.args:
+                continue
+            if any(k.arg in ("donate_argnums", "donate_argnames")
+                   for k in node.keywords):
+                continue
+            if not any(k.arg == "out_shardings" for k in node.keywords):
+                continue
+            target = node.args[0]
+            if not isinstance(target, ast.Lambda):
+                continue
+            args = target.args
+            pos = args.posonlyargs + args.args
+            if len(pos) < 2 or args.kwonlyargs:
+                continue        # single-operand form is GL021's
+            if self._is_identity_body(target.body,
+                                      [p.arg for p in pos]):
+                ctx.report(
+                    self.id, node,
+                    "multi-operand identity-reshard jit without "
+                    "donate_argnums: every input's source layout "
+                    "stays live alongside its resharded copy — "
+                    "donate the inputs")
+
+    @staticmethod
+    def _is_identity_body(body: ast.AST, params: list[str]) -> bool:
+        """Body is a pure rearrangement of the parameter names
+        (tuple/list of Names drawn from params, each at most once)."""
+        if isinstance(body, ast.Name):
+            return body.id in params
+        if isinstance(body, (ast.Tuple, ast.List)):
+            seen: list[str] = []
+            for e in body.elts:
+                if not isinstance(e, ast.Name) or e.id not in params \
+                        or e.id in seen:
+                    return False
+                seen.append(e.id)
+            return bool(seen)
+        return False
+
+
+RULES = [UnknownMeshAxis(), RankDivergentCollective(),
+         CollectiveUnderLoopOrVmap(), ShardingSpecHygiene()]

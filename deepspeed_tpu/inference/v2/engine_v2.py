@@ -246,6 +246,23 @@ class GraftsanConfig(DeepSpeedConfigModel):
     journal_size: int = Field(512, ge=16)
 
 
+class InferenceMeshsanConfig(DeepSpeedConfigModel):
+    """Runtime mesh-traffic sanitizer for the serving dispatch families
+    (ISSUE 15, ``analysis/meshsan.py`` — the runtime half of the
+    shardlint GL060-GL063 static pass; see the training-side
+    ``meshsan`` block in runtime/config.py for the full model). The v2
+    contract is strict: a tp-sharded forward moves bytes on ``tp``
+    only, and any substantial all-to-all/collective-permute in a
+    serving executable is the GSPMD silent-reshard signature
+    (kilobyte-scale partitioner shuffles are tolerated). Checks ride
+    the telemetry executable ledger's HLO walk, once per new
+    executable. Off by default; env ``DS_MESHSAN=1`` force-enables."""
+    enabled: bool = False
+    mode: Literal["raise", "warn"] = "raise"
+    # override the auto-seeded contract axes (None = {tp} when tp > 1)
+    axes: Optional[list[str]] = None
+
+
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
     (state_manager block/pool sizing knobs + the fused-decode loop)."""
@@ -305,6 +322,11 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     # journal + conservation checks and the thread-affinity checker
     # (see docs/static-analysis.md, "Concurrency domains & sanitizers")
     graftsan: GraftsanConfig = Field(default_factory=GraftsanConfig)
+    # meshsan mesh-traffic sanitizer (ISSUE 15): per-executable
+    # collective traffic contracts over the ledger's HLO walk (see
+    # docs/static-analysis.md, "SPMD correctness")
+    meshsan: InferenceMeshsanConfig = Field(
+        default_factory=InferenceMeshsanConfig)
 
 
 class InferenceEngineV2:
@@ -469,6 +491,25 @@ class InferenceEngineV2:
                 _bsan.set_blocksan(self._blocksan)
             if gs.thread_affinity:
                 self._affinity = _bsan.ThreadAffinityChecker(mode=gs.mode)
+        # meshsan (ISSUE 15): per-executable traffic contracts checked
+        # at the dispatch-family registration choke point
+        # (_device_truth_observe); opt-in, lazily imported, rides the
+        # telemetry ledger's HLO walk
+        self._meshsan = None
+        ms = config.meshsan
+        if ms.enabled or os.environ.get("DS_MESHSAN", "") \
+                not in ("", "0"):
+            from ...analysis import meshsan as _msan
+            contract = _msan.seed_serving_contract(tp=tp)
+            if ms.axes is not None:
+                contract.axes = frozenset(ms.axes)
+            self._meshsan = _msan.MeshSanitizer(mode=ms.mode)
+            # the two ledger-observed dispatch families (prefill
+            # registers under v2/dispatch — its span name is not a
+            # ledger name)
+            for fam in ("v2/dispatch", "v2/fused_dispatch"):
+                self._meshsan.declare(fam, contract)
+            _msan.set_meshsan(self._meshsan)
         # serving counters behind serving_metrics(): host dispatches vs
         # decoded tokens measures how host-free the decode loop is.
         # Schema-driven (SERVING_COUNTER_KEYS) so reset/emission can
@@ -1227,9 +1268,14 @@ class InferenceEngineV2:
             fr.progress("v2_dispatch", span=name)
         led = tel.get_ledger()
         if led is not None:
-            led.observe(name, fn,
-                        (self.params, self.pools) + tuple(dev_ops),
-                        mesh=self.mesh)
+            entry = led.observe(name, fn,
+                                (self.params, self.pools)
+                                + tuple(dev_ops),
+                                mesh=self.mesh)
+            if self._meshsan is not None:
+                # traffic-contract check (ISSUE 15): once per NEW
+                # executable, a set lookup per later dispatch
+                self._meshsan.observe_entry(entry)
 
     def _record_dispatch_telemetry(self, tel, dt: float) -> None:
         """Fused-dispatch boundary metrics (per DISPATCH — K tokens'

@@ -269,6 +269,39 @@ class SentinelsConfig(DeepSpeedConfigModel):
     warmup_steps: int = 1
 
 
+class MeshsanConfig(DeepSpeedConfigModel):
+    """Runtime mesh-traffic sanitizer (ISSUE 15,
+    ``deepspeed_tpu/analysis/meshsan.py`` — the runtime half of the
+    shardlint GL060-GL063 static SPMD pass). Cross-checks every
+    compiled executable's ACTUAL collective traffic (the telemetry
+    ledger's optimized-HLO walk; requires
+    ``telemetry.executable_ledger``) against a declared per-executable
+    traffic contract seeded from the mesh topology and the ZeRO++ wire
+    flags: traffic on an undeclared axis, an unexpected
+    all-to-all/collective-permute (the GSPMD silent-reshard signature),
+    or full-precision bytes on an axis configured for an int8 wire
+    become named findings carrying executable, axis, op and bytes —
+    counted in ``ds_meshsan_violations_total{kind}`` and embedded
+    (with per-collective stall attribution) in hang-watchdog dumps.
+    Off by default — nothing is imported and the dispatch path is
+    untouched. Env ``DS_MESHSAN=1`` force-enables (the conftest/CI
+    opt-in knob). See docs/static-analysis.md, "SPMD correctness"."""
+    enabled: bool = False
+    # "raise" fails fast (tests/bench); "warn" logs, counts, and keeps
+    # training (violations still reach ds_meshsan_violations_total)
+    mode: Literal["raise", "warn"] = "raise"
+    # override the auto-seeded contract: axes the compiled step may
+    # move bytes on / carry all-to-all traffic on (None = seed from
+    # the mesh topology + ZeRO++ flags; see
+    # analysis.meshsan.seed_training_contract)
+    axes: Optional[list[str]] = None
+    all_to_all_axes: Optional[list[str]] = None
+    # collectives below this payload never trip the wire-width check
+    # (tiny fp32 control reductions — loss means, found-inf flags —
+    # are not wire traffic)
+    wire_min_bytes: int = Field(65536, ge=0)
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -399,6 +432,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     sentinels: SentinelsConfig = Field(default_factory=SentinelsConfig)
+    meshsan: MeshsanConfig = Field(default_factory=MeshsanConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 from typing import Any, Callable, Iterable, Optional
 
 import jax
@@ -342,6 +343,40 @@ class DeepSpeedEngine:
                     warmup_calls=sent_cfg.warmup_steps)
             if sent_cfg.transfer_guard:
                 self._hot_guard = hot_path_guard
+        # meshsan (ISSUE 15): mesh-traffic contract enforcement at the
+        # executable-registration choke point (_device_truth_observe).
+        # Opt-in via config or DS_MESHSAN=1; lazily imported so a
+        # sanitizer-off process never loads analysis/meshsan. Checks
+        # ride the telemetry ledger's HLO walk, so they only run when
+        # telemetry.executable_ledger is also on.
+        self._meshsan = None
+        ms_cfg = self.config.meshsan
+        if ms_cfg.enabled or os.environ.get("DS_MESHSAN", "") \
+                not in ("", "0"):
+            from ..analysis import meshsan as _msan
+            zq = self.config.zero_optimization
+            contract = _msan.seed_training_contract(
+                self.topology.sizes,
+                quantized_gradients=zq.zero_quantized_gradients,
+                quantized_weights=zq.zero_quantized_weights,
+                min_bytes=ms_cfg.wire_min_bytes)
+            if ms_cfg.axes is not None:
+                contract.axes = frozenset(ms_cfg.axes)
+            if ms_cfg.all_to_all_axes is not None:
+                contract.all_to_all_axes = frozenset(
+                    ms_cfg.all_to_all_axes)
+            self._meshsan = _msan.MeshSanitizer(mode=ms_cfg.mode)
+            self._meshsan.declare("compiled_step", contract)
+            # registered process-wide so hang-watchdog dumps embed the
+            # contract state + collective stall attribution
+            _msan.set_meshsan(self._meshsan)
+            if not (self.config.telemetry.enabled
+                    and self.config.telemetry.executable_ledger):
+                logger.warning(
+                    "meshsan is enabled but telemetry.executable_ledger "
+                    "is not: there is no HLO collective walk to check "
+                    "the traffic contract against, so meshsan will "
+                    "observe nothing")
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} "
             f"dtype={self.compute_dtype.__name__} mesh={self.topology} "
@@ -908,8 +943,13 @@ class DeepSpeedEngine:
         if led is not None:
             # offload tier reuses the same attribute for its grads
             # step, so one observation point covers both paths
-            led.observe("compiled_step", self._train_step,
-                        (self.state, batch), mesh=self.mesh)
+            entry = led.observe("compiled_step", self._train_step,
+                                (self.state, batch), mesh=self.mesh)
+            if self._meshsan is not None:
+                # traffic-contract check (ISSUE 15): once per NEW
+                # executable (signature-deduped inside), a set lookup
+                # on every later dispatch
+                self._meshsan.observe_entry(entry)
 
     def _telemetry_boundary(self, tel, metrics):
         """Boundary-cadence telemetry work (never per step): the

@@ -203,6 +203,22 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
     except Exception as e:   # noqa: BLE001
         doc["blocksan_error"] = repr(e)
     try:
+        # meshsan contract state + collective stall attribution
+        # (ISSUE 15): when the mesh-traffic sanitizer is active, the
+        # dump joins the recorder's last dispatch heartbeat against the
+        # registered executables' HLO collective content — a wedged
+        # multichip run names the collectives (axis, op, bytes) it died
+        # inside, not just the host thread stacks
+        from ..analysis.meshsan import get_meshsan
+        msan = get_meshsan()
+        if msan is not None:
+            doc["meshsan"] = msan.snapshot()
+            if recorder is not None:
+                doc["collective_stall"] = msan.stall_attribution(
+                    recorder.events())
+    except Exception as e:   # noqa: BLE001
+        doc["meshsan_error"] = repr(e)
+    try:
         with open("/proc/self/status") as f:
             doc["host_memory"] = {
                 k: v.strip() for k, v in

@@ -314,6 +314,79 @@ FIXTURES = {
                         self.n -= 1
         """,
     ),
+    "GL060": (
+        """
+        # shardlint: axes=dp,fsdp
+        import jax
+        from jax import lax
+        def step(x):
+            return lax.psum(x, "fdsp")
+        step_j = jax.jit(step)
+        """,
+        """
+        # shardlint: axes=dp,fsdp
+        import jax
+        from jax import lax
+        def step(x):
+            return lax.psum(x, ("dp", "fsdp"))
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL061": (
+        """
+        import jax
+        from jax import lax
+        def sync(g):
+            if lax.axis_index("dp") == 0:
+                g = lax.psum(g, "dp")
+            return g
+        f = jax.jit(sync)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        from jax import lax
+        def sync(g):
+            rank = lax.axis_index("dp")
+            g = lax.psum(jnp.where(rank == 0, g, 0.0), "dp")
+            return g
+        f = jax.jit(sync)
+        """,
+    ),
+    "GL062": (
+        """
+        import jax
+        from jax import lax
+        def tick(carry, x):
+            g = lax.psum(x, "dp")
+            return carry + g, None
+        def run(xs):
+            out, _ = lax.scan(tick, 0.0, xs)
+            return out
+        run_j = jax.jit(run)
+        """,
+        """
+        import jax
+        from jax import lax
+        def tick(carry, x):
+            return carry + x, None
+        def run(xs):
+            out, _ = lax.scan(tick, 0.0, xs)
+            return lax.psum(out, "dp")
+        run_j = jax.jit(run)
+        """,
+    ),
+    "GL063": (
+        """
+        # shardlint: axes=dp,tp
+        from jax.sharding import PartitionSpec as P
+        SPEC = P("dp", "tpp")
+        """,
+        """
+        # shardlint: axes=dp,tp
+        from jax.sharding import PartitionSpec as P
+        SPEC = P("dp", "tp")
+        """,
+    ),
     "GL041": (
         """
         import jax, jax.numpy as jnp

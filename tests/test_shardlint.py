@@ -1,0 +1,446 @@
+"""shardlint (ISSUE 15, static half): the GL060-GL063 SPMD rules —
+axis-vocabulary collection (incl. cross-module and annotation paths),
+rank-divergent-collective detection shaped like a real
+all-reduce-under-``process_index`` deadlock, vmap/scan collective
+hazards, paired quantize/collective route mismatch, sharding-spec
+hygiene, the ``--select spmd`` CLI group, and the one-command
+``tools/lint_all.py`` gate."""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from deepspeed_tpu.analysis import lint_paths
+from deepspeed_tpu.analysis.core import (ModuleIndex,
+                                         collect_axis_declarations)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "deepspeed_tpu")
+
+
+def _lint_src(tmp_path, src, name="fix.py", extra=None, **kw):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    for n, s in (extra or {}).items():
+        (tmp_path / n).write_text(textwrap.dedent(s))
+    return lint_paths([str(tmp_path)], root=str(tmp_path), **kw)
+
+
+def _rules(res, rule_id):
+    return [f for f in res.findings if f.rule == rule_id]
+
+
+# ---------------------------------------------------------------------
+# axis-vocabulary collection (the linter's pass 1)
+# ---------------------------------------------------------------------
+
+def test_axis_vocabulary_collection_sources():
+    """Every declaration form feeds the vocabulary: Mesh axis_names,
+    shard_map axis_names, axis-named assignments and parameter
+    defaults, and the `# shardlint: axes=` annotation — while collective
+    USE sites contribute nothing (a typo must not self-legalize)."""
+    src = textwrap.dedent("""
+        from jax.sharding import Mesh
+        AXIS_ORDER = ("pp", "dp")
+        INNER_AXIS = "zps"
+        # shardlint: axes=annotated
+        def f(x, sp_axis="sp", batch_axes=("dp", "fsdp")):
+            m = Mesh(x, ("tp",))
+            return m
+        def g(x):
+            from jax import lax
+            return lax.psum(x, "typo_axis_not_declared")
+    """)
+    vocab = collect_axis_declarations(ast.parse(src), src)
+    assert vocab == {"pp", "dp", "zps", "annotated", "sp", "fsdp", "tp"}
+
+
+def test_axis_annotation_in_string_is_ignored():
+    """A `shardlint: axes=` occurrence inside a docstring/string is not
+    a declaration (same real-comment rule as suppressions)."""
+    src = 'DOC = """# shardlint: axes=ghost"""\n'
+    assert collect_axis_declarations(ast.parse(src), src) == set()
+
+
+def test_standalone_module_index_uses_own_declarations(tmp_path):
+    """A directly-constructed ModuleIndex (no driver pass 1) still sees
+    the module's own declarations."""
+    src = 'AXIS_ORDER = ("dp", "tp")\n'
+    idx = ModuleIndex("m.py", src)
+    assert idx.axis_vocab == {"dp", "tp"}
+
+
+# ---------------------------------------------------------------------
+# GL060 — axis-name validity
+# ---------------------------------------------------------------------
+
+def test_gl060_cross_module_vocabulary(tmp_path):
+    """mesh.py's AXIS_ORDER validates (and catches) axis literals used
+    in a sibling module — the package-wide pass-1 union."""
+    res = _lint_src(tmp_path, """
+        import jax
+        from jax import lax
+        def step(x):
+            return lax.all_gather(x, "fdsp", axis=0, tiled=True)
+        step_j = jax.jit(step)
+    """, extra={"mesh.py": 'AXIS_ORDER = ("dp", "fsdp", "tp")\n'})
+    hits = _rules(res, "GL060")
+    assert hits and hits[0].path == "fix.py"
+    assert "did you mean 'fsdp'" in hits[0].message
+
+
+def test_gl060_dynamic_axis_is_exempt(tmp_path):
+    """A variable axis argument is invisible to the AST and must stay
+    quiet — the annotation is the opt-in for those."""
+    res = _lint_src(tmp_path, """
+        # shardlint: axes=dp
+        from jax import lax
+        def step(x, axes):
+            return lax.psum(x, axes)
+    """)
+    assert not _rules(res, "GL060")
+
+
+def test_gl060_empty_vocabulary_disables_the_rule(tmp_path):
+    """No declaration anywhere in the lint run -> nothing to violate:
+    a lone undeclared file never false-fires."""
+    res = _lint_src(tmp_path, """
+        from jax import lax
+        def step(x):
+            return lax.psum(x, "whatever")
+    """)
+    assert not _rules(res, "GL060")
+
+
+def test_gl060_shard_map_axis_names(tmp_path):
+    """shard_map's axis_names is a USE site (deliberately not a
+    vocabulary source — a typo'd shard_map must not legalize itself)."""
+    res = _lint_src(tmp_path / "a", """
+        # shardlint: axes=dp,fsdp
+        from deepspeed_tpu.utils.jax_compat import shard_map
+        def build(body, mesh, specs):
+            return shard_map(body, mesh=mesh, axis_names={"fdsp"},
+                             in_specs=specs, out_specs=specs)
+    """)
+    hits = _rules(res, "GL060")
+    assert hits and "fdsp" in hits[0].message
+
+
+def test_gl060_every_literal_site_reports(tmp_path):
+    """axis_index AND the collective both name the typo (two sites,
+    two findings)."""
+    res = _lint_src(tmp_path, """
+        # shardlint: axes=dp,fsdp
+        from jax import lax
+        def body(x):
+            return lax.axis_index("fdsp") + lax.psum(x, "fdsp")
+    """)
+    hits = _rules(res, "GL060")
+    assert len(hits) == 2
+    assert all("fdsp" in f.message for f in hits)
+
+
+def test_gl060_suppression_path(tmp_path):
+    res = _lint_src(tmp_path, """
+        # shardlint: axes=dp
+        from jax import lax
+        def step(x):
+            # deliberately dynamic-mesh name, validated at runtime
+            return lax.psum(x, "expert")   # graftlint: disable=GL060
+    """)
+    assert not _rules(res, "GL060")
+
+
+# ---------------------------------------------------------------------
+# GL061 — rank-divergent collective (the SPMD deadlock shape)
+# ---------------------------------------------------------------------
+
+def test_gl061_all_reduce_under_process_index(tmp_path):
+    """The classic multi-host deadlock: rank 0 enters the all-reduce,
+    every other rank skipped the branch and never joins."""
+    res = _lint_src(tmp_path, """
+        import jax
+        from jax import lax
+        def log_and_sync(metrics):
+            if jax.process_index() == 0:
+                return lax.psum(metrics, "dp")
+            return metrics
+        f = jax.jit(log_and_sync)
+    """)
+    hits = _rules(res, "GL061")
+    assert hits and "rank-dependent predicate" in hits[0].message
+
+
+def test_gl061_derived_predicate_propagates(tmp_path):
+    """Rank taint flows through assignments: rank -> leader -> if."""
+    res = _lint_src(tmp_path, """
+        import jax
+        from jax import lax
+        def sync(g):
+            rank = lax.axis_index("dp")
+            leader = rank == 0
+            if leader:
+                g = lax.psum(g, "dp")
+            return g
+        f = jax.jit(sync)
+    """)
+    assert _rules(res, "GL061")
+
+
+def test_gl061_uniform_predicates_are_quiet(tmp_path):
+    """process_count and config flags are uniform across ranks —
+    branching on them cannot diverge."""
+    res = _lint_src(tmp_path, """
+        import jax
+        from jax import lax
+        def sync(g, enabled):
+            if enabled and jax.process_count() > 1:
+                g = lax.psum(g, "dp")
+            return g
+    """)
+    assert not _rules(res, "GL061")
+
+
+def test_gl061_masked_operand_is_the_fix(tmp_path):
+    """The recommended fix — unconditional collective over a
+    rank-masked OPERAND — is quiet."""
+    res = _lint_src(tmp_path, """
+        import jax, jax.numpy as jnp
+        from jax import lax
+        def bcast(x):
+            idx = lax.axis_index("dp")
+            return lax.psum(jnp.where(idx == 0, x, 0.0), "dp")
+        f = jax.jit(bcast)
+    """)
+    assert not _rules(res, "GL061")
+
+
+def test_gl061_suppression_with_uniformity_argument(tmp_path):
+    res = _lint_src(tmp_path, """
+        from jax import lax
+        def sync(g, rank_table):
+            r = lax.axis_index("dp")
+            if bool(r in rank_table):
+                # every rank's table contains every rank: uniform
+                g = lax.psum(g, "dp")   # graftlint: disable=GL061
+            return g
+    """)
+    assert not _rules(res, "GL061")
+
+
+# ---------------------------------------------------------------------
+# GL062 — collective under vmap/scan + paired-route mismatch
+# ---------------------------------------------------------------------
+
+def test_gl062_ppermute_in_scan_is_exempt(tmp_path):
+    """The ring-attention / pipeline-schedule idiom: one neighbor hop
+    per step IS the algorithm — documented exemption."""
+    res = _lint_src(tmp_path, """
+        import jax
+        from jax import lax
+        def step(i, carry):
+            kb, acc = carry
+            kb = lax.ppermute(kb, "sp", [(0, 1), (1, 0)])
+            return (kb, acc + kb)
+        def ring(k):
+            return lax.fori_loop(0, 2, step, (k, k))
+        ring_j = jax.jit(ring)
+    """)
+    assert not _rules(res, "GL062")
+
+
+def test_gl062_vmap_collective_needs_axis_name(tmp_path):
+    src = """
+        import jax
+        from jax import lax
+        def one(x):
+            return lax.psum(x, "dp")
+        f = jax.vmap(one)
+    """
+    assert _rules(_lint_src(tmp_path, src), "GL062")
+    ok = src.replace("jax.vmap(one)",
+                     'jax.vmap(one, spmd_axis_name="dp")')
+    assert not _rules(_lint_src(tmp_path, ok), "GL062")
+
+
+def test_gl062_pair_route_mismatch(tmp_path):
+    """qgZ two-hop shape: codes and scales unpacked from one quantize
+    call must travel the same (axis, split, concat) route — scales on
+    a different path dequantize the wrong blocks."""
+    src = """
+        from jax import lax
+        def exchange(x, quant):
+            q, s = quant(x)
+            qx = lax.all_to_all(q, ("fsdp",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            sx = lax.all_to_all(s, ("zps",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            return qx, sx
+    """
+    hits = _rules(_lint_src(tmp_path, src), "GL062")
+    assert hits and "DIFFERENT routes" in hits[0].message
+    ok = src.replace('("zps",)', '("fsdp",)')
+    assert not _rules(_lint_src(tmp_path, ok), "GL062")
+
+
+def test_gl062_pair_two_hop_first_hop_divergence(tmp_path):
+    """Routes accumulate per name: a divergent FIRST hop must not be
+    masked by a matching second hop (the two-hop qgZ shape exchanges
+    each of codes/scales twice)."""
+    res = _lint_src(tmp_path, """
+        from jax import lax
+        def two_hop(x, quant):
+            q, s = quant(x)
+            q2 = lax.all_to_all(q, ("fsdp",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            s2 = lax.all_to_all(s, ("zps",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            qg = lax.all_gather(q, ("zps",), axis=0, tiled=True)
+            sg = lax.all_gather(s, ("zps",), axis=0, tiled=True)
+            return q2, s2, qg, sg
+    """)
+    assert _rules(res, "GL062")
+    # both hops matched: clean
+    ok = _lint_src(tmp_path / "ok", """
+        from jax import lax
+        def two_hop(x, quant):
+            q, s = quant(x)
+            q2 = lax.all_to_all(q, ("fsdp",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            s2 = lax.all_to_all(s, ("fsdp",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            qg = lax.all_gather(q, ("zps",), axis=0, tiled=True)
+            sg = lax.all_gather(s, ("zps",), axis=0, tiled=True)
+            return q2, s2, qg, sg
+    """)
+    assert not _rules(ok, "GL062")
+
+
+def test_gl062_pair_split_axis_mismatch(tmp_path):
+    """Same axis but different split/concat dims is still a route
+    mismatch (the hop-1 hierarchical shape exchanges dim 1)."""
+    res = _lint_src(tmp_path, """
+        from jax import lax
+        def hop(x, quant):
+            q, s = quant(x)
+            qx = lax.all_to_all(q, ("zps",), split_axis=1,
+                                concat_axis=1, tiled=True)
+            sx = lax.all_to_all(s, ("zps",), split_axis=0,
+                                concat_axis=0, tiled=True)
+            return qx, sx
+    """)
+    assert _rules(res, "GL062")
+
+
+# ---------------------------------------------------------------------
+# GL063 — sharding-spec hygiene
+# ---------------------------------------------------------------------
+
+def test_gl063_partition_spec_typo_with_suggestion(tmp_path):
+    res = _lint_src(tmp_path, """
+        from jax.sharding import PartitionSpec
+        # shardlint: axes=dp,fsdp,tp
+        RULES = {
+            "wq": PartitionSpec(None, ("fsdp", "tpp")),
+        }
+    """)
+    hits = _rules(res, "GL063")
+    assert hits and "did you mean 'tp'" in hits[0].message
+
+
+def test_gl063_multi_operand_reshard_needs_donation(tmp_path):
+    src = """
+        import jax
+        def build(sh):
+            return jax.jit(lambda a, b: (a, b), out_shardings=sh)
+    """
+    assert _rules(_lint_src(tmp_path, src), "GL063")
+    ok = src.replace("out_shardings=sh",
+                     "donate_argnums=(0, 1), out_shardings=sh")
+    assert not _rules(_lint_src(tmp_path, ok), "GL063")
+
+
+def test_gl063_single_operand_form_stays_gl021(tmp_path):
+    """The one-operand identity reshard is GL021's finding; GL063 must
+    not double-report it."""
+    res = _lint_src(tmp_path, """
+        import jax
+        def build(sh):
+            return jax.jit(lambda t: t, out_shardings=sh)
+    """)
+    assert _rules(res, "GL021") and not _rules(res, "GL063")
+
+
+def test_gl063_computation_lambda_is_not_a_reshard(tmp_path):
+    """A jit lambda that computes is not an identity reshard even with
+    out_shardings and no donation (that is GL020 territory at most)."""
+    res = _lint_src(tmp_path, """
+        import jax
+        def build(sh):
+            return jax.jit(lambda a, b: a + b, out_shardings=sh)
+    """)
+    assert not _rules(res, "GL063")
+
+
+# ---------------------------------------------------------------------
+# CLI: --select spmd + the one-command gate
+# ---------------------------------------------------------------------
+
+def test_cli_select_spmd_runs_only_the_group(tmp_path):
+    """--select spmd: a file with BOTH a host-sync bug (GL001) and an
+    axis typo (GL060) reports only the SPMD finding."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        # shardlint: axes=dp,fsdp
+        import jax, jax.numpy as jnp
+        from jax import lax
+        def step(x):
+            y = jnp.sum(x)
+            z = lax.psum(y, "fdsp")
+            return float(z)
+        step_j = jax.jit(step)
+    """))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         str(bad), "--select", "spmd", "--baseline", "none", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    rules = {f["rule"] for f in data["findings"]}
+    assert "GL060" in rules and "GL001" not in rules
+    # unknown group -> usage error
+    bad_group = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         str(bad), "--select", "nosuch"],
+        capture_output=True, text=True, timeout=120)
+    assert bad_group.returncode == 2
+
+
+def test_lint_all_exits_zero_at_head():
+    """The whole static gate — graftlint + SPMD group + host-only
+    audits — passes at HEAD from one stdlib-only command (tier-1, so a
+    builder breaking any section sees it in the default suite)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_all.py"),
+         "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["ok"] is True
+    names = {s["name"] for s in data["sections"]}
+    assert "spmd group (GL060-GL063)" in names
+    assert any(n.startswith("host-only") for n in names)
+
+
+def test_package_spmd_group_is_clean():
+    """The committed package passes the SPMD pass with zero findings
+    (the ISSUE 15 audit satellite's end state — every surfaced site
+    was fixed or inline-justified)."""
+    from deepspeed_tpu.analysis.rules import RULE_GROUPS
+    res = lint_paths([PACKAGE], rules=list(RULE_GROUPS["spmd"]),
+                     root=REPO)
+    assert res.findings == [] and not res.errors, res.findings

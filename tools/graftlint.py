@@ -55,18 +55,28 @@ def main(argv=None) -> int:
                          "and exit 0")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule GROUPS to run (e.g. "
+                         "`--select spmd` runs only the GL060-family "
+                         "SPMD pass); combines with --rules")
     ap.add_argument("--disable", default="",
                     help="comma-separated rule ids to skip")
     ap.add_argument("--list-rules", action="store_true",
-                    help="print the rule catalog and exit")
+                    help="print the rule catalog (grouped) and exit")
     args = ap.parse_args(argv)
 
     linter = _import_linter()
-    from deepspeed_tpu.analysis.rules import ALL_RULES
+    from deepspeed_tpu.analysis.rules import ALL_RULES, RULE_GROUPS
 
     if args.list_rules:
+        by_id = {}
+        for group, ids in RULE_GROUPS.items():
+            for rid in ids:
+                by_id[rid] = group
         for r in ALL_RULES:
-            print(f"{r.id}  {r.name}\n    {r.summary}")
+            print(f"{r.id}  {r.name}  [{by_id.get(r.id, '?')}]"
+                  f"\n    {r.summary}")
+        print(f"\ngroups (--select): {', '.join(sorted(RULE_GROUPS))}")
         return 0
 
     paths = args.paths or [os.path.join(_REPO, "deepspeed_tpu")]
@@ -83,6 +93,15 @@ def main(argv=None) -> int:
 
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
+    if args.select:
+        groups = [g.strip() for g in args.select.split(",") if g.strip()]
+        unknown = [g for g in groups if g not in RULE_GROUPS]
+        if unknown:
+            print(f"graftlint: unknown rule group(s) {unknown}; "
+                  f"available: {sorted(RULE_GROUPS)}", file=sys.stderr)
+            return 2
+        selected = [rid for g in groups for rid in RULE_GROUPS[g]]
+        rules = sorted(set(selected) | set(rules or ()))
     disable = [r.strip() for r in args.disable.split(",") if r.strip()]
     try:
         result = linter.lint_paths(paths, rules=rules, disable=disable,

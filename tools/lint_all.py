@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""lint_all — the whole static gate in one stdlib-only command.
+
+    python tools/lint_all.py            # everything, one exit code
+    python tools/lint_all.py --json     # machine-readable section report
+
+Runs, in order (ISSUE 15 satellite — one invocation, single exit code,
+no jax import anywhere):
+
+1. **graftlint** — all rules (GL001-GL063 incl. the shardlint SPMD
+   group) over ``deepspeed_tpu/`` against ``.graftlint-baseline.json``;
+2. **spmd group** — the GL060-family pass alone (same findings subset;
+   kept as its own section so a CI lane can see the SPMD gate status
+   at a glance — equivalent to ``graftlint.py --select spmd``);
+3. **host-only audits** — ``traced_roots`` over the packages whose
+   contract forbids jit-reachable code: ``autotuning/`` (deterministic
+   planner ranking) and ``serving/`` + ``telemetry/reqtrace.py`` (the
+   request-trace recorder runs on the event loop).
+
+Exit codes: 0 = every section clean; 1 = any section failed;
+2 = usage/environment error. The tier-1 suite asserts this exits 0 at
+HEAD (tests/test_shardlint.py), so builders get the same gate CI runs
+from one command.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PACKAGE = os.path.join(_REPO, "deepspeed_tpu")
+
+
+def _import_analysis():
+    """Import deepspeed_tpu.analysis without executing
+    deepspeed_tpu/__init__.py (which imports jax)."""
+    if "deepspeed_tpu" not in sys.modules:
+        stub = types.ModuleType("deepspeed_tpu")
+        stub.__path__ = [_PACKAGE]
+        sys.modules["deepspeed_tpu"] = stub
+    sys.path.insert(0, _REPO)
+    from deepspeed_tpu import analysis
+    return analysis
+
+
+def run_sections() -> list[dict]:
+    """Each section: {name, ok, detail}."""
+    analysis = _import_analysis()
+    from deepspeed_tpu.analysis import linter
+    from deepspeed_tpu.analysis.rules import RULE_GROUPS
+    sections: list[dict] = []
+
+    # 1. full graftlint vs the committed baseline
+    result = linter.lint_paths([_PACKAGE], root=_REPO)
+    baseline = os.path.join(_REPO, linter.BASELINE_DEFAULT)
+    linter.apply_baseline(result, baseline
+                          if os.path.exists(baseline) else None)
+    sections.append({
+        "name": "graftlint (all rules)",
+        "ok": result.ok,
+        "detail": (f"{result.files} files, {len(result.findings)} "
+                   f"finding(s), {len(result.new)} new, "
+                   f"{len(result.errors)} error(s)"),
+        "new": [f.to_dict() for f in result.new],
+        "errors": [f.to_dict() for f in result.errors],
+    })
+
+    # 2. the SPMD group status, filtered from the full run's findings
+    # (same result set `graftlint.py --select spmd` produces, without
+    # re-reading and re-parsing the whole package)
+    spmd_ids = set(RULE_GROUPS["spmd"])
+    spmd_all = [f for f in result.findings if f.rule in spmd_ids]
+    spmd_new = [f for f in result.new if f.rule in spmd_ids]
+    sections.append({
+        "name": "spmd group (GL060-GL063)",
+        "ok": not spmd_new and not result.errors,
+        "detail": (f"{len(spmd_all)} finding(s), "
+                   f"{len(spmd_new)} new"),
+        "new": [f.to_dict() for f in spmd_new],
+        "errors": [],
+    })
+
+    # 3. host-only package audits (no jit-reachable code allowed)
+    for label, paths in (
+            ("host-only: autotuning",
+             [os.path.join(_PACKAGE, "autotuning")]),
+            ("host-only: serving + reqtrace",
+             [os.path.join(_PACKAGE, "serving"),
+              os.path.join(_PACKAGE, "telemetry", "reqtrace.py")])):
+        roots = analysis.traced_roots(paths, root=_REPO)
+        sections.append({
+            "name": label,
+            "ok": not roots,
+            "detail": (f"{len(roots)} traced function(s)"
+                       if roots else "clean"),
+            "traced": roots,
+        })
+    return sections
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint_all", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a JSON section report on stdout")
+    args = ap.parse_args(argv)
+
+    sections = run_sections()
+    ok = all(s["ok"] for s in sections)
+    if args.as_json:
+        print(json.dumps({"ok": ok, "sections": sections},
+                         indent=1, sort_keys=True))
+    else:
+        for s in sections:
+            mark = "PASS" if s["ok"] else "FAIL"
+            print(f"[{mark}] {s['name']}: {s['detail']}")
+            for f in s.get("new", []):
+                print(f"    {f['path']}:{f['line']}: {f['rule']} "
+                      f"{f['message']}")
+            for f in s.get("errors", []):
+                print(f"    {f['path']}:{f['line']}: {f['rule']} "
+                      f"{f['message']}")
+            for r in s.get("traced", []):
+                print(f"    {r['path']}:{r['line']}: traced function "
+                      f"'{r['name']}'")
+        print("lint_all: " + ("all sections clean"
+                              if ok else "FAILURES above"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,13 +123,14 @@ def serving_summary(metrics: dict) -> dict:
     aggregates, and the prefix-cache hit/miss/eviction counters +
     occupancy gauges), plus a derived block-level
     ``prefix_hit_rate_derived`` when the hit/miss counters are
-    present. Graftsan runtime-sanitizer violation counters
-    (``ds_blocksan_*`` / ``ds_affinity_*``, ISSUE 11) ride along when
-    present — a nonzero value there is a correctness finding, not a
-    perf number."""
+    present. Runtime-sanitizer violation counters (``ds_blocksan_*`` /
+    ``ds_affinity_*``, ISSUE 11; ``ds_meshsan_*``, ISSUE 15) ride
+    along when present — a nonzero value there is a correctness
+    finding, not a perf number."""
     out = {k: v for k, v in sorted(metrics.items())
            if "ds_serving_" in k or "ds_blocksan_" in k
-           or "ds_affinity_" in k or "ds_kv_" in k}
+           or "ds_affinity_" in k or "ds_meshsan_" in k
+           or "ds_kv_" in k}
 
     def total(stem: str):
         vals = [v for k, v in metrics.items() if stem in k
@@ -186,7 +187,7 @@ def print_report(report: dict) -> None:
     if serving:
         print()
         print("serving summary (ds_serving_* incl. prefix cache + "
-              "graftsan sanitizer counters):")
+              "graftsan/meshsan sanitizer counters):")
         print(f"{'series':<64}{'value':>14}")
         for series in sorted(serving):
             v = serving[series]
