@@ -329,7 +329,10 @@ _QWZ_WIRE_OPS = ("all_gather",)
 def seed_training_contract(axis_sizes: dict,
                            quantized_gradients: bool = False,
                            quantized_weights: bool = False,
-                           min_bytes: int = 65536) -> TrafficContract:
+                           min_bytes: int = 65536,
+                           moe_dispatch: bool = False,
+                           moe_quantized_dispatch: bool = False
+                           ) -> TrafficContract:
     """The compiled train step's contract, derived from the mesh
     topology and the ZeRO++ wire flags exactly as the engine configures
     them: bytes may move on every mesh axis with extent > 1; all-to-all
@@ -341,11 +344,27 @@ def seed_training_contract(axis_sizes: dict,
     carry a <= 2.0 B/element wire ceiling PER QUANTIZED DIRECTION
     (int8 payload + fp32 block scales is ~1.03-1.5): qgZ limits the
     gradient-exchange op class, qwZ the weight all-gather — the other
-    direction legitimately stays fp32 when its flag is off."""
+    direction legitimately stays fp32 when its flag is off.
+
+    ``moe_dispatch`` (ISSUE 16): the engine's ep-sharded MoE dispatcher
+    routes the token shuffle through an explicit capacity
+    reduce-scatter/all-gather over the TOKEN axes (dp/fsdp/zps), which
+    XLA is free to lower as all-to-all + local reduce — those axes join
+    the expected-a2a set whenever the dispatcher is engaged, so a
+    dispatch landing on any OTHER axis (a mis-sharded table) is still a
+    named finding. No wire ceiling rides the MoE a2a op class even for
+    an int8/fp8 wire (``moe_quantized_dispatch``): the combine leg and
+    the dispatch transpose legitimately stay full-precision and lower
+    to all-to-alls on the SAME (axis, op) buckets, so an aggregate
+    ceiling there would flag correct programs — the int8 dispatch-byte
+    claim is audited by the bench's per-op HLO accounting instead
+    (bench.py moe_train, `--gate moe`)."""
     live = {a for a, n in (axis_sizes or {}).items() if int(n) > 1}
     a2a = {"sp", "ep"} & live
     if quantized_gradients:
         a2a |= {"fsdp", "zps"} & live
+    if moe_dispatch or moe_quantized_dispatch:
+        a2a |= {"dp", "fsdp", "zps"} & live
     wire_ops: dict[str, float] = {}
     if quantized_gradients:
         wire_ops.update({op: 2.0 for op in _QGZ_WIRE_OPS})
@@ -353,6 +372,12 @@ def seed_training_contract(axis_sizes: dict,
         wire_ops.update({op: 2.0 for op in _QWZ_WIRE_OPS})
     wire = ({a: dict(wire_ops) for a in ("fsdp", "zps") if a in live}
             if wire_ops else {})
+    if (moe_dispatch or moe_quantized_dispatch) and wire:
+        # qgZ's a2a ceiling cannot coexist with an engaged MoE
+        # dispatcher: the full-precision combine/transpose legs of the
+        # token shuffle share those (axis, op) buckets (see above)
+        for by_op in wire.values():
+            by_op.pop("all_to_all", None)
     return TrafficContract(
         axes=live,
         all_to_all_axes=a2a,

@@ -73,6 +73,17 @@ class AutotuningConfig(DeepSpeedConfigModel):
     # "int8"/"fp8" = the ZeRO++ quantized protocol. Quantized entries
     # only pair with ZeRO stage >= 2 (the wire is a shard feature).
     wire_dtypes: list[str] = Field(default_factory=lambda: ["fp32"])
+    # MoE routing grid (ISSUE 16), used only when the tuned model has
+    # num_experts > 0: capacity factors to try (0.0 = keep the model
+    # config's value) and dispatch all-to-all wire formats for the
+    # ep-sharded token exchange (moe.wire_dtype — independent of the
+    # ZeRO wire above). Candidates are costed by the same per-axis
+    # collective-bytes ledger as every other grid point; add "ep" to
+    # mesh_axes to search expert-parallel degree too (ep points that
+    # don't divide num_experts are skipped).
+    moe_capacity_factors: list[float] = Field(
+        default_factory=lambda: [0.0])
+    moe_wire_dtypes: list[str] = Field(default_factory=lambda: ["fp32"])
     # score quantized-wire variants analytically from the fp32
     # sibling's compiled facts (cost_model.quantized_wire_facts)
     # instead of compiling each variant config — one engine build per

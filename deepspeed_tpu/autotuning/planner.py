@@ -67,6 +67,13 @@ class Candidate:
     # protocol (runtime/zeropp.py). Joins the grid via
     # AutotuningConfig.wire_dtypes.
     wire_dtype: str = "fp32"
+    # MoE routing grid (ISSUE 16), only populated for MoE models:
+    # capacity factor 0.0 = keep the model config's value; moe_wire is
+    # the dispatch all-to-all wire (runtime/comm/moe_alltoall.py),
+    # independent of the ZeRO wire_dtype above. Joins the grid via
+    # AutotuningConfig.moe_capacity_factors / moe_wire_dtypes.
+    moe_capacity_factor: float = 0.0
+    moe_wire: str = "fp32"
 
     @property
     def mesh_sizes(self) -> dict[str, int]:
@@ -78,8 +85,13 @@ class Candidate:
                else "")
         wire = (f" wire={self.wire_dtype}" if self.wire_dtype != "fp32"
                 else "")
+        moe = ""
+        if self.moe_capacity_factor > 0:
+            moe += f" cf={self.moe_capacity_factor:g}"
+        if self.moe_wire != "fp32":
+            moe += f" a2a={self.moe_wire}"
         return (f"{mesh} mb{self.micro_batch} z{self.zero_stage} "
-                f"remat={self.remat_policy}{off}{wire}")
+                f"remat={self.remat_policy}{off}{wire}{moe}")
 
     def config_patch(self, grad_accum: int = 1) -> dict:
         """The ds-config diff this candidate applies on the base
@@ -100,7 +112,7 @@ class Candidate:
             # had quantization on, or plan replay diverges
             zero["zero_quantized_weights"] = False
             zero["zero_quantized_gradients"] = False
-        return {
+        patch = {
             "mesh": {a: s for a, s in self.mesh},
             "train_micro_batch_size_per_gpu": self.micro_batch,
             "gradient_accumulation_steps": grad_accum,
@@ -108,6 +120,16 @@ class Candidate:
             "zero_optimization": zero,
             "activation_checkpointing": {"policy": self.remat_policy},
         }
+        # only emitted when non-default so dense-model patches (and the
+        # exact-dict assertions plan replay relies on) are unchanged
+        moe: dict[str, Any] = {}
+        if self.moe_wire != "fp32":
+            moe["wire_dtype"] = self.moe_wire
+        if self.moe_capacity_factor > 0:
+            moe["capacity_factor"] = self.moe_capacity_factor
+        if moe:
+            patch["moe"] = moe
+        return patch
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -271,7 +293,18 @@ class Planner:
         mbs = self._micro_batches()
         out: list[Candidate] = []
         wires = cfg.wire_dtypes or ["fp32"]
+        # MoE grid (ISSUE 16): dense models keep a single default point
+        # so their grids are byte-identical to before
+        n_exp = int(getattr(getattr(self.model, "config", None),
+                            "num_experts", 0) or 0)
+        moe_cfs = (cfg.moe_capacity_factors or [0.0]) if n_exp else [0.0]
+        moe_wires = (cfg.moe_wire_dtypes or ["fp32"]) if n_exp else ["fp32"]
         for mesh in meshes:
+            # an ep shard must own a whole number of experts (dense
+            # models have nothing to put on an ep axis at all)
+            ep = dict(mesh).get("ep", 1)
+            if ep > 1 and (n_exp <= 0 or n_exp % ep):
+                continue
             for mb in mbs:
                 for st in stages:
                     for remat in (cfg.remat_policies
@@ -284,13 +317,17 @@ class Planner:
                                     # below stage 2
                                     if wire != "fp32" and st < 2:
                                         continue
-                                    out.append(Candidate(
-                                        mesh=mesh, micro_batch=mb,
-                                        zero_stage=st,
-                                        remat_policy=remat,
-                                        offload_ratio=float(off),
-                                        overlap_ratio=float(ov),
-                                        wire_dtype=str(wire)))
+                                    for mcf in moe_cfs:
+                                        for mwire in moe_wires:
+                                            out.append(Candidate(
+                                                mesh=mesh, micro_batch=mb,
+                                                zero_stage=st,
+                                                remat_policy=remat,
+                                                offload_ratio=float(off),
+                                                overlap_ratio=float(ov),
+                                                wire_dtype=str(wire),
+                                                moe_capacity_factor=float(mcf),
+                                                moe_wire=str(mwire)))
         if cfg.include_base:
             base = self._base_candidate()
             if base is not None and base not in out:
@@ -363,11 +400,15 @@ class Planner:
         wire = (str(zero.get("zero_quantized_dtype", "int8"))
                 if zero.get("zero_quantized_weights")
                 or zero.get("zero_quantized_gradients") else "fp32")
+        moe = base.get("moe", {}) or {}
         return Candidate(mesh=mesh, micro_batch=mb,
                          zero_stage=int(zero.get("stage", 0)),
                          remat_policy=remat,
                          offload_ratio=ratio, overlap_ratio=float(ovs[0]),
-                         wire_dtype=wire)
+                         wire_dtype=wire,
+                         moe_capacity_factor=float(
+                             moe.get("capacity_factor") or 0.0),
+                         moe_wire=str(moe.get("wire_dtype", "fp32")))
 
     # -- memory pruning ------------------------------------------------
     def prune(self, candidates: list[Candidate]) -> \
@@ -736,7 +777,10 @@ class Planner:
                          remat_policy=row["remat_policy"],
                          offload_ratio=row["offload_ratio"],
                          overlap_ratio=row["overlap_ratio"],
-                         wire_dtype=row.get("wire_dtype", "fp32"))
+                         wire_dtype=row.get("wire_dtype", "fp32"),
+                         moe_capacity_factor=row.get(
+                             "moe_capacity_factor", 0.0),
+                         moe_wire=row.get("moe_wire", "fp32"))
 
     @staticmethod
     def _choose(rows: list[dict]) -> int:

@@ -134,6 +134,12 @@ class InferenceEngine:
             self.module = copy.copy(model)
             self.module.moe_serving_dispatch = bool(
                 config.moe_grouped_dispatch)
+            # a training engine may have bound its ep-sharded dispatcher
+            # (shard_map over the TRAINING mesh) to the shared instance;
+            # serving runs on its own tp mesh, so strip it from the copy
+            if hasattr(self.module, "moe_dispatcher"):
+                self.module.moe_dispatcher = None
+                self.module.moe_router_telemetry = False
         self._forward = jax.jit(
             lambda p, tokens: self.module.apply(p, tokens))
         self._generate_fns: dict[tuple, Any] = {}

@@ -337,7 +337,9 @@ class InferenceEngineV2:
         from ..engine import InferenceEngine
         # reuse v1 for param load/shard/dtype (policy+checkpoint layer)
         self._v1 = InferenceEngine(model, config, params=params)
-        self.model = model
+        # take v1's per-engine module copy (serving flags bound, any
+        # training-engine moe_dispatcher stripped), not the raw model
+        self.model = getattr(self._v1, "module", model)
         self.params = self._v1.params
         self._config = config
         c = model.config

@@ -121,9 +121,25 @@ class ModelConfig:
         final_norm = d + (d if self.norm_type == "layernorm" else 0)
         return embed + pos + L * per_layer + final_norm
 
+    def num_active_params(self) -> int:
+        """Parameters a token actually computes with: dense models run
+        everything; an MoE token runs only its top-k routed experts (the
+        router projection and any shared experts always run). This is
+        the MFU denominator — counting parked experts would credit the
+        model with FLOPs it never executed."""
+        n = self.num_params()
+        if self.num_experts <= 0:
+            return n
+        d, f = self.hidden_size, self.intermediate_size
+        per_expert = 3 * d * f if self.activation == "swiglu" else 2 * d * f
+        inactive = max(self.num_experts - self.moe_top_k, 0)
+        return n - self.num_layers * inactive * per_expert
+
     def flops_per_token(self, seq_len: int, causal: bool = True) -> float:
-        """Training FLOPs/token (fwd+bwd ~= 6*N + attention term), the
-        standard MFU accounting (BASELINE.md §9).
+        """Training FLOPs/token (fwd+bwd ~= 6*N_active + attention
+        term), the standard MFU accounting (BASELINE.md §9). For MoE
+        models N is :meth:`num_active_params` — top-k experts per
+        token, not the full expert bank.
 
         ``causal=True`` (default — the PRIMARY number for every reported
         MFU) counts only the attention work a causal model performs: the
@@ -133,7 +149,7 @@ class ModelConfig:
         at long sequence it flatters MFU ~2x and is kept only as a
         secondary figure.
         """
-        n = self.num_params()
+        n = self.num_active_params()
         s = seq_len
         if causal:
             w = self.sliding_window

@@ -23,6 +23,14 @@ def mixtral_config(size: str = "8x7b", **overrides) -> ModelConfig:
         "tiny": dict(hidden_size=64, num_layers=2, num_heads=4,
                      num_kv_heads=2, intermediate_size=128, vocab_size=512,
                      max_seq_len=128, num_experts=4, moe_top_k=2),
+        # MoE reference config (ISSUE 16): big enough that routing,
+        # ep sharding and the dispatch wire dominate like a real MoE
+        # block (8 experts top-2 -> 4x total/active param ratio in the
+        # FFN), small enough for the bench rig and slow tests
+        "ref": dict(hidden_size=256, num_layers=4, num_heads=4,
+                    num_kv_heads=4, intermediate_size=512,
+                    vocab_size=4096, max_seq_len=512, num_experts=8,
+                    moe_top_k=2, capacity_factor=1.25),
         "8x7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
                      num_kv_heads=8, intermediate_size=14336,
                      vocab_size=32000, max_seq_len=4096, num_experts=8,
@@ -77,6 +85,16 @@ class Mixtral(DecoderLM):
     # training sharded_moe dispatch)
     moe_serving_dispatch = False
 
+    # set by the training engine (runtime/engine.py, ISSUE 16): the
+    # ep-sharded explicit dispatch/combine exchange, routing overrides
+    # from the moe config block (None = this config's values), and the
+    # router-telemetry opt-in. Class attrs so plain model use (tests,
+    # serving) keeps the implicit einsum collectives.
+    moe_dispatcher = None
+    moe_capacity_factor = None
+    moe_min_capacity = None
+    moe_router_telemetry = False
+
     def _mlp(self, p, h):
         c = self.config
         from ..moe.sharded_moe import dequantize_experts
@@ -88,10 +106,18 @@ class Mixtral(DecoderLM):
                                    k=c.moe_top_k,
                                    activation=c.activation,
                                    normalize_topk=norm)
+        hook = None
+        if self.moe_router_telemetry:
+            from ..moe.dispatch import publish_router_metrics
+            hook = publish_router_metrics
+        cf = self.moe_capacity_factor
+        mc = self.moe_min_capacity
         return moe_ffn(
             h, p["router"], experts, k=c.moe_top_k,
-            capacity_factor=c.capacity_factor, min_capacity=c.min_capacity,
-            activation=c.activation, normalize_topk=norm)
+            capacity_factor=c.capacity_factor if cf is None else cf,
+            min_capacity=c.min_capacity if mc is None else mc,
+            activation=c.activation, normalize_topk=norm,
+            dispatcher=self.moe_dispatcher, metrics_hook=hook)
 
     def partition_rules(self):
         rules = [r for r in super().partition_rules()

@@ -27,7 +27,8 @@ class MoE:
     def __init__(self, hidden_size: int, ffn_dim: int, num_experts: int,
                  k: int = 1, capacity_factor: float = 1.0,
                  eval_capacity_factor: float = 1.0, min_capacity: int = 4,
-                 activation: str = "gelu", use_residual: bool = False):
+                 activation: str = "gelu", use_residual: bool = False,
+                 dispatcher=None):
         self.hidden_size = hidden_size
         self.ffn_dim = ffn_dim
         self.num_experts = num_experts
@@ -36,6 +37,7 @@ class MoE:
         self.min_capacity = min_capacity
         self.activation = activation
         self.use_residual = use_residual  # PR-MoE residual expert
+        self.dispatcher = dispatcher  # e.g. EpShardedDispatcher (ep > 1)
 
     def init(self, rng, dtype=jnp.float32):
         d, f, e = self.hidden_size, self.ffn_dim, self.num_experts
@@ -63,7 +65,8 @@ class MoE:
         out, aux = moe_ffn(
             x, params["router"], params["experts"], k=self.k,
             capacity_factor=self.capacity_factor,
-            min_capacity=self.min_capacity, activation=self.activation)
+            min_capacity=self.min_capacity, activation=self.activation,
+            dispatcher=self.dispatcher)
         if self.use_residual:
             # PR-MoE: dense residual expert mixed by a learned coefficient
             r = params["residual_mlp"]

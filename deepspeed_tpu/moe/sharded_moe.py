@@ -16,6 +16,7 @@ top-k — reference top1gating/top2gating/topkgating (sharded_moe.py:183,
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Callable
 
@@ -39,7 +40,15 @@ def top_k_gating(logits: jax.Array, k: int, capacity_factor: float = 1.0,
     logits: [N, E] router outputs for N tokens.
     """
     n, e = logits.shape
-    capacity = compute_capacity(n, e, k, capacity_factor, min_capacity)
+    if drop_tokens:
+        capacity = compute_capacity(n, e, k, capacity_factor, min_capacity)
+    else:
+        # no-drop mode must size capacity to the WORST-CASE expert load:
+        # top-k indices are distinct per token, so one expert can claim
+        # at most one slot per token — n slots. A fixed capacity_factor
+        # capacity here silently one-hots overflow positions past the
+        # table into zero rows (they were "kept" but never dispatched)
+        capacity = max(n, min_capacity)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     topk_probs, topk_idx = lax.top_k(probs, k)          # [N, k]
@@ -112,7 +121,9 @@ def quantize_experts(experts: dict, scale_dtype=None) -> dict:
 def dequantize_experts(experts: dict, dtype) -> dict:
     """Inline dequant of a quantize_experts tree; under jit XLA fuses
     this into the consuming GEMM (no bf16 materialization in HBM)."""
-    if "w_up_q" not in experts:
+    if not any(k.endswith("_q") for k in experts):
+        # not a quantized tree (gate-less gelu dicts have no w_up_q
+        # either; any *_q key marks the quantize_experts form)
         return experts
     return {k[:-2]: experts[k].astype(dtype)
             * experts[k[:-2] + "_s"].astype(dtype)
@@ -176,30 +187,12 @@ def moe_ffn_grouped(x: jax.Array, gate_w: jax.Array, experts: dict, *,
     return out.reshape(b, s, d), aux
 
 
-def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: dict, *,
-            k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
-            activation: str = "swiglu", normalize_topk: bool = True,
-            constrain: Callable | None = None):
-    """Full MoE FFN for a [B, S, D] block input.
-
-    experts: {"w_up": [E, D, F], "w_down": [E, F, D], ("w_gate": [E, D, F])}.
-    With the E dim sharded over the ``ep`` mesh axis, the two einsums below
-    become XLA all-to-alls (dispatch/combine) around expert-local GEMMs.
-    Returns (out [B, S, D], aux_loss).
-    """
-    b, s, d = x.shape
-    n = b * s
-    xt = x.reshape(n, d)
-    logits = xt @ gate_w                                  # [N, E]
-    combine, dispatch, aux, _ = top_k_gating(
-        logits, k, capacity_factor, min_capacity,
-        normalize_topk=normalize_topk)
-    combine = combine.astype(x.dtype)
-
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt,
-                           preferred_element_type=x.dtype)
-    if constrain is not None:
-        expert_in = constrain(expert_in)
+def _expert_ffn(expert_in: jax.Array, experts: dict,
+                activation: str = "swiglu") -> jax.Array:
+    """The per-expert FFN on dispatched slots [E, C, D] -> [E, C, D].
+    Shared between the global capacity-einsum path and the ep-sharded
+    dispatcher's shard_map body (where E and C are the LOCAL extents).
+    Bias-free, so zero (padded / unfilled) slots stay exactly zero."""
     if activation == "swiglu":
         gate = jnp.einsum("ecd,edf->ecf", expert_in, experts["w_gate"])
         up = jnp.einsum("ecd,edf->ecf", expert_in, experts["w_up"])
@@ -208,8 +201,50 @@ def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: dict, *,
         h = jax.nn.gelu(
             jnp.einsum("ecd,edf->ecf", expert_in, experts["w_up"]),
             approximate=True)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, experts["w_down"])
+    return jnp.einsum("ecf,efd->ecd", h, experts["w_down"])
+
+
+def moe_ffn(x: jax.Array, gate_w: jax.Array, experts: dict, *,
+            k: int = 2, capacity_factor: float = 1.0, min_capacity: int = 4,
+            activation: str = "swiglu", normalize_topk: bool = True,
+            constrain: Callable | None = None, drop_tokens: bool = True,
+            dispatcher: Callable | None = None,
+            metrics_hook: Callable | None = None):
+    """Full MoE FFN for a [B, S, D] block input.
+
+    experts: {"w_up": [E, D, F], "w_down": [E, F, D], ("w_gate": [E, D, F])}.
+    With the E dim sharded over the ``ep`` mesh axis, the two einsums below
+    become XLA all-to-alls (dispatch/combine) around expert-local GEMMs.
+    ``dispatcher`` (moe/dispatch.py EpShardedDispatcher, wired by the
+    engine) replaces that implicit form with the explicit hierarchical
+    (optionally int8-wire) dispatch/combine exchange; gating stays
+    global either way. ``metrics_hook`` receives top_k_gating's metrics
+    dict at trace time (telemetry/dispatch publishing).
+    Returns (out [B, S, D], aux_loss).
+    """
+    b, s, d = x.shape
+    n = b * s
+    xt = x.reshape(n, d)
+    logits = xt @ gate_w                                  # [N, E]
+    combine, dispatch, aux, metrics = top_k_gating(
+        logits, k, capacity_factor, min_capacity,
+        normalize_topk=normalize_topk, drop_tokens=drop_tokens)
+    if metrics_hook is not None:
+        metrics_hook(metrics)
+    combine = combine.astype(x.dtype)
+
+    if dispatcher is not None:
+        out = dispatcher(xt, combine, dispatch.astype(x.dtype), experts,
+                         functools.partial(_expert_ffn,
+                                           activation=activation))
+        return out.reshape(b, s, d), aux
+
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt,
+                           preferred_element_type=x.dtype)
     if constrain is not None:
-        expert_out = constrain(expert_out)
-    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        expert_in = constrain(expert_in)
+    h = _expert_ffn(expert_in, experts, activation)
+    if constrain is not None:
+        h = constrain(h)
+    out = jnp.einsum("nec,ecd->nd", combine, h)
     return out.reshape(b, s, d), aux

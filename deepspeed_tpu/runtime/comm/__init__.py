@@ -3,3 +3,5 @@ deepspeed/runtime/comm/)."""
 
 from .coalesced_collectives import (all_to_all_quant_reduce,  # noqa: F401
                                     reduce_scatter_coalesced)
+from .moe_alltoall import (moe_combine_exchange,  # noqa: F401
+                           moe_dispatch_exchange)

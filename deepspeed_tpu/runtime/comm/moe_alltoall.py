@@ -1,0 +1,136 @@
+# shardlint: axes=dp,fsdp,zps,ep
+"""MoE-shaped hierarchical all-to-all: the dispatch/combine token
+shuffle of an ep-sharded MoE block as explicit collectives (ISSUE 16;
+reference: deepspeed/moe/sharded_moe.py _AllToAll:96 + the ZeRO++ qgZ
+wire of coalesced_collectives.py).
+
+Layout: gating is computed globally, so every token shard holds a
+PARTIAL dispatch table for the full capacity range of its local expert
+shard — ``partial[e, c, :]`` is nonzero only when slot ``(e, c)`` was
+claimed by one of this device's tokens. Summing those partials over the
+token axes while scattering the capacity dim IS the dispatch all-to-all
+(a SUM reduce-scatter == all-to-all + local reduce, exactly how qgZ
+lowers it); the combine direction is its transpose, an all-gather of
+the expert outputs back to full capacity. Routing the exchange through
+:func:`~.coalesced_collectives.hierarchical_quantized_reduce_scatter`
+gives the two-hop form — fast intra-hop (``zps``) first, slow
+inter-hop (``dp``/``fsdp``) on 1/zps-sized partials — with an optional
+int8/fp8 stochastic-rounded wire for the dispatched activations
+(``moe.wire_dtype``).
+
+The quantized wire has a zero gradient through ``jnp.round``, so it is
+wrapped in a ``custom_vjp`` whose backward is the TRANSPOSE of the
+unquantized exchange (an all-gather of the shard cotangent) — the
+straight-through estimator, same convention as the qgZ gradient wire.
+Chunk order is outer-major/inner-minor for every wire, i.e. the shard
+this device owns under ``PartitionSpec((*outer, *inner))`` on ``dim``,
+so dispatch and combine always invert each other exactly.
+
+Everything here must run inside ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .coalesced_collectives import (hierarchical_quantized_reduce_scatter,
+                                    quantized_reduce_scatter)
+
+MOE_WIRE_DTYPES = ("fp32", "bf16", "int8", "fp8")
+
+
+@functools.lru_cache(maxsize=None)
+def _quantized_dispatch_fn(outer_axes: tuple[str, ...],
+                           inner_axes: tuple[str, ...], dim: int,
+                           wire_dtype: str, rounding: str):
+    """custom_vjp wrapper of the (two-hop when both axis groups are
+    live) quantized reduce-scatter; cached per static config so the
+    vjp identity is stable across traces. ``seed`` rides as a traced
+    uint32 arg (custom_vjp cannot close over tracers) with a float0
+    cotangent."""
+    axes = tuple(outer_axes) + tuple(inner_axes)
+
+    def impl(x, seed):
+        if outer_axes and inner_axes:
+            return hierarchical_quantized_reduce_scatter(
+                x, outer_axes, inner_axes, dim, wire_dtype=wire_dtype,
+                rounding=rounding, seed=seed)
+        return quantized_reduce_scatter(
+            x, axes, dim, wire_dtype=wire_dtype, rounding=rounding,
+            seed=seed)
+
+    @jax.custom_vjp
+    def exchange(x, seed):
+        return impl(x, seed)
+
+    def fwd(x, seed):
+        return impl(x, seed), None
+
+    def bwd(_, ct):
+        # straight-through: the unquantized SUM reduce-scatter's
+        # transpose is an all-gather of the shard cotangent back to
+        # full capacity on every token shard
+        return (lax.all_gather(ct, axes, axis=dim, tiled=True),
+                np.zeros((), jax.dtypes.float0))
+
+    exchange.defvjp(fwd, bwd)
+    return exchange
+
+
+def moe_dispatch_exchange(partial: jax.Array,
+                          outer_axes: tuple[str, ...],
+                          inner_axes: tuple[str, ...], dim: int = 1,
+                          wire_dtype: str = "fp32",
+                          rounding: str = "stochastic",
+                          seed=0) -> jax.Array:
+    """SUM-reduce the per-token-shard partial dispatch tables
+    ``[E_local, C, D]`` over the token axes while scattering ``dim``
+    (capacity): every token shard ends with its ``C / token_world``
+    slice of the fully-summed expert input. ``C`` must be a multiple of
+    the combined token world (callers pad).
+
+    wire_dtype: "fp32" exact, "bf16" half-width wire, "int8"/"fp8" the
+    qgZ block-quantized protocol (optionally stochastic-rounded on
+    ``seed``, the training step) — forward-only; gradients flow
+    straight-through at full width.
+    """
+    outer, inner = tuple(outer_axes), tuple(inner_axes)
+    axes = outer + inner
+    if not axes:
+        return partial
+    if wire_dtype in ("int8", "fp8"):
+        fn = _quantized_dispatch_fn(outer, inner, dim, wire_dtype,
+                                    rounding)
+        return fn(partial, jnp.asarray(seed, jnp.uint32))
+    if wire_dtype == "bf16":
+        out = lax.psum_scatter(partial.astype(jnp.bfloat16), axes,
+                               scatter_dimension=dim, tiled=True)
+        return out.astype(partial.dtype)
+    if wire_dtype != "fp32":
+        raise ValueError(f"unknown moe wire_dtype {wire_dtype!r}; "
+                         f"expected one of {MOE_WIRE_DTYPES}")
+    return lax.psum_scatter(partial, axes, scatter_dimension=dim,
+                            tiled=True)
+
+
+def moe_combine_exchange(shard: jax.Array,
+                         outer_axes: tuple[str, ...],
+                         inner_axes: tuple[str, ...], dim: int = 1,
+                         wire_dtype: str = "fp32") -> jax.Array:
+    """The combine direction: all-gather the expert-output capacity
+    shards back to the full table on every token shard — the exact
+    transpose of :func:`moe_dispatch_exchange`'s chunk order, and
+    natively differentiable (its vjp is the psum_scatter). The combine
+    wire stays float (the int8 protocol covers DISPATCHED activations
+    only); "bf16" halves the gather bytes."""
+    axes = tuple(outer_axes) + tuple(inner_axes)
+    if not axes:
+        return shard
+    x = shard.astype(jnp.bfloat16) if wire_dtype == "bf16" else shard
+    return lax.all_gather(x, axes, axis=dim,
+                          tiled=True).astype(shard.dtype)

@@ -302,6 +302,38 @@ class MeshsanConfig(DeepSpeedConfigModel):
     wire_min_bytes: int = Field(65536, ge=0)
 
 
+class MoEConfig(DeepSpeedConfigModel):
+    """Expert-parallel MoE training (ISSUE 16, docs/moe.md). Routes the
+    dispatch/combine token shuffle of an MoE model (``num_experts > 0``)
+    through the explicit hierarchical exchange
+    (``runtime/comm/moe_alltoall.py``): fast intra-hop over ``zps``
+    first, slow inter-hop over ``dp``/``fsdp`` on 1/zps-sized partials,
+    with an optional int8/fp8 stochastic-rounded wire for the
+    dispatched activations (the ZeRO++ qgZ protocol applied to tokens).
+    Routing semantics (top_k_gating capacity/drops) are unchanged —
+    only the wire. Ignored for dense models."""
+    # None = auto: engage the explicit dispatcher when mesh.ep > 1
+    # (true forces it on any token-sharded mesh — e.g. to get the
+    # quantized dispatch wire without expert sharding; false keeps
+    # XLA's implicit dispatch collectives)
+    enabled: Optional[bool] = None
+    # dispatch-activation wire: fp32 = exact, bf16 = half-width,
+    # int8/fp8 = block-quantized qgZ wire (forward only; gradients flow
+    # straight-through at full width)
+    wire_dtype: Literal["fp32", "bf16", "int8", "fp8"] = "fp32"
+    # int8 wire rounding; "stochastic" keys unbiased noise on the
+    # training step (recommended — wire error averages out over steps)
+    rounding: Literal["nearest", "stochastic"] = "stochastic"
+    # routing overrides (None = the model config's values); surfaced so
+    # the autotuner can grid capacity_factor without rebuilding models
+    capacity_factor: Optional[float] = None
+    min_capacity: Optional[int] = None
+    # publish router drop-fraction / expert-load gauges each step via
+    # jax.debug.callback (requires active telemetry; small dispatch
+    # overhead — off by default)
+    router_telemetry: bool = False
+
+
 class FlopsProfilerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     profile_step: int = 1
@@ -433,6 +465,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     sentinels: SentinelsConfig = Field(default_factory=SentinelsConfig)
     meshsan: MeshsanConfig = Field(default_factory=MeshsanConfig)
+    moe: MoEConfig = Field(default_factory=MoEConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)

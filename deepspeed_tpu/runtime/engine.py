@@ -31,6 +31,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import comm as dist
 from ..models.base import ModelConfig
+from ..moe.dispatch import moe_step
 from ..parallel.mesh import MeshTopology, TopologyConfig, set_topology
 from ..parallel.partition import constrain, named_shardings
 from ..utils.logging import log_dist, logger
@@ -139,6 +140,42 @@ class DeepSpeedEngine:
             else:
                 self.model_config.remat = True
                 self.model_config.remat_policy = ac_cfg.policy
+        # --- MoE expert-parallel dispatch (ISSUE 16) --------------------
+        # Bind the ep-sharded explicit dispatch/combine exchange (and
+        # the routing overrides/telemetry flag) to the module; attrs
+        # are (re)set unconditionally so a model instance reused across
+        # engines never carries a stale dispatcher into a new mesh.
+        moe_cfg = self.config.moe
+        self._moe_dispatcher = None
+        if hasattr(self.module, "moe_dispatcher"):
+            self.module.moe_dispatcher = None
+            self.module.moe_capacity_factor = moe_cfg.capacity_factor
+            self.module.moe_min_capacity = moe_cfg.min_capacity
+            self.module.moe_router_telemetry = bool(
+                moe_cfg.router_telemetry)
+            want = (moe_cfg.enabled if moe_cfg.enabled is not None
+                    else self.topology.sizes.get("ep", 1) > 1)
+            if want:
+                from ..moe.dispatch import (EpShardedDispatcher,
+                                            dispatcher_unsupported_reason)
+                n_exp = int(getattr(self.model_config, "num_experts", 0)
+                            or 0)
+                why = dispatcher_unsupported_reason(self.topology, n_exp)
+                if why is not None:
+                    logger.warning(
+                        f"moe: ep-sharded dispatcher disabled ({why}); "
+                        "falling back to XLA's implicit dispatch "
+                        "collectives")
+                else:
+                    self._moe_dispatcher = EpShardedDispatcher.for_topology(
+                        self.topology, wire_dtype=moe_cfg.wire_dtype,
+                        rounding=moe_cfg.rounding)
+                    self.module.moe_dispatcher = self._moe_dispatcher
+                    log_dist(
+                        f"moe: ep-sharded dispatch engaged "
+                        f"(wire={moe_cfg.wire_dtype} slow="
+                        f"{self._moe_dispatcher.slow_axes} fast="
+                        f"{self._moe_dispatcher.fast_axes})")
         self.compute_dtype = self.config.compute_dtype
         self._mixed = self.compute_dtype != jnp.float32
         self.fp16_enabled = bool(self.config.fp16.enabled)
@@ -359,7 +396,11 @@ class DeepSpeedEngine:
                 self.topology.sizes,
                 quantized_gradients=zq.zero_quantized_gradients,
                 quantized_weights=zq.zero_quantized_weights,
-                min_bytes=ms_cfg.wire_min_bytes)
+                min_bytes=ms_cfg.wire_min_bytes,
+                moe_dispatch=self._moe_dispatcher is not None,
+                moe_quantized_dispatch=(
+                    self._moe_dispatcher is not None
+                    and self.config.moe.wire_dtype in ("int8", "fp8")))
             if ms_cfg.axes is not None:
                 contract.axes = frozenset(ms_cfg.axes)
             if ms_cfg.all_to_all_axes is not None:
@@ -603,7 +644,11 @@ class DeepSpeedEngine:
                 # an STE, pruning masks gate the gradient too (reference
                 # basic_layer.py forward semantics)
                 params = compress(params, step)
-            loss = loss_fn(params, batch)
+            # step binding scopes the MoE stochastic-wire rounding seed
+            # to this (traced) step; try/finally keeps a failed trace
+            # from leaking the tracer into the contextvar
+            with moe_step(step):
+                loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
         grad_fn = self._make_grad_fn(micro_loss)
@@ -715,7 +760,8 @@ class DeepSpeedEngine:
         def micro_loss(params, batch, scale, step):
             if compress is not None:
                 params = compress(params, step)
-            loss = loss_fn(params, batch)
+            with moe_step(step):
+                loss = loss_fn(params, batch)
             return loss * scale.astype(loss.dtype), loss
 
         grad_fn = self._make_grad_fn(micro_loss)
@@ -1076,7 +1122,8 @@ class DeepSpeedEngine:
                 def micro_loss(p, batch, scale, step):
                     if compress is not None:
                         p = compress(p, step)
-                    l = loss_fn(p, batch)
+                    with moe_step(step):
+                        l = loss_fn(p, batch)
                     return l * scale.astype(l.dtype), l
 
                 fn = local_value_and_grad(

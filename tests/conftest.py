@@ -101,6 +101,24 @@ _SLOW = {
     ("test_checkpoint.py", "test_reshard_on_plain_load"),
     ("test_moe.py", "test_mixtral_ep_parity"),
     ("test_moe.py", "test_moe_serving_dispatch_wired"),
+    # ISSUE 16: engine-backed int8-dispatch-wire + meshsan-raise +
+    # router-telemetry acceptance; the host-only shard_map SUM-parity
+    # test (test_ep_sharded_dispatch_sum_parity) stays tier-1
+    ("test_moe.py", "test_engine_int8_dispatch_wire_meshsan"),
+    # ISSUE 16 budget buyback: the tier-1 wall hit ~800 s of the 870 s
+    # budget; these five (~83 s profiled) are the heaviest variants
+    # whose subsystems keep a lighter tier-1 sibling — fused-decode
+    # bookkeeping (test_fused_greedy_matches_per_tick stays), pipeline
+    # parity (test_pipeline_with_zero3_and_gpt2 + slow 1f1b-vs-flat
+    # stay), offload ratio/nvme-fp16 (test_cpu_offload_matches_baseline
+    # + test_param_offload_cpu stay), and the Infinity nvme tier
+    # (test_streamed_matches_sharded_fp32 stays)
+    ("test_inference_v2.py",
+     "test_fused_mid_loop_eos_and_inter_dispatch_admission"),
+    ("test_pipeline.py", "test_pipeline_matches_non_pipeline"),
+    ("test_offload.py", "test_twin_flow_partial_offload_ratio"),
+    ("test_offload.py", "test_nvme_offload_fp16_scale_backoff"),
+    ("test_infinity.py", "test_streamed_nvme_matches_cpu_tier"),
     ("test_model_families.py", "test_family_trains_through_engine"),
     ("test_model_families.py", "test_bert_encoder_end_to_end"),
     ("test_sequence_parallel.py",

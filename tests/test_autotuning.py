@@ -488,3 +488,49 @@ def test_autotuner_end_to_end(devices8):
     assert best is not None and val > 0
     assert best["zero_optimization"]["stage"] in (0, 3)
     assert len(at.rm.results) == 2
+
+
+def test_moe_grid_and_config_patch(devices8):
+    """ISSUE 16: the MoE grid (ep x capacity_factor x dispatch wire)
+    opens only for MoE models, ep mesh points must divide num_experts,
+    and the moe config-patch block is emitted only when non-default so
+    dense plans stay byte-identical."""
+    from deepspeed_tpu.models import Mixtral
+
+    base = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"fsdp": -1},
+            "train_micro_batch_size_per_gpu": 1,
+            "zero_optimization": {"stage": 0}}
+    cfg = AutotuningConfig(enabled=True, mesh_axes=["fsdp", "ep"],
+                           zero_stages=[0],
+                           num_tuning_micro_batch_sizes=1,
+                           moe_capacity_factors=[0.0, 1.5],
+                           moe_wire_dtypes=["fp32", "int8"],
+                           include_base=False)
+    dense = Planner(GPT2(size="tiny"), base, cfg).enumerate_candidates()
+    moe = Planner(Mixtral(size="tiny"), base,
+                  cfg).enumerate_candidates()
+
+    # dense: the MoE grid collapses to the single default point and no
+    # mesh puts anything on ep
+    assert all(c.moe_capacity_factor == 0.0 and c.moe_wire == "fp32"
+               for c in dense)
+    assert all(dict(c.mesh).get("ep", 1) == 1 for c in dense)
+
+    # moe (tiny Mixtral: 4 experts, 8 devices): ep 8 can't split 4
+    # experts; every surviving mesh carries the full 2x2 routing grid
+    assert {dict(c.mesh).get("ep", 1) for c in moe} == {1, 2, 4}
+    assert len(moe) == len(dense) * 3 * 4   # 3 ep points x (2 cf x 2 wire)
+    assert {(c.moe_capacity_factor, c.moe_wire) for c in moe} == {
+        (0.0, "fp32"), (0.0, "int8"), (1.5, "fp32"), (1.5, "int8")}
+
+    # patch emission: defaults add NO moe block; non-defaults round-trip
+    # through the patch and show up in the trial label
+    kw = dict(mesh=(("fsdp", 4), ("ep", 2)), micro_batch=1, zero_stage=3,
+              remat_policy="nothing_saveable", offload_ratio=0.0,
+              overlap_ratio=0.71)
+    assert "moe" not in Candidate(**kw).config_patch(1)
+    tuned = Candidate(**kw, moe_capacity_factor=1.5, moe_wire="int8")
+    assert tuned.config_patch(1)["moe"] == {"wire_dtype": "int8",
+                                            "capacity_factor": 1.5}
+    assert "cf=1.5" in tuned.label() and "a2a=int8" in tuned.label()

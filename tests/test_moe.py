@@ -187,3 +187,185 @@ def test_moe_quantized_experts_serving(devices8):
     assert rel < 0.05, rel
     out = e_q.generate(toks, max_new_tokens=4)
     assert out.shape == (2, 12)
+
+
+# ---- ISSUE 16: ep-sharded dispatch + no-drop gating + dispatch wire --
+
+
+def test_no_drop_gating_conserves_tokens():
+    """Satellite regression: drop_tokens=False must size capacity to
+    the worst-case expert load even at capacity_factor 0 — the old
+    code still applied the factor and silently dropped overflow."""
+    # adversarial load: every token wants expert 0
+    logits = jnp.zeros((32, 4)).at[:, 0].set(10.0)
+    combine, dispatch, _, metrics = top_k_gating(
+        logits, k=1, capacity_factor=0.0, drop_tokens=False)
+    assert dispatch.shape[2] >= 32          # capacity >= n (worst case)
+    assert int(jnp.sum(dispatch)) == 32     # every token kept
+    assert float(metrics["drop_fraction"]) == 0.0
+    # every token's full gate weight survives (nothing zeroed by keep)
+    sums = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    np.testing.assert_allclose(sums, sums[0] * np.ones(32), rtol=1e-6)
+    assert sums[0] > 0.99  # softmax top-1 of a +10 logit margin
+
+
+def test_dequantize_experts_gateless_roundtrip():
+    """Satellite regression: dequantize_experts keyed off the literal
+    'w_up_q'; any *_q key must mark the quantized form so gate-less
+    (gelu-only) expert dicts round-trip too."""
+    from deepspeed_tpu.moe.sharded_moe import (dequantize_experts,
+                                               quantize_experts)
+    ks = jax.random.split(jax.random.PRNGKey(0), 2)
+    experts = {"w_up": jax.random.normal(ks[0], (4, 16, 32)) * 0.1,
+               "w_down": jax.random.normal(ks[1], (4, 32, 16)) * 0.1}
+    q = quantize_experts(experts)
+    assert "w_up_q" in q and "w_up" not in q
+    deq = dequantize_experts(q, jnp.float32)
+    assert set(deq) == {"w_up", "w_down"}
+    for k in experts:
+        np.testing.assert_allclose(np.asarray(deq[k]),
+                                   np.asarray(experts[k]), atol=2e-3)
+    # an unquantized (plain float) dict passes through untouched
+    assert dequantize_experts(experts, jnp.float32) is experts
+
+
+def _rand_moe_inputs(key, b=2, s=16, d=32, e=4, f=64):
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (b, s, d))
+    gate_w = jax.random.normal(ks[1], (d, e)) * 0.1
+    experts = {"w_gate": jax.random.normal(ks[2], (e, d, f)) * 0.1,
+               "w_up": jax.random.normal(ks[3], (e, d, f)) * 0.1,
+               "w_down": jax.random.normal(ks[4], (e, f, d)) * 0.1}
+    return x, gate_w, experts
+
+
+def test_moe_ffn_matches_grouped_at_zero_drop():
+    """moe_ffn with no-drop capacity (drop_tokens=False) and
+    moe_ffn_grouped both implement exact top-k routing — the capacity
+    einsum and the sort-by-expert ragged GEMM must agree."""
+    from deepspeed_tpu.moe.sharded_moe import moe_ffn_grouped
+    x, gate_w, experts = _rand_moe_inputs(jax.random.PRNGKey(7))
+    ref, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=0.0,
+                     drop_tokens=False, activation="swiglu")
+    got, _ = moe_ffn_grouped(x, gate_w, experts, k=2,
+                             activation="swiglu")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_quantized_experts_error_bound():
+    """Weight-only int8 experts through the full routed FFN: the output
+    error stays within the per-channel quantization bound."""
+    from deepspeed_tpu.moe.sharded_moe import (dequantize_experts,
+                                               quantize_experts)
+    x, gate_w, experts = _rand_moe_inputs(jax.random.PRNGKey(11))
+    ref, _ = moe_ffn(x, gate_w, experts, k=2, capacity_factor=0.0,
+                     drop_tokens=False, activation="swiglu")
+    deq = dequantize_experts(quantize_experts(experts), x.dtype)
+    got, _ = moe_ffn(x, gate_w, deq, k=2, capacity_factor=0.0,
+                     drop_tokens=False, activation="swiglu")
+    denom = float(jnp.max(jnp.abs(ref))) or 1.0
+    assert float(jnp.max(jnp.abs(got - ref))) / denom < 0.05
+
+
+def test_moe_step_contextvar():
+    """The step seed the quantized dispatch wire consumes: bound inside
+    the engine's micro_loss, uint32 zeros when unbound (eval traces)."""
+    from deepspeed_tpu.moe.dispatch import current_step, moe_step
+    s = current_step()
+    assert s.dtype == jnp.uint32 and int(s) == 0
+    with moe_step(5):
+        assert int(current_step()) == 5
+    assert int(current_step()) == 0
+
+
+def test_dispatcher_unsupported_reason():
+    from deepspeed_tpu.moe.dispatch import dispatcher_unsupported_reason
+    from deepspeed_tpu.parallel.mesh import MeshTopology, TopologyConfig
+    topo = MeshTopology(TopologyConfig())
+    assert dispatcher_unsupported_reason(topo, 4) is None
+    # ep must divide the expert count
+    n = len(jax.devices())
+    if n >= 2:
+        topo2 = MeshTopology(TopologyConfig(ep=2))
+        assert dispatcher_unsupported_reason(topo2, 3) is not None
+        assert dispatcher_unsupported_reason(topo2, 4) is None
+
+
+def test_ep_sharded_dispatch_sum_parity(devices8):
+    """The ep-sharded explicit dispatch/combine exchange must reproduce
+    the single-device capacity einsum: the reduce-scatter of per-shard
+    partial dispatch tables is a SUM, so fp32 parity is exact up to
+    reduction order; the int8 stochastic wire tracks within the
+    quantization bound."""
+    from deepspeed_tpu.moe.dispatch import EpShardedDispatcher, moe_step
+    from deepspeed_tpu.parallel.mesh import MeshTopology, TopologyConfig
+    topo = MeshTopology(TopologyConfig(fsdp=2, zps=2, ep=2))
+    x, gate_w, experts = _rand_moe_inputs(jax.random.PRNGKey(3), b=4)
+    ref, aux_ref = moe_ffn(x, gate_w, experts, k=2, capacity_factor=0.0,
+                           drop_tokens=False, activation="swiglu")
+    disp = EpShardedDispatcher.for_topology(topo)
+    assert disp.slow_axes == ("fsdp",) and disp.fast_axes == ("zps",)
+    with topo.mesh:
+        out, aux = moe_ffn(x, gate_w, experts, k=2, capacity_factor=0.0,
+                           drop_tokens=False, activation="swiglu",
+                           dispatcher=disp)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+    # int8 stochastic-rounded wire: gradients flow (straight-through),
+    # forward tracks the fp32 exchange within the quantization bound
+    disp8 = EpShardedDispatcher.for_topology(topo, wire_dtype="int8")
+
+    def loss(xx):
+        with topo.mesh:
+            o, _ = moe_ffn(xx, gate_w, experts, k=2, capacity_factor=0.0,
+                           drop_tokens=False, activation="swiglu",
+                           dispatcher=disp8)
+        return jnp.sum(o * o), o
+
+    with moe_step(3):
+        (v, o8), g = jax.value_and_grad(loss, has_aux=True)(x)
+    assert bool(jnp.all(jnp.isfinite(g)))
+    denom = float(jnp.max(jnp.abs(ref))) or 1.0
+    assert float(jnp.max(jnp.abs(o8 - ref))) / denom < 0.05
+    ref_v = float(jnp.sum(ref * ref))
+    assert abs(float(v) - ref_v) / abs(ref_v) < 1e-2
+
+
+def test_engine_int8_dispatch_wire_meshsan(devices8):
+    """Engine-backed acceptance (slow tier): int8 dispatch wire on an
+    ep x zps x fsdp mesh trains under the meshsan traffic contract in
+    raise mode, the router-telemetry gauges publish, and the loss
+    tracks the fp32-wire engine within 1e-2."""
+
+    def cfg(wire):
+        return {"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3},
+                "mesh": {"fsdp": -1, "zps": 2, "ep": 2},
+                "moe": {"wire_dtype": wire, "router_telemetry": True},
+                "telemetry": {"enabled": True,
+                              "executable_ledger": True},
+                "meshsan": {"enabled": True, "mode": "raise"},
+                "steps_per_print": 10 ** 9}
+
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 33), 0, 512)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    losses = {}
+    for wire in ("fp32", "int8"):
+        eng, _, _, _ = ds.initialize(model=Mixtral(size="tiny"),
+                                     config=cfg(wire))
+        assert eng._moe_dispatcher is not None
+        assert eng._moe_dispatcher.wire_dtype == wire
+        losses[wire] = [float(eng.train_batch(batch)) for _ in range(2)]
+        from deepspeed_tpu.telemetry.registry import get_registry
+        reg = get_registry()
+        assert reg is not None
+        snap = reg.snapshot()
+        assert "ds_moe_router_drop_fraction" in snap
+        assert "ds_moe_router_capacity" in snap
+    rel = max(abs(a - b) / abs(b)
+              for a, b in zip(losses["int8"], losses["fp32"]))
+    assert rel < 1e-2, (losses, rel)

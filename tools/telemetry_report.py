@@ -363,6 +363,23 @@ _GATES = {
         ("collective_bytes", -1, 0.02),
         ("tokens_per_sec", +1, 0.05),
     ),
+    # MoE gate (ISSUE 16, bench `moe_train` + `moe_serve` stages):
+    # training MFU on active-params accounting and its ratio against
+    # the equal-active-params dense run must not shrink; the int8
+    # dispatch-wire slow-link cut is static HLO byte arithmetic (tight
+    # threshold), its loss fidelity must not drift; fused-decode
+    # throughput, its step-up vs the equal-active-size dense engine,
+    # and the greedy-parity horizon gate the serving half.
+    "moe": (
+        ("dispatch_wire_cut_slow", +1, 0.02),
+        ("dispatch_slow_bytes", -1, 0.02),
+        ("loss_rel_err_int8_wire", -1, 0.50),
+        ("mfu_vs_dense", +1, 0.05),
+        ("moe_mfu", +1, 0.05),
+        ("moe_vs_dense", +1, 0.05),
+        ("greedy_parity_horizon", +1, 0.0),
+        ("tokens_per_sec", +1, 0.05),
+    ),
 }
 
 # metric families a gate must NOT touch even though a stem matches by
