@@ -915,6 +915,199 @@ def disagg_bench(ds, on_tpu: bool):
             "fused_k": K, "requests_per_replica": scale_req}
 
 
+def fleet_bench(ds, on_tpu: bool):
+    """Fleet health plane (ISSUE 17): kill one replica under open-loop
+    Poisson load and measure the detection -> reroute incident
+    response. Two replicas behind the health-gated router take Poisson
+    traffic; mid-window the victim replica's serving loop is killed
+    through the supported fault-injection path (``server.kill()`` — a
+    real worker death, not a monkeypatch). The stage reports:
+
+    - ``detection_ms`` — kill to the phi-accrual detector marking the
+      victim suspect/dead (heartbeat silence, no failure RPC);
+    - ``detection_to_reroute_ms`` — kill until BOTH the detector
+      tripped and the router rerouted the victim's in-flight requests
+      (the drain-and-reroute contract);
+    - ``dropped_requests`` — client-visible failures (the acceptance
+      bar is ZERO: every in-flight request completes elsewhere);
+    - multi-window ``slo_burn_rate_*`` from the time-series ring
+      (breaches per request over the fast/slow burn windows spanning
+      the incident).
+
+    Gated by ``telemetry_report --gate fleet``. Directly pre-stages
+    ROADMAP item 1's acceptance figure."""
+    import asyncio
+
+    import numpy as np
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.serving import (AsyncInferenceServer,
+                                       InferenceRouter, RouterConfig,
+                                       ServingConfig)
+
+    # the stage NEEDS the telemetry plane (detector + ring); own it for
+    # the stage when the harness did not pass --telemetry
+    owned = not telemetry.is_active()
+    if owned:
+        telemetry.configure()
+
+    if on_tpu:
+        model = Llama(hidden_size=1024, num_layers=12, num_heads=8,
+                      num_kv_heads=8, intermediate_size=2816,
+                      vocab_size=32000, max_seq_len=2048)
+        bs_kv, nb, chunk, B, K = 64, 256, 256, 16, 8
+        n_req, rate_rps, p_len, max_new = 32, 8.0, 64, 32
+        slo_ttft_ms = 500.0
+    else:
+        model = Llama(size="tiny", hidden_size=128, num_layers=3,
+                      num_heads=4, num_kv_heads=4,
+                      intermediate_size=344, vocab_size=2048,
+                      max_seq_len=512)
+        bs_kv, nb, chunk, B, K = 8, 128, 16, 8, 4
+        n_req, rate_rps, p_len, max_new = 32, 8.0, 12, 8
+        slo_ttft_ms = 50.0
+    dtype = "bfloat16" if on_tpu else "float32"
+
+    def mk(params=None):
+        return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            dtype=dtype, kv_block_size=bs_kv, num_kv_blocks=nb,
+            max_chunk_size=chunk, max_ragged_sequence_count=B,
+            fused_decode_steps=K), params=params)
+
+    e0 = mk()
+    e1 = mk(e0.params)
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    prompts = [rng.integers(0, vocab, p_len).tolist()
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, n_req))
+    # floor: the post-clear detector needs min_heartbeats intervals of
+    # in-round cadence before silence can read as suspicion
+    kill_at = max(float(arrivals[n_req // 3]), 2.5)
+    tel = telemetry  # active by construction above
+    incident = {"t_kill": None, "t_detect": None, "t_reroute": None,
+                "detect_state": None, "victim_open_at_kill": None}
+
+    def mk_router():
+        servers = [AsyncInferenceServer(e, ServingConfig(
+            k_steps=K, slo_ttft_ms=slo_ttft_ms)) for e in (e0, e1)]
+        # tighter-than-default phi thresholds: the bench WANTS an
+        # aggressive detector (it measures incident response, and a
+        # false trip would show up as health_skips + a flapping state,
+        # both reported)
+        return servers, InferenceRouter(servers, RouterConfig(
+            health={"phi_suspect": 2.0, "phi_dead": 5.0}))
+
+    async def run(servers, router, kill: bool):
+        results = {"done": 0, "dropped": 0, "tokens": 0}
+        victim = servers[0].config.replica
+        hm = tel.get_health_monitor()
+
+        async def client(i):
+            await asyncio.sleep(float(arrivals[i]))
+            try:
+                h = await router.submit(prompts[i],
+                                        max_new_tokens=max_new)
+                results["tokens"] += len(await h.tokens())
+                results["done"] += 1
+            except Exception:   # noqa: BLE001 — a drop IS the figure
+                results["dropped"] += 1
+
+        async def killer():
+            await asyncio.sleep(kill_at)
+            incident["t_kill"] = time.perf_counter()
+            victim_open = servers[0].open_requests
+            incident["victim_open_at_kill"] = victim_open
+            servers[0].kill()
+            deadline = incident["t_kill"] + 60.0
+            # detection: heartbeat silence alone must trip the
+            # detector (no failure notification is consulted)
+            while hm.state(victim) not in ("suspect", "dead") \
+                    and time.perf_counter() < deadline:
+                await asyncio.sleep(0.002)
+            if hm.state(victim) in ("suspect", "dead"):
+                incident["t_detect"] = time.perf_counter()
+                incident["detect_state"] = hm.state(victim)
+            # reroute: the victim's in-flight requests resubmitted
+            # elsewhere (drain-and-reroute); nothing to wait for if
+            # the victim happened to be empty at the kill
+            while victim_open and router.stats["reroutes"] == 0 \
+                    and time.perf_counter() < deadline:
+                await asyncio.sleep(0.002)
+            if not victim_open or router.stats["reroutes"]:
+                incident["t_reroute"] = time.perf_counter()
+
+        async with router:
+            t0 = time.perf_counter()
+            jobs = [client(i) for i in range(n_req)]
+            if kill:
+                jobs.append(killer())
+            await asyncio.gather(*jobs)
+            wall = time.perf_counter() - t0
+            return results, wall, router.metrics()
+
+    try:
+        # warm wave (compiles + detector cadence history), no kill
+        servers, router = mk_router()
+        asyncio.run(run(servers, router, kill=False))
+        rt = tel.get_request_recorder()
+        if rt is not None:
+            rt.clear()
+        ts = tel.get_timeseries()
+        if ts is not None:
+            ts.clear()
+        # fresh detector cadence for the measured round: the warm
+        # round's replicas answered to the same names, and the
+        # inter-round setup gap would poison their interval history
+        # (an inflated mean interval inflates detection latency)
+        tel.get_health_monitor().clear()
+
+        servers, router = mk_router()
+        results, wall, m = asyncio.run(run(servers, router, kill=True))
+
+        burn = {}
+        if ts is not None:
+            for win, rate in ts.multi_window_burn(
+                    "ds_serving_slo_",
+                    "ds_serving_requests_total").items():
+                burn[f"slo_burn_rate_{win}"] = round(rate, 4)
+        t_kill = incident["t_kill"]
+        detection_ms = (
+            round((incident["t_detect"] - t_kill) * 1e3, 1)
+            if incident["t_detect"] else None)
+        reroute_ms = (
+            round((max(incident["t_reroute"], incident["t_detect"])
+                   - t_kill) * 1e3, 1)
+            if incident["t_reroute"] and incident["t_detect"] else None)
+        survivors = [n for n, s in m.get("health", {}).items()
+                     if s not in ("suspect", "dead")]
+        placed = [m["replicas"][n]["placed"] for n in survivors
+                  if n in m.get("replicas", {})]
+        skew = (round(max(placed) / (sum(placed) / len(placed)), 3)
+                if placed else None)
+        return {"metric": "fleet_detection_to_reroute_ms",
+                "value": reroute_ms, "unit": "ms",
+                "detection_ms": detection_ms,
+                "detection_state": incident["detect_state"],
+                "requests": n_req, "completed": results["done"],
+                "victim_open_at_kill": incident["victim_open_at_kill"],
+                "dropped_requests": results["dropped"],
+                "zero_drops": bool(results["dropped"] == 0),
+                "reroutes": m["reroutes"],
+                "health_skips": m["health_skips"],
+                "replica_skew": skew,
+                "health_states": m.get("health", {}),
+                "tokens_per_sec": round(results["tokens"]
+                                        / max(wall, 1e-9), 1),
+                "arrival_rate_rps": rate_rps,
+                "slo_ttft_ms_target": slo_ttft_ms, **burn}
+    finally:
+        if owned:
+            telemetry.shutdown()
+
+
 def serving_bench(ds, on_tpu: bool):
     """Serving class (BASELINE configs 1-2 / FastGen): greedy batch
     decode on the Llama-340M-class model. Reports the v1 engine's
@@ -2513,6 +2706,7 @@ STAGES = [("headline", headline_bench),
           ("kvquant", kvquant_bench),
           ("serve_openloop", serve_openloop_bench),
           ("disagg", disagg_bench),
+          ("fleet", fleet_bench),
           ("moe_serving", moe_serving_bench),
           ("moe_train", moe_train_bench),
           ("moe_serve", moe_serve_bench),

@@ -199,6 +199,13 @@ class FusedServeLoop:
         # is guarded, so the telemetry-disabled loop is untouched
         self._rt = (self._tel.get_request_recorder()
                     if self._tel is not None else None)
+        # fleet health monitor (ISSUE 17): closed-loop drivers run this
+        # loop without an AsyncInferenceServer around it, so the loop
+        # itself beats the failure detector under its replica label
+        # (one dict write per step; None when the fleet plane is off)
+        self._hm = (self._tel.get_health_monitor()
+                    if self._tel is not None else None)
+        self._beat_next = 0.0   # beat rate limit (see step())
 
     # ------------------------------------------------------------------
     # request intake (single-threaded with step(); see module docstring)
@@ -284,6 +291,15 @@ class FusedServeLoop:
         this iteration; an empty list means the loop is idle (or
         waiting on admission headroom)."""
         ev: list[TokenEvent] = []
+        if self._hm is not None:
+            # rate-limited: a fast tick loop must not calibrate the
+            # detector tighter than its min interval (sub-ms beats
+            # would flush the real cadence out of the bounded window)
+            now = time.monotonic()
+            if now >= self._beat_next:
+                self._beat_next = now + max(
+                    self._hm.min_interval_s, 1e-3)
+                self._hm.heartbeat(self.replica or "replica0")
         if not self.has_work():
             return ev
         try:

@@ -245,6 +245,25 @@ class TelemetryConfig(DeepSpeedConfigModel):
     request_traces: bool = True
     # completed-trace ring capacity (requests; oldest dropped first)
     request_trace_size: int = 1024
+    # --- fleet health plane (ISSUE 17), opt-in on top of enabled -----
+    # install the time-series ring (periodic registry snapshots ->
+    # windowed rates / SLO burn), the phi-accrual health monitor, and
+    # the FleetScope aggregator; export_artifacts then also writes the
+    # versioned <prefix>.fleet.json rollup. The serving router also
+    # installs this layer when its RouterConfig.health block is on.
+    fleet: bool = False
+    # this process's replica name inside the fleet rollup
+    # ("" = proc<pid>)
+    fleet_replica: str = ""
+    # snapshot ring capacity (samples; oldest dropped first) and the
+    # minimum seconds between accepted samples (the serving loop calls
+    # maybe_sample() on its housekeeping path; the ring rate-limits)
+    timeseries_capacity: int = 512
+    timeseries_interval_s: float = 0.25
+    # multi-window burn-rate lookbacks in seconds (fast burn -> slow
+    # burn), à la SRE fast/slow-burn alerting; [] = the built-in
+    # (60, 300, 3600)
+    burn_windows_s: list[float] = Field(default_factory=list)
 
 
 class SentinelsConfig(DeepSpeedConfigModel):

@@ -79,6 +79,42 @@ class DisaggregationConfig(DeepSpeedConfigModel):
     prefill_threshold_tokens: int = Field(0, ge=0)
 
 
+class HealthConfig(DeepSpeedConfigModel):
+    """Replica health gating for the router (ISSUE 17,
+    ``deepspeed_tpu/telemetry/health.py``): serving-loop heartbeats
+    feed a phi-accrual failure detector; placement skips ``suspect`` /
+    ``dead`` replicas (``health_skips`` router counter) and sends
+    ``degraded`` replicas to the existing drain path. Only consulted
+    when telemetry is active (the detector lives in the telemetry
+    package; with telemetry off this block is inert and nothing is
+    imported). See docs/observability.md "Fleet health & burn
+    rates"."""
+    enabled: bool = True
+    # phi thresholds: suspicion is log10-scaled silence relative to the
+    # replica's own heartbeat cadence. phi >= phi_suspect excludes the
+    # replica from placement; phi >= phi_dead marks it dead (terminal
+    # under silence; only a resumed heartbeat revives it).
+    phi_suspect: float = Field(4.0, gt=0.0)
+    phi_dead: float = Field(10.0, gt=0.0)
+    # inter-heartbeat intervals kept per replica (the detector's
+    # empirical cadence window)
+    heartbeat_window: int = Field(64, ge=2)
+    # intervals required before phi reports nonzero (cold detector
+    # never suspects)
+    min_heartbeats: int = Field(3, ge=1)
+    # hysteresis: a suspect replica returns to service only once phi
+    # falls below phi_suspect * recovery_ratio (not merely below the
+    # trip point), so jittered heartbeats cannot flap the state
+    recovery_ratio: float = Field(0.5, gt=0.0, le=1.0)
+    # composite-score floor below which a live replica counts as
+    # degraded (drains instead of taking new work)
+    degraded_score: float = Field(0.35, ge=0.0, le=1.0)
+    # floor on the detector's empirical mean heartbeat interval: a
+    # burst of fast beats from a busy loop must not calibrate the
+    # detector so tight that one long engine step reads as death
+    min_interval_s: float = Field(0.05, gt=0.0)
+
+
 class RouterConfig(DeepSpeedConfigModel):
     """Prefix-affinity multi-replica router
     (``deepspeed_tpu.serving.InferenceRouter``) fronting N decode
@@ -111,3 +147,6 @@ class RouterConfig(DeepSpeedConfigModel):
     # router)
     disaggregation: DisaggregationConfig = Field(
         default_factory=DisaggregationConfig)
+    # replica health gating (ISSUE 17; effective only with telemetry
+    # active)
+    health: HealthConfig = Field(default_factory=HealthConfig)

@@ -41,6 +41,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import threading
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
@@ -54,8 +55,8 @@ _DONE = object()
 # router decision/outcome counters (metrics() schema)
 ROUTER_COUNTER_KEYS = (
     "routed_affinity", "routed_least_loaded", "backpressure_skips",
-    "drain_skips", "reroutes", "prefill_handoffs", "migrated_bytes",
-    "completed", "failed", "cancelled")
+    "drain_skips", "health_skips", "reroutes", "prefill_handoffs",
+    "migrated_bytes", "completed", "failed", "cancelled")
 
 
 class PrefillEngine:
@@ -221,6 +222,28 @@ class InferenceRouter:
         tel = _telemetry()
         self._rt = (tel.get_request_recorder() if tel is not None
                     else None)
+        # replica health gating (ISSUE 17): with telemetry active, the
+        # router installs the fleet plane (idempotent — a bench that
+        # configured it first wins) and consults the detector at every
+        # placement. With telemetry off, _hm stays None and placement
+        # is byte-for-byte the PR 13 logic.
+        self._hm = None
+        if tel is not None and config.health.enabled:
+            h = config.health
+            tel.configure_fleet(
+                phi_suspect=h.phi_suspect, phi_dead=h.phi_dead,
+                heartbeat_window=h.heartbeat_window,
+                min_heartbeats=h.min_heartbeats,
+                recovery_ratio=h.recovery_ratio,
+                degraded_score=h.degraded_score,
+                min_interval_s=h.min_interval_s,
+                free_block_floor=config.drain_free_block_watermark)
+            self._hm = tel.get_health_monitor()
+        # last placement decisions, each with the health snapshot it
+        # saw — the forensic record "why did replica2 get nothing?"
+        self.placement_log: deque = deque(maxlen=64)
+        # replicas whose worker died before/at stop(): {name: error}
+        self.replica_errors: dict[str, str] = {}
 
     # -- lifecycle -----------------------------------------------------
     async def __aenter__(self):
@@ -248,10 +271,24 @@ class InferenceRouter:
                     t.cancel()
                 await asyncio.gather(*self._tasks,
                                      return_exceptions=True)
-        for _, srv in self.replicas:
-            await srv.stop(drain=drain)
+        # stop EVERY replica even when one died mid-run (aborting at
+        # the first worker error would leak the remaining replicas'
+        # loop threads). A partial death the router already routed
+        # around is the fleet plane working as designed (ISSUE 17) —
+        # recorded in replica_errors, not raised; TOTAL fleet loss
+        # still raises.
+        errors: dict[str, Exception] = {}
+        for name, srv in self.replicas:
+            try:
+                await srv.stop(drain=drain)
+            except Exception as err:   # noqa: BLE001 — per-replica isolation
+                errors[name] = err
+                log_dist(f"InferenceRouter: replica {name} died: {err}")
         if self.prefill is not None:
             self.prefill.close()
+        self.replica_errors = {n: str(e) for n, e in errors.items()}
+        if errors and len(errors) == len(self.replicas):
+            raise next(iter(errors.values()))
 
     # -- placement -----------------------------------------------------
     def _place(self, tokens: list[int], record: bool = True):
@@ -263,9 +300,18 @@ class InferenceRouter:
         ``record=False`` on backoff re-polls keeps the skip counters
         meaning 'placement decisions', not 'poll ticks'."""
         cfg = self.config
+        health = self._hm.states() if self._hm is not None else {}
         rows, drained = [], []
         for name, srv in self.replicas:
             if not srv.accepting:
+                continue
+            hstate = health.get(name, "healthy")
+            if hstate in ("suspect", "dead"):
+                # the detector suspects this loop is gone: never a
+                # candidate, not even as last resort — placing onto a
+                # dead replica converts backpressure into drops
+                if record:
+                    self.stats["health_skips"] += 1
                 continue
             open_ = srv.open_requests
             if cfg.max_open_per_replica \
@@ -274,6 +320,13 @@ class InferenceRouter:
                     self.stats["backpressure_skips"] += 1
                 continue
             row = (name, srv, srv.prefix_affinity(tokens), open_)
+            if hstate == "degraded":
+                # alive but unwell (score under the floor): existing
+                # drain semantics — finish residents, last resort only
+                if record:
+                    self.stats["drain_skips"] += 1
+                drained.append(row)
+                continue
             if cfg.drain_free_block_watermark \
                     and srv.free_blocks < cfg.drain_free_block_watermark:
                 # pool nearly exhausted: let it drain — route new work
@@ -286,13 +339,18 @@ class InferenceRouter:
         if not rows:
             rows = drained
         if not rows:
-            return [], "none"
-        best_aff = max(r[2] for r in rows)
-        if best_aff >= cfg.min_affinity_blocks:
+            cands, rule = [], "none"
+        elif max(r[2] for r in rows) >= cfg.min_affinity_blocks:
             rows.sort(key=lambda r: (-r[2], r[3], r[0]))
-            return [(n, s) for n, s, _, _ in rows], "affinity"
-        rows.sort(key=lambda r: (r[3], r[0]))
-        return [(n, s) for n, s, _, _ in rows], "least_loaded"
+            cands, rule = [(n, s) for n, s, _, _ in rows], "affinity"
+        else:
+            rows.sort(key=lambda r: (r[3], r[0]))
+            cands, rule = [(n, s) for n, s, _, _ in rows], "least_loaded"
+        if record and self._hm is not None:
+            self.placement_log.append({
+                "rule": rule, "candidates": [n for n, _ in cands],
+                "health": health})
+        return cands, rule
 
     # -- request intake ------------------------------------------------
     async def submit(self, prompt: Sequence[int], *,
@@ -496,6 +554,9 @@ class InferenceRouter:
             }
         if self.prefill is not None:
             out["prefill"] = self.prefill.metrics()
+        if self._hm is not None:
+            out["health"] = self._hm.states()
+            out["placement_log"] = list(self.placement_log)[-8:]
         return out
 
 

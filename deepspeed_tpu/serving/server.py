@@ -44,6 +44,16 @@ from .config import ServingConfig
 _DONE = object()
 
 
+def _slo_seconds(cfg: ServingConfig):
+    """``ServingConfig`` SLO targets (milliseconds, the user-facing
+    unit) -> ``RequestTraceRecorder.set_slo`` arguments (seconds, the
+    recorder's unit). THE one place the ms->s conversion happens —
+    unit-boundary regression test in tests/test_fleet.py. 0 disables a
+    target (maps to None)."""
+    return (cfg.slo_ttft_ms / 1e3 if cfg.slo_ttft_ms else None,
+            cfg.slo_itl_ms / 1e3 if cfg.slo_itl_ms else None)
+
+
 class RequestCancelled(Exception):
     """Raised by the stream iterator of a cancelled request."""
 
@@ -143,6 +153,8 @@ class AsyncInferenceServer:
         self._rt = None         # request-trace recorder (ISSUE 10)
         self._hb_meta: dict = {}    # cached heartbeat summary
         self._hb_next = 0.0         # next full-summary refresh time
+        self._health_next = 0.0     # next health quality-input refresh
+        self._beat_next = 0.0       # next liveness heartbeat forward
 
     # ------------------------------------------------------------------
     async def __aenter__(self):
@@ -168,9 +180,7 @@ class AsyncInferenceServer:
                     else None)
         if self._rt is not None:
             # SLO burn counters measure against this server's targets
-            self._rt.set_slo(
-                cfg.slo_ttft_ms / 1e3 if cfg.slo_ttft_ms else None,
-                cfg.slo_itl_ms / 1e3 if cfg.slo_itl_ms else None)
+            self._rt.set_slo(*_slo_seconds(cfg))
         # GIL-atomic bool flags shared with the worker: _accepting is
         # flipped off by a dying worker (the losing race costs one
         # submit that then hits the _worker_error check), _stopping is
@@ -285,6 +295,16 @@ class AsyncInferenceServer:
         h = await self.submit(prompt, **kw)
         return await h.tokens()
 
+    def kill(self) -> None:
+        """Fault injection (ISSUE 17): make the worker thread die at
+        its next mailbox drain, exactly as an engine fault would — the
+        death path fails every open handle with ``RequestFailed``
+        ("serving loop died"), closes their traces, and flips
+        ``accepting`` off, so the router's drain-and-reroute (and the
+        health detector's silence->suspect->dead arc) is exercised for
+        real. The fleet bench and the kill-reroute tests drive this."""
+        self._post(("die",))
+
     def metrics(self) -> dict:
         """Engine serving counters merged with the scheduler's
         (preemptions/restores/cancellations/admitted/chain_drains/
@@ -368,6 +388,11 @@ class AsyncInferenceServer:
                 if stop and not s.has_work():
                     break
                 if not s.has_work():
+                    tel = _telemetry()
+                    if tel is not None:
+                        # the idle loop is ALIVE: without this beat an
+                        # idle replica's silence would read as death
+                        self._beat(tel)
                     self._wake.wait(timeout=0.1)
                     self._wake.clear()
                     continue
@@ -420,6 +445,8 @@ class AsyncInferenceServer:
                 s.cancel(m[1])
             elif m[0] == "stop":
                 stop = self._stopping = True
+            elif m[0] == "die":
+                raise RuntimeError("fault injection: replica killed")
         return stop
 
     def _observe(self, s: FusedServeLoop) -> None:
@@ -446,6 +473,10 @@ class AsyncInferenceServer:
                     self._hb_next = now + 0.25
                 meta = {**self._hb_meta,
                         "inflight": self._rt.inflight_count()}
+            if cfg_replica := self.config.replica:
+                # fleet runs (ISSUE 17): the hang dump's progress ring
+                # then names WHICH replica's loop stalled
+                meta["replica"] = cfg_replica
             fr.progress("serving_loop", **meta)
         reg = tel.get_registry()
         if reg is None:
@@ -457,3 +488,54 @@ class AsyncInferenceServer:
         reg.gauge("ds_serving_open_requests",
                   "requests open on the async server "
                   "(queued + running)").set(self._open, engine="v2")
+        self._beat(tel)
+
+    def _beat(self, tel) -> None:
+        """Fleet-health heartbeat (ISSUE 17): liveness of THIS loop
+        thread, sent from the busy and idle paths alike — deliberately
+        a SEPARATE channel from ``fr.progress()``, which means "work
+        advanced" and stays silent while idle (the hang watchdog's
+        contract). At a ~4 Hz cadence it also samples the time-series
+        ring and feeds the composite-score inputs (queue saturation,
+        KV headroom, windowed SLO burn, sanitizer violations, stall
+        age) to the monitor."""
+        hm = tel.get_health_monitor()
+        if hm is None:
+            return
+        name = self.config.replica or "replica0"
+        now = time.monotonic()
+        # rate-limit the forwarded beats: a busy tick loop calls
+        # _beat per tick, and a burst of sub-ms beats would both
+        # shrink the detector's empirical mean and flush the real
+        # cadence out of its bounded window
+        if now >= self._beat_next:
+            self._beat_next = now + max(hm.min_interval_s, 1e-3)
+            hm.heartbeat(name)
+        if now < self._health_next:
+            return
+        self._health_next = now + 0.25
+        reg = tel.get_registry()
+        ts = tel.get_timeseries()
+        burn = viol = None
+        if ts is not None:
+            ts.maybe_sample(reg)
+            # both breach counters under one stem; fastest window =
+            # the detector's reaction signal
+            burn = ts.burn_rate("ds_serving_slo_",
+                                "ds_serving_requests_total",
+                                tel.burn_windows()[0])
+            latest = ts.latest()
+            if latest is not None:
+                viol = int(sum(
+                    v for k, v in latest[1].items()
+                    if "ds_blocksan_violations" in k
+                    or "ds_meshsan_violations" in k))
+        fr = tel.get_flight_recorder()
+        cfg = self.config
+        hm.observe(
+            name,
+            queue_frac=(self._open / cfg.max_queue
+                        if cfg.max_queue else None),
+            free_blocks=self.engine.free_blocks,
+            slo_burn=burn, violations=viol,
+            stalled_s=fr.stalled_for() if fr is not None else None)

@@ -34,20 +34,26 @@ import os
 from typing import Optional
 
 from . import (bridges, collectives, flightrec as _flightrec_mod,  # noqa: F401
+               fleet as _fleet_mod, health as _health_mod,
                ledger as _ledger_mod, registry as _registry_mod,
-               reqtrace as _reqtrace_mod, spans as _spans_mod)
+               reqtrace as _reqtrace_mod, spans as _spans_mod,
+               timeseries as _timeseries_mod)
+from .fleet import FleetScope, get_fleet  # noqa: F401
 from .flightrec import (FlightRecorder, HangWatchdog,  # noqa: F401
                         get_flight_recorder, get_watchdog)
 flightrec = _flightrec_mod   # public alias for instrumented call sites
+from .health import HealthMonitor, get_health_monitor  # noqa: F401
 from .ledger import ExecutableLedger, get_ledger  # noqa: F401
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricsRegistry, get_registry)
 from .reqtrace import (RequestTraceRecorder,  # noqa: F401
                        get_request_recorder)
 from .spans import NULL_CONTEXT, SpanTracer, get_tracer  # noqa: F401
+from .timeseries import TimeSeriesRing, get_timeseries  # noqa: F401
 
 _ACTIVE = False
 _ARTIFACT_DIR = "telemetry_hangdump"
+_BURN_WINDOWS_S = _timeseries_mod.DEFAULT_BURN_WINDOWS_S
 
 
 def is_active() -> bool:
@@ -66,7 +72,12 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
               watchdog_artifact_dir: Optional[str] = None,
               watchdog_abort: Optional[bool] = None,
               request_traces: Optional[bool] = None,
-              request_trace_size: Optional[int] = None) -> None:
+              request_trace_size: Optional[int] = None,
+              fleet: Optional[bool] = None,
+              fleet_replica: Optional[str] = None,
+              timeseries_capacity: Optional[int] = None,
+              timeseries_interval_s: Optional[float] = None,
+              burn_windows_s=None) -> None:
     """Activate telemetry for this process. ``config`` may be the
     engine's ``TelemetryConfig`` block; keyword overrides win.
     Idempotent: re-configuring while active keeps the existing
@@ -125,14 +136,65 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
     if compile_events:
         bridges.install_jax_compile_listener()
     _ACTIVE = True
+    # fleet health plane (ISSUE 17): opt-in like the device-truth layer
+    if pick(fleet, "fleet", False):
+        configure_fleet(
+            replica=pick(fleet_replica, "fleet_replica", ""),
+            timeseries_capacity=pick(timeseries_capacity,
+                                     "timeseries_capacity", 512),
+            timeseries_interval_s=pick(timeseries_interval_s,
+                                       "timeseries_interval_s", 0.25),
+            burn_windows_s=pick(burn_windows_s, "burn_windows_s", None))
+
+
+def configure_fleet(*, replica: str = "",
+                    timeseries_capacity: int = 512,
+                    timeseries_interval_s: float = 0.25,
+                    burn_windows_s=None, **health_kw) -> None:
+    """Install the fleet health plane (ISSUE 17): the time-series ring,
+    the health monitor, and a :class:`FleetScope` with this process's
+    registry registered as the local replica. Idempotent (a second
+    caller — router after bench, say — keeps the existing components;
+    its kwargs are ignored). Requires an active ``configure()`` —
+    no-ops otherwise so disabled runs stay allocation-free.
+
+    ``health_kw`` passes through to :class:`HealthMonitor`
+    (``phi_suspect``, ``phi_dead``, ``heartbeat_window``, ...), which is
+    how the router's ``RouterConfig.health`` block lands here."""
+    if not _ACTIVE:
+        return
+    if _timeseries_mod.get_timeseries() is None:
+        _timeseries_mod.set_timeseries(TimeSeriesRing(
+            capacity=timeseries_capacity,
+            interval_s=timeseries_interval_s))
+    if burn_windows_s:
+        global _BURN_WINDOWS_S
+        _BURN_WINDOWS_S = tuple(float(w) for w in burn_windows_s)
+    if _health_mod.get_health_monitor() is None:
+        _health_mod.set_health_monitor(HealthMonitor(**health_kw))
+    if _fleet_mod.get_fleet() is None:
+        scope = FleetScope()
+        reg = get_registry()
+        if reg is not None:
+            scope.add_replica(replica or f"proc{os.getpid()}", reg)
+        _fleet_mod.set_fleet(scope)
+
+
+def burn_windows() -> tuple:
+    """The configured multi-window burn lookbacks (seconds)."""
+    return _BURN_WINDOWS_S
 
 
 def shutdown() -> None:
     """Deactivate and drop all telemetry state. The jax.monitoring
     listener stays registered (jax has no per-listener removal) but
     no-ops once the registry is gone."""
-    global _ACTIVE
+    global _ACTIVE, _BURN_WINDOWS_S
     _ACTIVE = False
+    _fleet_mod.set_fleet(None)
+    _health_mod.set_health_monitor(None)
+    _timeseries_mod.set_timeseries(None)
+    _BURN_WINDOWS_S = _timeseries_mod.DEFAULT_BURN_WINDOWS_S
     _flightrec_mod.set_watchdog(None)
     _flightrec_mod.set_flight_recorder(None)
     _ledger_mod.set_ledger(None)
@@ -159,6 +221,12 @@ def clear() -> None:
     rt = get_request_recorder()
     if rt is not None:
         rt.clear()
+    ts = get_timeseries()
+    if ts is not None:
+        ts.clear()
+    hm = get_health_monitor()
+    if hm is not None:
+        hm.clear()
 
 
 def span(name: str, **tags):
@@ -200,6 +268,9 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
     rt = get_request_recorder()
     if rt is not None:
         rt.collect(reg)     # component p50/p99 gauges
+    hm = get_health_monitor()
+    if hm is not None:
+        hm.collect(reg)     # ds_fleet_replica_{phi,score,state} gauges
     out = {}
     # per-request async tracks (ISSUE 10) ride the same Chrome-trace
     # document as the host spans — one named tid per request — so
@@ -232,6 +303,13 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
         with open(path, "w") as f:
             _json.dump(led.snapshot(), f, indent=1, default=str)
         out["ledger"] = path
+    scope = get_fleet()
+    if scope is not None:
+        # versioned fleet rollup (ISSUE 17); embeds the health snapshot
+        # so telemetry_report --fleet renders from this file alone
+        out["fleet"] = scope.write(
+            os.path.join(out_dir, f"{prefix}.fleet.json"),
+            health=hm.snapshot() if hm is not None else None)
     return out
 
 

@@ -1,0 +1,234 @@
+"""Fleet-level metrics aggregation (ISSUE 17 tentpole part 3).
+
+Every telemetry surface so far stops at one process; the elasticity
+and SLO-controller work (ROADMAP items 1 and 2) needs fleet rollups
+and per-replica views. :class:`FleetScope` merges per-replica registry
+snapshots from BOTH membership kinds:
+
+- **in-process replicas** — a live :class:`~.registry.MetricsRegistry`
+  (or any zero-arg callable returning a ``snapshot()``-shaped dict:
+  a router can register a per-replica metrics closure), snapshotted at
+  merge time;
+- **cross-process replicas** — ``*.metrics.json`` snapshot files other
+  processes exported (``telemetry.export_artifacts``), loaded from
+  disk at merge time, so a multi-host serving fleet aggregates through
+  a shared artifact directory with no RPC plane.
+
+Merge semantics are exact where exactness is meaningful:
+
+- **counters** sum across replicas per label set — the fleet total of
+  a monotonic counter IS the sum of the per-replica totals
+  (property-tested in tests/test_fleet.py);
+- **histograms** merge bucket-by-bucket (counts, sum, count add;
+  mean recomputed), valid because every replica shares the registry's
+  bucket layout for a given metric name;
+- **gauges** are NOT summed into one number blindly — a point-in-time
+  value aggregates as ``{sum, min, max, mean, n}`` so both "total free
+  blocks fleet-wide" (sum) and "worst replica" (min) stay readable.
+
+``write()`` emits the versioned ``fleet.json`` artifact
+(``schema_version`` + a per-instance monotonic ``version`` bumped on
+every write) carrying the fleet rollup, the per-replica flat views,
+and the health snapshot — everything ``tools/telemetry_report.py
+--fleet`` needs to render per-replica + fleet tables with no other
+file. Host-only, stdlib-only, zero-import when telemetry is disabled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional, Union
+
+from .timeseries import flatten_snapshot
+
+FLEET_SCHEMA_VERSION = 1
+
+
+def merge_snapshots(snaps: dict[str, dict]) -> dict:
+    """{replica: registry-snapshot} -> one merged snapshot (same
+    shape), with gauges widened to aggregate dicts (see module
+    docstring)."""
+    merged: dict = {}
+    for _replica, snap in sorted(snaps.items()):
+        for name, meta in snap.items():
+            slot = merged.setdefault(
+                name, {"type": meta.get("type", "untyped"),
+                       "help": meta.get("help", ""), "values": []})
+            for entry in meta.get("values", []):
+                _merge_entry(slot, meta.get("type"), entry)
+    # finalize gauge aggregates + histogram means
+    for meta in merged.values():
+        for entry in meta["values"]:
+            agg = entry.pop("_agg", None)
+            if agg is not None:
+                entry["value"] = agg["sum"]
+                entry["aggregate"] = {
+                    "sum": agg["sum"], "min": agg["min"],
+                    "max": agg["max"],
+                    "mean": agg["sum"] / max(agg["n"], 1),
+                    "n": agg["n"]}
+            if "count" in entry:
+                entry["mean"] = (entry["sum"] / entry["count"]
+                                 if entry.get("count") else 0.0)
+    return merged
+
+
+def _merge_entry(slot: dict, kind: Optional[str], entry: dict) -> None:
+    labels = entry.get("labels") or {}
+    key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    row = next((e for e in slot["values"]
+                if tuple(sorted((str(k), str(v)) for k, v in
+                                (e.get("labels") or {}).items())) == key),
+               None)
+    if kind == "histogram":
+        if row is None:
+            row = {"labels": dict(labels), "count": 0, "sum": 0.0,
+                   "buckets": {}}
+            slot["values"].append(row)
+        row["count"] += int(entry.get("count", 0))
+        row["sum"] += float(entry.get("sum", 0.0))
+        for le, cum in (entry.get("buckets") or {}).items():
+            row["buckets"][str(le)] = (row["buckets"].get(str(le), 0)
+                                       + int(cum))
+        return
+    value = float(entry.get("value", 0.0))
+    if kind == "gauge":
+        if row is None:
+            row = {"labels": dict(labels),
+                   "_agg": {"sum": 0.0, "min": value, "max": value,
+                            "n": 0}}
+            slot["values"].append(row)
+        agg = row.setdefault("_agg", {"sum": 0.0, "min": value,
+                                      "max": value, "n": 0})
+        agg["sum"] += value
+        agg["min"] = min(agg["min"], value)
+        agg["max"] = max(agg["max"], value)
+        agg["n"] += 1
+        return
+    # counters (and untyped scalars): exact sum per label set
+    if row is None:
+        row = {"labels": dict(labels), "value": 0.0}
+        slot["values"].append(row)
+    row["value"] += value
+
+
+class FleetScope:
+    """See module docstring. Register members, then ``merge()`` /
+    ``write()`` at flush boundaries (never per token)."""
+
+    def __init__(self, fleet_id: str = "fleet0"):
+        self.fleet_id = str(fleet_id)
+        self._members: dict[str, Union[Callable[[], dict], str]] = {}
+        self._version = 0
+        self._lock = threading.Lock()
+
+    # -- membership ----------------------------------------------------
+    def add_replica(self, name: str, source) -> None:
+        """Register an in-process member: a ``MetricsRegistry``, or any
+        zero-arg callable returning a snapshot-shaped dict. Re-adding
+        a name replaces its source (a restarted replica re-registers)."""
+        snap = getattr(source, "snapshot", None)
+        fn = snap if callable(snap) else source
+        if not callable(fn):
+            raise TypeError(
+                f"add_replica({name!r}): need a registry or callable, "
+                f"got {type(source).__name__}")
+        with self._lock:
+            self._members[str(name)] = fn
+
+    def add_snapshot_file(self, path: str,
+                          name: Optional[str] = None) -> str:
+        """Register a cross-process member backed by a
+        ``*.metrics.json`` snapshot file (re-read at every merge, so a
+        periodically re-exported file tracks the remote process).
+        Returns the member name (default: the file stem)."""
+        if name is None:
+            name = os.path.basename(path)
+            for suffix in (".metrics.json", ".json"):
+                if name.endswith(suffix):
+                    name = name[:-len(suffix)]
+                    break
+        with self._lock:
+            self._members[str(name)] = str(path)
+        return str(name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._members.pop(str(name), None)
+
+    def members(self) -> list[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    # -- aggregation ---------------------------------------------------
+    def _collect(self) -> tuple[dict[str, dict], dict[str, str]]:
+        """{replica: snapshot} from every member; unreadable members
+        land in the errors map instead of failing the merge (one dead
+        replica's missing file must not blind the fleet view)."""
+        with self._lock:
+            members = dict(self._members)
+        snaps: dict[str, dict] = {}
+        errors: dict[str, str] = {}
+        for name, src in members.items():
+            try:
+                if callable(src):
+                    snaps[name] = src()
+                else:
+                    with open(src) as f:
+                        snaps[name] = json.load(f)
+            except Exception as e:   # noqa: BLE001 — per-member isolation
+                errors[name] = f"{type(e).__name__}: {e}"
+        return snaps, errors
+
+    def merge(self) -> dict:
+        """Fleet rollup document (not yet written to disk):
+        ``{fleet_id, replicas: {name: flat view}, fleet: merged
+        snapshot, fleet_flat, errors}``."""
+        snaps, errors = self._collect()
+        merged = merge_snapshots(snaps)
+        return {"fleet_id": self.fleet_id,
+                "replicas": {n: flatten_snapshot(s)
+                             for n, s in sorted(snaps.items())},
+                "fleet": merged,
+                "fleet_flat": flatten_snapshot(merged),
+                "errors": errors}
+
+    def write(self, path: str, health: Optional[dict] = None) -> str:
+        """Write the versioned ``fleet.json`` artifact and return its
+        path. ``health`` embeds a
+        :meth:`~.health.HealthMonitor.snapshot` so the artifact alone
+        renders the fleet view."""
+        doc = self.merge()
+        with self._lock:
+            self._version += 1
+            version = self._version
+        doc.update({"schema_version": FLEET_SCHEMA_VERSION,
+                    "version": version,
+                    "generated_unix_s": round(time.time(), 3)})
+        if health is not None:
+            doc["health"] = health
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, default=str)
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._members.clear()
+
+
+# --- module-level current scope (wired by telemetry.configure) -----------
+
+_FLEET: Optional[FleetScope] = None
+
+
+def get_fleet() -> Optional[FleetScope]:
+    return _FLEET
+
+
+def set_fleet(scope: Optional[FleetScope]) -> None:
+    global _FLEET
+    _FLEET = scope

@@ -219,6 +219,18 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
     except Exception as e:   # noqa: BLE001
         doc["meshsan_error"] = repr(e)
     try:
+        # fleet health (ISSUE 17): when the failure detector is
+        # active, the dump says what the health plane believed about
+        # every replica at the moment of the hang — phi, score, state,
+        # heartbeat ages — so "watchdog fired" and "detector saw it"
+        # can be correlated from the artifact alone
+        from . import health as _health
+        hm = _health.get_health_monitor()
+        if hm is not None:
+            doc["fleet_health"] = hm.snapshot()
+    except Exception as e:   # noqa: BLE001
+        doc["fleet_health_error"] = repr(e)
+    try:
         with open("/proc/self/status") as f:
             doc["host_memory"] = {
                 k: v.strip() for k, v in

@@ -1,0 +1,341 @@
+"""Replica failure detection + composite health scoring (ISSUE 17
+tentpole part 2).
+
+Two signals, one state machine per replica:
+
+- **Liveness** — a phi-accrual-style failure detector (Hayashibara et
+  al.; the Akka/Cassandra lineage) over the serving loop's heartbeats.
+  Each replica's recent inter-heartbeat intervals form an empirical
+  distribution; ``phi`` is the log-scaled suspicion that the CURRENT
+  silence is not explained by that distribution (``phi = log10(e) *
+  silence / mean_interval`` under the exponential model — monotonic in
+  silence, self-calibrating to each replica's own cadence, so a slow
+  replica is not a suspect replica). Two robustness guards: the mean
+  is floored at ``min_interval_s`` (a burst of fast beats must not
+  over-tighten the calibration), and phi reports 0 until the silence
+  exceeds the LONGEST interval in the window (a pause the replica
+  already survived once is not evidence). Heartbeats are a SEPARATE
+  channel
+  from the flight recorder's ``progress()``: progress means "work
+  advanced" (the hang watchdog's signal, silent while idle by design),
+  heartbeats mean "the loop thread is alive" (sent while idle too).
+
+- **Quality** — a composite score in [0, 1] from the signals the
+  serving stack already produces: queue saturation, KV free-block
+  headroom, windowed SLO burn rate (from
+  :mod:`.timeseries`), blocksan/meshsan violation counters, and
+  hang-watchdog stall age. The score is the MINIMUM of the available
+  sub-scores (weakest link): a replica with one exhausted resource is
+  degraded no matter how healthy the rest looks.
+
+States: ``healthy -> degraded -> suspect -> dead``. Liveness owns the
+suspect/dead arc (phi thresholds), quality owns degraded. Hysteresis:
+leaving ``suspect`` requires phi to fall BELOW
+``phi_suspect * recovery_ratio`` (not merely below the trip point), so
+jittered heartbeats straddling the threshold cannot flap the state;
+``dead`` is terminal under silence — only an explicit recovery
+heartbeat (the replica's loop demonstrably running again) re-admits
+it, resetting its interval history so stale pre-death cadence does not
+poison the revived detector.
+
+The router consumes ``state()`` at placement (suspect/dead excluded,
+degraded drains); the hang-watchdog dump embeds ``snapshot()`` as its
+``fleet_health`` section; ``collect()`` exports ``ds_fleet_*`` gauges.
+Host-only, stdlib-only, zero-import when telemetry is disabled;
+``clock`` injection keeps every transition fake-clock testable.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+HEALTH_STATES = ("healthy", "degraded", "suspect", "dead")
+_STATE_RANK = {s: i for i, s in enumerate(HEALTH_STATES)}
+_LOG10_E = math.log10(math.e)
+
+
+class _Replica:
+    __slots__ = ("name", "last_beat", "intervals", "state",
+                 "transitions", "inputs", "beats", "deaths")
+
+    def __init__(self, name: str, window: int):
+        self.name = name
+        self.last_beat: Optional[float] = None
+        self.intervals: deque[float] = deque(maxlen=window)
+        self.state = "healthy"
+        self.transitions = 0
+        self.inputs: dict = {}
+        self.beats = 0
+        self.deaths = 0
+
+
+class HealthMonitor:
+    """See module docstring. One instance per process, shared across
+    replicas; all methods are host-only and O(window) worst case."""
+
+    def __init__(self, *, phi_suspect: float = 4.0,
+                 phi_dead: float = 10.0, heartbeat_window: int = 64,
+                 min_heartbeats: int = 3, recovery_ratio: float = 0.5,
+                 degraded_score: float = 0.35,
+                 free_block_floor: int = 0,
+                 stall_deadline_s: float = 5.0,
+                 burn_degraded: float = 0.5,
+                 min_interval_s: float = 0.05,
+                 clock: Callable[[], float] = time.monotonic):
+        if not 0.0 < recovery_ratio <= 1.0:
+            raise ValueError(
+                f"recovery_ratio must be in (0, 1]: {recovery_ratio}")
+        if phi_dead < phi_suspect:
+            raise ValueError(
+                f"phi_dead {phi_dead} < phi_suspect {phi_suspect}")
+        self.phi_suspect = float(phi_suspect)
+        self.phi_dead = float(phi_dead)
+        self.heartbeat_window = max(int(heartbeat_window), 2)
+        self.min_heartbeats = max(int(min_heartbeats), 1)
+        self.recovery_ratio = float(recovery_ratio)
+        self.degraded_score = float(degraded_score)
+        self.free_block_floor = int(free_block_floor)
+        self.stall_deadline_s = float(stall_deadline_s)
+        self.burn_degraded = max(float(burn_degraded), 1e-9)
+        # floor on the empirical mean interval: a burst of sub-ms
+        # beats from a busy loop must not calibrate the detector so
+        # tight that one long engine step reads as infinite silence
+        # (Akka's analogous knob is the min std deviation)
+        self.min_interval_s = max(float(min_interval_s), 0.0)
+        self._clock = clock
+        self._replicas: dict[str, _Replica] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str) -> _Replica:
+        r = self._replicas.get(name)
+        if r is None:
+            r = self._replicas[name] = _Replica(
+                str(name), self.heartbeat_window)
+        return r
+
+    # -- liveness ------------------------------------------------------
+    def heartbeat(self, name: str, now: Optional[float] = None) -> None:
+        """One liveness beat from ``name``'s loop thread. A beat from a
+        DEAD replica is the explicit recovery signal: state returns to
+        healthy and the interval history resets (post-restart cadence
+        starts clean)."""
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            r = self._get(name)
+            r.beats += 1
+            if r.state == "dead":
+                r.intervals.clear()
+                r.last_beat = None
+                self._transition(r, "healthy")
+            if r.last_beat is not None:
+                gap = max(t - r.last_beat, 0.0)
+                if self._phi_locked(r, t) >= self.phi_dead:
+                    # a gap the detector would have called death is a
+                    # REJOIN, not a sample: fold it into the window
+                    # and one stale epoch poisons the mean (and the
+                    # max-interval guard) for the whole next epoch
+                    r.intervals.clear()
+                else:
+                    r.intervals.append(gap)
+            r.last_beat = t
+
+    def _phi_locked(self, r: _Replica, t: float) -> float:
+        # caller holds self._lock
+        if r.last_beat is None \
+                or len(r.intervals) < self.min_heartbeats:
+            return 0.0
+        silence = max(t - r.last_beat, 0.0)
+        # a pause no longer than one the replica already survived is
+        # not evidence: without this guard one slow engine step (long
+        # tick, GC pause) reads as suspicion whenever the window mean
+        # sits well below the window max
+        if silence <= max(r.intervals):
+            return 0.0
+        mean = sum(r.intervals) / len(r.intervals)
+        return _LOG10_E * silence / max(mean, self.min_interval_s,
+                                        1e-9)
+
+    def phi(self, name: str, now: Optional[float] = None) -> float:
+        """Suspicion level for ``name``: 0 while the detector has too
+        little history, else log10-scaled and MONOTONIC in silence."""
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return 0.0
+            return self._phi_locked(r, t)
+
+    # -- quality -------------------------------------------------------
+    def observe(self, name: str, *, queue_frac: Optional[float] = None,
+                free_blocks: Optional[int] = None,
+                slo_burn: Optional[float] = None,
+                violations: Optional[int] = None,
+                stalled_s: Optional[float] = None) -> None:
+        """Composite-score inputs (any subset; absent = no signal).
+        ``queue_frac`` is open/capacity in [0, 1]; ``free_blocks``
+        scores against ``free_block_floor`` (0 disables); ``slo_burn``
+        is a windowed breach fraction (breaches/request) scored
+        against ``burn_degraded``; any nonzero sanitizer ``violations``
+        zeroes the score (a correctness finding, not a perf number);
+        ``stalled_s`` scores against ``stall_deadline_s``."""
+        with self._lock:
+            r = self._get(name)
+            for key, val in (("queue_frac", queue_frac),
+                             ("free_blocks", free_blocks),
+                             ("slo_burn", slo_burn),
+                             ("violations", violations),
+                             ("stalled_s", stalled_s)):
+                if val is not None:
+                    r.inputs[key] = val
+
+    def score(self, name: str) -> float:
+        """Composite quality score in [0, 1] (1 = no adverse signal);
+        the minimum over the sub-scores of the inputs observed so
+        far."""
+        with self._lock:
+            r = self._replicas.get(name)
+            inputs = dict(r.inputs) if r is not None else {}
+        subs = [1.0]
+        if "queue_frac" in inputs:
+            subs.append(1.0 - min(max(float(inputs["queue_frac"]),
+                                      0.0), 1.0))
+        if "free_blocks" in inputs and self.free_block_floor > 0:
+            subs.append(min(max(float(inputs["free_blocks"]), 0.0)
+                            / self.free_block_floor, 1.0))
+        if "slo_burn" in inputs:
+            subs.append(1.0 - min(max(float(inputs["slo_burn"]), 0.0)
+                                  / self.burn_degraded, 1.0))
+        if "violations" in inputs:
+            subs.append(0.0 if inputs["violations"] else 1.0)
+        if "stalled_s" in inputs and self.stall_deadline_s > 0:
+            subs.append(1.0 - min(max(float(inputs["stalled_s"]), 0.0)
+                                  / self.stall_deadline_s, 1.0))
+        return min(subs)
+
+    # -- state machine -------------------------------------------------
+    def _transition(self, r: _Replica, state: str) -> None:
+        if state != r.state:
+            if state == "dead":
+                r.deaths += 1
+            r.state = state
+            r.transitions += 1
+
+    def state(self, name: str, now: Optional[float] = None) -> str:
+        """Evaluate and return ``name``'s current health state.
+        Unknown replicas are healthy (no signal is not a finding)."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return "healthy"
+        p = self.phi(name, now=now)
+        with self._lock:
+            r = self._get(name)
+            if r.state == "dead":
+                return "dead"       # only heartbeat() revives
+            if p >= self.phi_dead:
+                self._transition(r, "dead")
+                return "dead"
+            if p >= self.phi_suspect:
+                self._transition(r, "suspect")
+                return "suspect"
+            if r.state == "suspect" \
+                    and p > self.phi_suspect * self.recovery_ratio:
+                # hysteresis: keep suspecting until phi clearly drops
+                return "suspect"
+        # score() takes the lock itself; compute outside it
+        sc = self.score(name)
+        with self._lock:
+            r = self._get(name)
+            if r.state == "dead":
+                return "dead"
+            self._transition(
+                r, "degraded" if sc < self.degraded_score else "healthy")
+            return r.state
+
+    def states(self, now: Optional[float] = None) -> dict[str, str]:
+        """{replica: state} over every replica seen so far — the
+        health snapshot a placement decision records."""
+        with self._lock:
+            names = list(self._replicas)
+        return {n: self.state(n, now=now) for n in names}
+
+    def transitions(self, name: str) -> int:
+        with self._lock:
+            r = self._replicas.get(name)
+            return r.transitions if r is not None else 0
+
+    # -- export --------------------------------------------------------
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """Per-replica detector view for the hang dump's
+        ``fleet_health`` section and the fleet.json artifact."""
+        t = self._clock() if now is None else float(now)
+        out = {}
+        with self._lock:
+            names = list(self._replicas)
+        for n in names:
+            state = self.state(n, now=t)
+            with self._lock:
+                r = self._replicas[n]
+                row = {"state": state,
+                       "phi": round(self._phi_locked(r, t), 4),
+                       "score": None,
+                       "heartbeats": r.beats,
+                       "transitions": r.transitions,
+                       "deaths": r.deaths,
+                       "last_heartbeat_age_s": (
+                           round(t - r.last_beat, 4)
+                           if r.last_beat is not None else None),
+                       "mean_interval_s": (
+                           round(sum(r.intervals) / len(r.intervals), 5)
+                           if r.intervals else None),
+                       "inputs": dict(r.inputs)}
+            row["score"] = round(self.score(n), 4)
+            out[n] = row
+        return out
+
+    def collect(self, reg) -> None:
+        """Export ``ds_fleet_*`` gauges (per-replica phi, score, state
+        rank, heartbeat age) — flush-boundary only."""
+        if reg is None:
+            return
+        snap = self.snapshot()
+        phi_g = reg.gauge("ds_fleet_replica_phi",
+                          "phi-accrual suspicion per replica (log10 "
+                          "scale; suspect/dead thresholds in config)")
+        score_g = reg.gauge("ds_fleet_replica_score",
+                            "composite health score per replica "
+                            "(1 = healthy, min over sub-scores)")
+        state_g = reg.gauge("ds_fleet_replica_state",
+                            "health state rank per replica "
+                            "(0 healthy, 1 degraded, 2 suspect, "
+                            "3 dead)")
+        trans_c = reg.counter("ds_fleet_state_transitions_total",
+                              "health state-machine transitions per "
+                              "replica")
+        for name, row in snap.items():
+            phi_g.set(row["phi"], replica=name)
+            score_g.set(row["score"], replica=name)
+            state_g.set(_STATE_RANK[row["state"]], replica=name)
+            trans_c.set_total(row["transitions"], replica=name)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._replicas.clear()
+
+
+# --- module-level current monitor (wired by telemetry.configure) ---------
+
+_MONITOR: Optional[HealthMonitor] = None
+
+
+def get_health_monitor() -> Optional[HealthMonitor]:
+    return _MONITOR
+
+
+def set_health_monitor(mon: Optional[HealthMonitor]) -> None:
+    global _MONITOR
+    _MONITOR = mon

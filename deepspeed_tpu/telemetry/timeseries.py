@@ -1,0 +1,247 @@
+"""Time-series derivation over the metrics registry (ISSUE 17
+tentpole part 1).
+
+The registry is a point-in-time surface: counters only ever say "N
+breaches since start", never "how fast are we burning NOW". This
+module keeps a bounded in-process ring of periodic registry snapshots
+(flattened to ``{series: value}``) and derives the signals the fleet
+health plane consumes:
+
+- ``rate(series, window_s)`` — per-second increase of a monotonic
+  counter over a lookback window (the Prometheus ``rate()`` analogue,
+  computed host-side with no scraper in the loop);
+- ``burn_rate(numerator, denominator, window_s)`` — windowed ratio of
+  two counter deltas, e.g. SLO breaches per completed request: the
+  multi-window fast/slow-burn figure SRE-style alerting keys on
+  (a 60 s window catching a cliff, a 3600 s window catching a slow
+  leak — see docs/observability.md);
+- ``window_percentile(series, window_s, q)`` — sliding-window
+  percentile of a gauge's sampled values (queue depth p99 over the
+  last minute, free-block p01, ...).
+
+Stem helpers sum label-variants: ``ds_serving_slo_ttft_breaches_total``
+may carry labels (one series per label set after flattening), and the
+burn computation wants the total.
+
+Sampling is pull-based and rate-limited (``maybe_sample``): the
+serving loop calls it on its existing ~4 Hz housekeeping path, and the
+ring itself enforces ``interval_s`` so a hot loop cannot oversample.
+Host-only, stdlib-only, zero-import when telemetry is disabled (same
+contract as reqtrace/flightrec; lint_all's host-only audit covers this
+module). A ``clock`` injection point keeps every derivation
+fake-clock testable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+# default multi-window burn lookbacks (seconds): fast burn (a cliff
+# shows up within a minute), mid, slow burn (a leak shows up over an
+# hour). Mirrored by TelemetryConfig.burn_windows_s.
+DEFAULT_BURN_WINDOWS_S = (60.0, 300.0, 3600.0)
+
+
+def flatten_snapshot(snap: dict) -> dict[str, float]:
+    """Registry ``snapshot()`` dict -> flat ``{series: value}``.
+
+    Scalar metrics flatten to ``name[/k=v...]``; histograms contribute
+    ``_count``/``_sum``/``_mean`` leaves — the same naming
+    ``tools/telemetry_report.parse_metrics_json`` produces, so ring
+    samples, fleet rollups and report rows all speak one key space."""
+    out: dict[str, float] = {}
+    for name, meta in snap.items():
+        for entry in meta.get("values", []):
+            labels = entry.get("labels") or {}
+            suffix = "".join(f"/{k}={v}"
+                             for k, v in sorted(labels.items()))
+            if meta.get("type") == "histogram":
+                out[f"{name}{suffix}_count"] = float(
+                    entry.get("count", 0))
+                out[f"{name}{suffix}_sum"] = float(entry.get("sum", 0.0))
+                out[f"{name}{suffix}_mean"] = float(
+                    entry.get("mean", 0.0))
+            else:
+                out[f"{name}{suffix}"] = float(entry.get("value", 0.0))
+    return out
+
+
+def stem_total(flat: dict[str, float], stem: str) -> float:
+    """Sum every series containing ``stem`` (label variants of one
+    counter), excluding the non-additive ``_mean`` histogram leaves."""
+    return sum(v for k, v in flat.items()
+               if stem in k and not k.endswith("_mean"))
+
+
+class TimeSeriesRing:
+    """Bounded ring of ``(t, flat_metrics)`` samples + derivations.
+
+    All readers tolerate an empty/short ring (return ``None``), so the
+    health plane degrades to "no signal" instead of raising while the
+    first window fills."""
+
+    def __init__(self, capacity: int = 512, interval_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = max(int(capacity), 8)
+        self.interval_s = max(float(interval_s), 0.0)
+        self._clock = clock
+        self._samples: deque[tuple[float, dict]] = deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._next_sample = 0.0
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    # -- writers -------------------------------------------------------
+    def record(self, flat: dict[str, float],
+               now: Optional[float] = None) -> None:
+        """Append one pre-flattened sample (tests, cross-process
+        feeds)."""
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            self._samples.append((t, dict(flat)))
+
+    def sample(self, registry=None, now: Optional[float] = None) -> bool:
+        """Snapshot ``registry`` (default: the live one) into the ring.
+        Returns False when no registry is available."""
+        if registry is None:
+            from .registry import get_registry
+            registry = get_registry()
+        if registry is None:
+            return False
+        self.record(flatten_snapshot(registry.snapshot()), now=now)
+        return True
+
+    def maybe_sample(self, registry=None,
+                     now: Optional[float] = None) -> bool:
+        """Rate-limited :meth:`sample`: no-op (False) until
+        ``interval_s`` has passed since the previous accepted sample.
+        The serving loop calls this on its housekeeping path without
+        its own cadence bookkeeping."""
+        t = self._clock() if now is None else float(now)
+        if t < self._next_sample:
+            return False
+        self._next_sample = t + self.interval_s
+        return self.sample(registry, now=t)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+        self._next_sample = 0.0
+
+    # -- readers -------------------------------------------------------
+    def latest(self) -> Optional[tuple[float, dict]]:
+        with self._lock:
+            return self._samples[-1] if self._samples else None
+
+    def _window(self, window_s: float,
+                now: Optional[float] = None) -> list[tuple[float, dict]]:
+        t = self._clock() if now is None else float(now)
+        lo = t - float(window_s)
+        with self._lock:
+            return [(ts, s) for ts, s in self._samples if ts >= lo]
+
+    def _bracket(self, window_s: float, now: Optional[float] = None):
+        """(oldest-in-window, newest) sample pair, or None when fewer
+        than two samples cover the window."""
+        rows = self._window(window_s, now)
+        if len(rows) < 2:
+            return None
+        return rows[0], rows[-1]
+
+    def delta(self, stem: str, window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Increase of the stem-summed counter over the window
+        (clamped at 0: a registry clear between samples must not read
+        as a negative burn)."""
+        br = self._bracket(window_s, now)
+        if br is None:
+            return None
+        (_, old), (_, new) = br
+        return max(stem_total(new, stem) - stem_total(old, stem), 0.0)
+
+    def rate(self, stem: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """Per-second increase of the stem-summed counter over the
+        window."""
+        br = self._bracket(window_s, now)
+        if br is None:
+            return None
+        (t0, old), (t1, new) = br
+        dt = t1 - t0
+        if dt <= 0:
+            return None
+        return max(stem_total(new, stem) - stem_total(old, stem),
+                   0.0) / dt
+
+    def burn_rate(self, numerator_stem: str, denominator_stem: str,
+                  window_s: float,
+                  now: Optional[float] = None) -> Optional[float]:
+        """Windowed Δnumerator / Δdenominator — e.g. SLO breaches per
+        completed request over the window. ``None`` while the window
+        lacks two samples, ``0.0`` when the denominator did not move
+        (no traffic burns no budget)."""
+        br = self._bracket(window_s, now)
+        if br is None:
+            return None
+        (_, old), (_, new) = br
+        dn = max(stem_total(new, numerator_stem)
+                 - stem_total(old, numerator_stem), 0.0)
+        dd = max(stem_total(new, denominator_stem)
+                 - stem_total(old, denominator_stem), 0.0)
+        if dd <= 0:
+            return 0.0
+        return dn / dd
+
+    def multi_window_burn(self, numerator_stem: str,
+                          denominator_stem: str,
+                          windows_s=DEFAULT_BURN_WINDOWS_S,
+                          now: Optional[float] = None) -> dict[str, float]:
+        """{"60s": burn, "300s": burn, ...} over the configured
+        lookbacks — the fast/slow-burn pair (plus any mid windows) an
+        alerting rule ANDs together. Windows without data are
+        omitted."""
+        out = {}
+        for w in windows_s:
+            b = self.burn_rate(numerator_stem, denominator_stem, w,
+                               now=now)
+            if b is not None:
+                out[f"{int(w)}s"] = b
+        return out
+
+    def window_percentile(self, series: str, window_s: float, q: float,
+                          now: Optional[float] = None) -> Optional[float]:
+        """Percentile ``q`` (0..1) of an EXACT series' sampled values
+        over the window (gauges: queue depth, free blocks, phi)."""
+        rows = self._window(window_s, now)
+        vals = sorted(s[series] for _, s in rows if series in s)
+        if not vals:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        return vals[min(len(vals) - 1, int(len(vals) * q))]
+
+    def series_names(self) -> list[str]:
+        """Union of series keys across the ring (report/debug)."""
+        seen: set[str] = set()
+        with self._lock:
+            for _, s in self._samples:
+                seen.update(s)
+        return sorted(seen)
+
+
+# --- module-level current ring (wired by telemetry.configure) ------------
+
+_RING: Optional[TimeSeriesRing] = None
+
+
+def get_timeseries() -> Optional[TimeSeriesRing]:
+    return _RING
+
+
+def set_timeseries(ring: Optional[TimeSeriesRing]) -> None:
+    global _RING
+    _RING = ring

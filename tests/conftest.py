@@ -168,6 +168,11 @@ _SLOW = {
     # same paths also run in the bench `disagg` stage)
     ("test_disagg.py", "test_router_two_replica_disagg_end_to_end"),
     ("test_disagg.py", "test_imported_request_preemption_restore"),
+    # fleet health plane (ISSUE 17): detector/ring/aggregation/router
+    # gating all run fake-clock tier-1; the two-engine kill ->
+    # drain-and-reroute end-to-end is the engine-heavy tail (the same
+    # path also runs in the bench `fleet` stage)
+    ("test_fleet.py", "test_replica_kill_drains_and_reroutes_zero_drops"),
     ("test_device_truth.py", "test_quantized_kv_pool_ledger_footprint"),
     ("test_spec_decode.py", "test_spec_stochastic_schedule_invariance"),
     ("test_spec_decode.py", "test_spec_admission_order_invariance"),

@@ -1,0 +1,612 @@
+"""Fleet-wide health plane (ISSUE 17): time-series ring derivations
+(rates, deltas, multi-window SLO burn, sliding percentiles), the
+phi-accrual failure detector + composite health scoring state machine,
+exact fleet snapshot aggregation (counter sums property-tested,
+histogram bucket merge, gauge min/max/sum widening), the versioned
+fleet.json artifact -> telemetry_report --fleet view, the router's
+health-gated placement, the ms->s SLO unit boundary, and the
+engine-backed kill -> drain-and-reroute end-to-end test.
+
+Everything except the end-to-end test drives the plane with fake
+clocks and fake replicas — host-only, no engine, tier-1 lean."""
+
+import asyncio
+import json
+import os
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry.fleet import FleetScope, merge_snapshots
+from deepspeed_tpu.telemetry.health import HealthMonitor
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.timeseries import (TimeSeriesRing,
+                                                flatten_snapshot,
+                                                stem_total)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    """Deterministic monotonic stand-in: advance() moves time."""
+
+    def __init__(self, t0: float = 100.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Each test starts and ends with telemetry inactive."""
+    telemetry.shutdown()
+    yield
+    telemetry.shutdown()
+
+
+# ---------------------------------------------------------------------
+# time-series ring: flatten / rate / delta / burn / percentile
+# ---------------------------------------------------------------------
+
+def test_flatten_snapshot_and_stem_total():
+    reg = MetricsRegistry()
+    reg.counter("ds_x_total").inc(2, op="a")
+    reg.counter("ds_x_total").inc(3, op="b")
+    reg.gauge("ds_depth").set(7)
+    h = reg.histogram("ds_lat_seconds", buckets=(0.1,))
+    h.observe(0.05)
+    h.observe(0.15)
+    flat = flatten_snapshot(reg.snapshot())
+    assert flat["ds_x_total/op=a"] == 2.0
+    assert flat["ds_x_total/op=b"] == 3.0
+    assert flat["ds_depth"] == 7.0
+    assert flat["ds_lat_seconds_count"] == 2.0
+    assert flat["ds_lat_seconds_sum"] == pytest.approx(0.2)
+    assert flat["ds_lat_seconds_mean"] == pytest.approx(0.1)
+    # stem sums the label variants; the non-additive _mean leaf is out
+    assert stem_total(flat, "ds_x_total") == 5.0
+    assert stem_total(flat, "ds_lat_seconds") == pytest.approx(2.2)
+
+
+def test_ring_rate_delta_and_clamp():
+    clock = FakeClock()
+    ring = TimeSeriesRing(clock=clock)
+    assert ring.rate("ds_x", 60.0) is None          # empty ring
+    ring.record({"ds_x_total": 10.0}, now=clock.t)
+    assert ring.rate("ds_x", 60.0) is None          # one sample
+    clock.advance(10.0)
+    ring.record({"ds_x_total": 30.0}, now=clock.t)
+    assert ring.delta("ds_x", 60.0) == 20.0
+    assert ring.rate("ds_x", 60.0) == pytest.approx(2.0)
+    # a registry clear between samples must clamp, not go negative
+    clock.advance(10.0)
+    ring.record({"ds_x_total": 0.0}, now=clock.t)
+    assert ring.delta("ds_x", 5.0 + 10.0) == 0.0
+    # lookback window honours sample timestamps: a 5 s window only
+    # sees the newest sample -> no bracket
+    assert ring.rate("ds_x", 5.0) is None
+
+
+def test_burn_rate_multi_window_and_flat_denominator():
+    clock = FakeClock()
+    ring = TimeSeriesRing(clock=clock)
+    ring.record({"ds_serving_slo_ttft_breaches_total": 0.0,
+                 "ds_serving_requests_total": 0.0}, now=clock.t)
+    clock.advance(30.0)
+    ring.record({"ds_serving_slo_ttft_breaches_total": 3.0,
+                 "ds_serving_requests_total": 10.0}, now=clock.t)
+    assert ring.burn_rate("ds_serving_slo_",
+                          "ds_serving_requests_total",
+                          60.0) == pytest.approx(0.3)
+    burn = ring.multi_window_burn("ds_serving_slo_",
+                                  "ds_serving_requests_total")
+    assert burn["60s"] == pytest.approx(0.3)
+    assert set(burn) == {"60s", "300s", "3600s"}
+    # no traffic burns no budget: flat denominator -> 0.0, not a raise
+    clock.advance(30.0)
+    ring.record({"ds_serving_slo_ttft_breaches_total": 3.0,
+                 "ds_serving_requests_total": 10.0}, now=clock.t)
+    assert ring.burn_rate("ds_serving_slo_",
+                          "ds_serving_requests_total", 40.0) == 0.0
+
+
+def test_window_percentile_and_maybe_sample_rate_limit():
+    clock = FakeClock()
+    ring = TimeSeriesRing(interval_s=0.25, clock=clock)
+    for v in (5.0, 1.0, 9.0, 3.0, 7.0):
+        clock.advance(1.0)
+        ring.record({"ds_depth": v}, now=clock.t)
+    assert ring.window_percentile("ds_depth", 60.0, 0.0) == 1.0
+    assert ring.window_percentile("ds_depth", 60.0, 1.0) == 9.0
+    assert ring.window_percentile("ds_depth", 60.0, 0.5) == 5.0
+    assert ring.window_percentile("missing", 60.0, 0.5) is None
+    # only the last two samples sit inside a 1.5 s window
+    assert ring.window_percentile("ds_depth", 1.5, 0.0) == 3.0
+    # maybe_sample enforces interval_s against a hot caller
+    reg = MetricsRegistry()
+    reg.counter("ds_y_total").inc()
+    assert ring.maybe_sample(reg, now=clock.t) is True
+    assert ring.maybe_sample(reg, now=clock.t + 0.1) is False
+    assert ring.maybe_sample(reg, now=clock.t + 0.3) is True
+    assert "ds_y_total" in ring.series_names()
+
+
+# ---------------------------------------------------------------------
+# phi-accrual failure detector (satellite: detector test suite)
+# ---------------------------------------------------------------------
+
+def _beaten(mon, clock, name="r0", n=8, dt=1.0):
+    for _ in range(n):
+        mon.heartbeat(name, now=clock.t)
+        clock.advance(dt)
+
+
+def test_phi_monotonic_under_silence_and_state_arc():
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    # cold detector never suspects (min_heartbeats intervals first)
+    mon.heartbeat("cold", now=clock.t)
+    assert mon.phi("cold", now=clock.advance(500.0)) == 0.0
+    assert mon.state("cold") == "healthy"
+    _beaten(mon, clock, n=8, dt=1.0)
+    last = mon.phi("r0", now=clock.t)
+    states = []
+    for _ in range(40):
+        clock.advance(1.0)
+        p = mon.phi("r0", now=clock.t)
+        assert p >= last                    # monotonic in silence
+        last = p
+        states.append(mon.state("r0", now=clock.t))
+    # healthy -> suspect -> dead, visited in order, no regressions
+    assert states[0] == "healthy" and states[-1] == "dead"
+    arc = [s for i, s in enumerate(states) if i == 0
+           or s != states[i - 1]]
+    assert arc == ["healthy", "suspect", "dead"]
+    assert mon.snapshot(now=clock.t)["r0"]["deaths"] == 1
+
+
+def test_recovery_on_resumed_heartbeats():
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    _beaten(mon, clock, n=8, dt=1.0)
+    clock.advance(12.0)                     # phi ~5.2 -> suspect
+    assert mon.state("r0", now=clock.t) == "suspect"
+    # resumed beats: the pause is folded into the window (it was not
+    # death-grade) and suspicion collapses
+    _beaten(mon, clock, n=4, dt=1.0)
+    assert mon.state("r0", now=clock.t) == "healthy"
+    assert mon.snapshot(now=clock.t)["r0"]["deaths"] == 0
+
+
+def test_jittered_heartbeats_never_flap():
+    """Hysteresis acceptance: intervals jittered 0.8-1.2 s around the
+    calibrated cadence never trip suspect, and the state machine
+    records zero transitions."""
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        mon.heartbeat("r0", now=clock.t)
+        assert mon.state("r0", now=clock.t) == "healthy"
+        clock.advance(float(rng.uniform(0.8, 1.2)))
+    assert mon.transitions("r0") == 0
+
+
+def test_dead_is_terminal_without_explicit_revival():
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    _beaten(mon, clock, n=8, dt=1.0)
+    clock.advance(30.0)
+    assert mon.state("r0", now=clock.t) == "dead"
+    # silence alone NEVER re-admits: phi stays astronomical, state
+    # stays dead across arbitrarily many evaluations
+    for _ in range(5):
+        clock.advance(100.0)
+        assert mon.state("r0", now=clock.t) == "dead"
+    assert mon.snapshot(now=clock.t)["r0"]["deaths"] == 1
+    # the explicit recovery beat is the ONLY way back, and it resets
+    # the interval history (post-restart cadence starts clean)
+    mon.heartbeat("r0", now=clock.t)
+    assert mon.state("r0", now=clock.t) == "healthy"
+    assert mon.snapshot(now=clock.t)["r0"]["mean_interval_s"] is None
+
+
+def test_rejoin_gap_is_not_a_cadence_sample():
+    """A gap the detector would have called death (even if nobody
+    polled state() during it) must not enter the interval window —
+    one stale epoch would poison the mean for the whole next epoch."""
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    _beaten(mon, clock, n=8, dt=1.0)
+    clock.advance(1000.0)
+    mon.heartbeat("r0", now=clock.t)        # rejoin, not a sample
+    snap = mon.snapshot(now=clock.t)["r0"]
+    assert snap["mean_interval_s"] is None
+    # ... and a survivable pause IS a sample (self-calibration)
+    clock.advance(3.0)
+    mon.heartbeat("r0", now=clock.t)
+    assert mon.snapshot(now=clock.t)["r0"]["mean_interval_s"] \
+        == pytest.approx(3.0)
+
+
+def test_fast_beats_do_not_overtighten_calibration():
+    """min_interval_s floor + survived-pause guard: a burst of sub-ms
+    beats must not make one long engine step read as death."""
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock, min_interval_s=0.05)
+    _beaten(mon, clock, n=50, dt=0.001)
+    # a 0.2 s pause: 200x the observed mean, but under the floor's
+    # suspicion threshold -> still healthy
+    clock.advance(0.2)
+    assert mon.state("r0", now=clock.t) == "healthy"
+    # a pause no longer than one already survived is never evidence
+    mon.heartbeat("r0", now=clock.t)        # the 0.2 s gap enters
+    clock.advance(0.19)
+    assert mon.phi("r0", now=clock.t) == 0.0
+    # real silence still detects
+    clock.advance(30.0)
+    assert mon.state("r0", now=clock.t) == "dead"
+
+
+def test_composite_score_weakest_link_and_degraded():
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock, free_block_floor=10,
+                        burn_degraded=0.5, stall_deadline_s=5.0)
+    _beaten(mon, clock, n=8, dt=0.1)
+    assert mon.score("r0") == 1.0
+    mon.observe("r0", queue_frac=0.5)
+    assert mon.score("r0") == pytest.approx(0.5)
+    # min over sub-scores: the worst signal owns the score
+    mon.observe("r0", free_blocks=2, slo_burn=0.25, stalled_s=1.0)
+    assert mon.score("r0") == pytest.approx(0.2)    # 2/10 free blocks
+    mon.heartbeat("r0", now=clock.t)
+    assert mon.state("r0", now=clock.t) == "degraded"
+    # any sanitizer violation zeroes the score outright
+    mon.observe("r0", violations=1)
+    assert mon.score("r0") == 0.0
+    # recovery: the adverse inputs clear, the replica re-admits
+    mon.observe("r0", queue_frac=0.0, free_blocks=100, slo_burn=0.0,
+                violations=0, stalled_s=0.0)
+    assert mon.score("r0") == 1.0
+    assert mon.state("r0", now=clock.t) == "healthy"
+
+
+def test_collect_exports_ds_fleet_gauges():
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    _beaten(mon, clock, "r0", n=8, dt=1.0)
+    _beaten(mon, clock, "r1", n=8, dt=1.0)
+    clock.advance(30.0)                      # r1 silent -> dead
+    mon.heartbeat("r0", now=clock.t)
+    reg = MetricsRegistry()
+    mon.collect(reg)
+    flat = flatten_snapshot(reg.snapshot())
+    assert flat["ds_fleet_replica_state/replica=r1"] == 3.0   # dead
+    assert flat["ds_fleet_replica_score/replica=r0"] == 1.0
+    assert flat["ds_fleet_replica_phi/replica=r1"] > flat[
+        "ds_fleet_replica_phi/replica=r0"]
+    assert flat["ds_fleet_state_transitions_total/replica=r1"] >= 1.0
+
+
+# ---------------------------------------------------------------------
+# fleet aggregation: exactness properties + the fleet.json artifact
+# ---------------------------------------------------------------------
+
+def test_merged_counter_totals_equal_sum_of_replicas():
+    """Acceptance property: for every (counter, label set), the merged
+    fleet total equals the sum of the per-replica snapshots — over
+    randomized fleets."""
+    rng = np.random.default_rng(0)
+    for _trial in range(5):
+        regs = {}
+        expect: dict[tuple, float] = {}
+        for r in range(int(rng.integers(2, 6))):
+            reg = MetricsRegistry()
+            for name in ("ds_a_total", "ds_b_total"):
+                for op in ("x", "y", "z"):
+                    if rng.random() < 0.3:
+                        continue            # sparse: not every replica
+                    v = float(rng.integers(0, 100))
+                    reg.counter(name).inc(v, op=op)
+                    key = (name, op)
+                    expect[key] = expect.get(key, 0.0) + v
+            regs[f"rep{r}"] = reg.snapshot()
+        flat = flatten_snapshot(merge_snapshots(regs))
+        got = {k: v for k, v in flat.items() if k.endswith(("x", "y", "z"))}
+        assert got == {f"{name}/op={op}": v
+                       for (name, op), v in expect.items() if v or True}
+
+
+def test_merge_histograms_and_gauge_widening():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    for reg, vals in ((r1, (0.05, 0.5)), (r2, (0.05, 5.0))):
+        h = reg.histogram("ds_lat_seconds", buckets=(0.1, 1.0))
+        for v in vals:
+            h.observe(v)
+    r1.gauge("ds_free_blocks").set(10)
+    r2.gauge("ds_free_blocks").set(4)
+    merged = merge_snapshots({"a": r1.snapshot(), "b": r2.snapshot()})
+    (hist,) = merged["ds_lat_seconds"]["values"]
+    assert hist["count"] == 4
+    assert hist["sum"] == pytest.approx(5.6)
+    assert hist["mean"] == pytest.approx(1.4)
+    # bucket-by-bucket cumulative add
+    assert hist["buckets"]["0.1"] == 2
+    assert hist["buckets"]["1.0"] == 3
+    # gauges widen: fleet sum readable AND worst replica readable
+    (g,) = merged["ds_free_blocks"]["values"]
+    assert g["value"] == 14.0
+    assert g["aggregate"] == {"sum": 14.0, "min": 4.0, "max": 10.0,
+                              "mean": 7.0, "n": 2}
+
+
+def test_fleet_scope_members_files_and_errors(tmp_path):
+    scope = FleetScope("fleetX")
+    live = MetricsRegistry()
+    live.counter("ds_req_total").inc(5)
+    scope.add_replica("live0", live)
+    # cross-process member: an exported snapshot file, re-read per merge
+    remote = MetricsRegistry()
+    remote.counter("ds_req_total").inc(7)
+    p = tmp_path / "host2.metrics.json"
+    p.write_text(json.dumps(remote.snapshot()))
+    assert scope.add_snapshot_file(str(p)) == "host2"
+    # a dead member's unreadable file lands in errors, not an exception
+    scope.add_snapshot_file(str(tmp_path / "gone.metrics.json"))
+    assert scope.members() == ["gone", "host2", "live0"]
+    doc = scope.merge()
+    assert doc["fleet_flat"]["ds_req_total"] == 12.0
+    assert doc["replicas"]["live0"]["ds_req_total"] == 5.0
+    assert list(doc["errors"]) == ["gone"]
+    # the live member tracks its registry at every merge
+    live.counter("ds_req_total").inc(1)
+    assert scope.merge()["fleet_flat"]["ds_req_total"] == 13.0
+    scope.remove_replica("gone")
+    assert scope.merge()["errors"] == {}
+
+
+def test_fleet_json_artifact_and_report_view(tmp_path):
+    scope = FleetScope()
+    for n, v in (("r0", 5.0), ("r1", 7.0)):
+        reg = MetricsRegistry()
+        reg.counter("ds_serving_requests_total").inc(v)
+        reg.gauge("ds_moe_aux_loss").set(v / 10)
+        scope.add_replica(n, reg)
+    path = str(tmp_path / "x.fleet.json")
+    health = {"r0": {"state": "healthy", "phi": 0.1, "score": 1.0,
+                     "heartbeats": 9, "deaths": 0,
+                     "last_heartbeat_age_s": 0.2}}
+    scope.write(path, health=health)
+    scope.write(path, health=health)            # version bumps per write
+    doc = json.load(open(path))
+    assert doc["schema_version"] == 1 and doc["version"] == 2
+    assert doc["fleet_flat"]["ds_serving_requests_total"] == 12.0
+    # the report renders per-replica + fleet views from the file ALONE
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    rep = telemetry_report.fleet_report(path)
+    assert rep["n_replicas"] == 2
+    assert rep["replicas"]["r0"]["ds_serving_requests_total"] == 5.0
+    assert rep["fleet"]["ds_serving_requests_total"] == 12.0
+    # ds_moe_* rows surface in the serving summary (PR 15 satellite)
+    assert rep["fleet"]["ds_moe_aux_loss"] == pytest.approx(1.2)
+    assert rep["health"] == health
+    telemetry_report.print_fleet(rep)            # render smoke
+    assert telemetry_report.main(["--fleet", path]) == 0
+
+
+def test_configure_fleet_wiring_and_artifact(tmp_path):
+    """configure(fleet=True) installs ring + detector + scope; the
+    registry joins the fleet under the replica name; export_artifacts
+    emits fleet.json; shutdown clears every singleton."""
+    telemetry.configure(fleet=True, fleet_replica="me0",
+                        burn_windows_s=[30.0, 600.0])
+    assert telemetry.get_timeseries() is not None
+    assert telemetry.get_health_monitor() is not None
+    assert telemetry.get_fleet().members() == ["me0"]
+    assert telemetry.burn_windows() == (30.0, 600.0)
+    telemetry.get_registry().counter("ds_req_total").inc(3)
+    telemetry.get_health_monitor().heartbeat("me0")
+    paths = telemetry.export_artifacts(str(tmp_path), prefix="t")
+    doc = json.load(open(paths["fleet"]))
+    assert doc["replicas"]["me0"]["ds_req_total"] == 3.0
+    assert doc["fleet_flat"]["ds_req_total"] == 3.0
+    assert "me0" in doc["health"]
+    # the detector's own gauges land in the merged view too
+    assert doc["fleet_flat"][
+        "ds_fleet_replica_state/replica=me0"] == 0.0
+    telemetry.shutdown()
+    assert telemetry.get_timeseries() is None
+    assert telemetry.get_health_monitor() is None
+    assert telemetry.get_fleet() is None
+
+
+def test_hang_dump_carries_fleet_health(tmp_path):
+    from deepspeed_tpu.telemetry import health as health_mod
+    from deepspeed_tpu.telemetry.flightrec import dump_state
+    clock = FakeClock()
+    mon = HealthMonitor(clock=clock)
+    _beaten(mon, clock, "r0", n=8, dt=1.0)
+    clock.advance(30.0)
+    health_mod.set_health_monitor(mon)
+    try:
+        path = dump_state("unit-test", str(tmp_path))
+        doc = json.load(open(path))
+        assert doc["fleet_health"]["r0"]["state"] == "dead"
+    finally:
+        health_mod.set_health_monitor(None)
+
+
+# ---------------------------------------------------------------------
+# SLO unit boundary (satellite: ms config -> seconds recorder, once)
+# ---------------------------------------------------------------------
+
+def test_slo_ms_config_converts_to_seconds_exactly_once():
+    """ServingConfig carries milliseconds; RequestTraceRecorder works
+    in seconds; the conversion happens exactly once at server start.
+    Regression for double-convert (ms/1e6) and skip (ms as s)."""
+    from deepspeed_tpu.serving.config import ServingConfig
+    from deepspeed_tpu.serving.server import _slo_seconds
+    cfg = ServingConfig(slo_ttft_ms=250.0, slo_itl_ms=40.0)
+    assert _slo_seconds(cfg) == (0.25, 0.04)
+    # 0 disables (None), never "0 seconds" (everything breaches)
+    assert _slo_seconds(ServingConfig()) == (None, None)
+    assert _slo_seconds(ServingConfig(slo_ttft_ms=250.0)) == (0.25, None)
+    # behavioral pin with a fake clock: a 0.3 s TTFT breaches a 250 ms
+    # target, a 0.2 s TTFT does not
+    from deepspeed_tpu.telemetry.reqtrace import RequestTraceRecorder
+    for ttft, breaches in ((0.3, 1.0), (0.2, 0.0)):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        rec = RequestTraceRecorder(registry=reg, clock=clock)
+        rec.set_slo(*_slo_seconds(cfg))
+        rec.enqueue(1, prompt_tokens=3, max_new_tokens=4)
+        rec.admitted(1)
+        clock.advance(ttft)
+        rec.tokens_landed(1, 1)
+        rec.finished(1, "completed")
+        flat = flatten_snapshot(reg.snapshot())
+        assert stem_total(
+            flat, "ds_serving_slo_ttft_breaches_total") == breaches
+
+
+# ---------------------------------------------------------------------
+# router health gating (fake replicas, no engine)
+# ---------------------------------------------------------------------
+
+class _FakeReplica:
+    """Duck-typed AsyncInferenceServer surface for _place()."""
+
+    def __init__(self, name="", open_requests=0, free_blocks=100):
+        self.config = SimpleNamespace(replica=name)
+        self.accepting = True
+        self.open_requests = open_requests
+        self.free_blocks = free_blocks
+
+    def prefix_affinity(self, tokens):
+        return 0
+
+    def metrics(self):
+        return {}
+
+
+def test_router_placement_consults_health_state():
+    from deepspeed_tpu.serving import InferenceRouter, RouterConfig
+    from deepspeed_tpu.telemetry import health as health_mod
+    telemetry.configure()
+    # pre-install a fake-clock monitor; the router's configure_fleet
+    # is idempotent and adopts it
+    clock = FakeClock()
+    health_mod.set_health_monitor(HealthMonitor(clock=clock))
+    reps = [_FakeReplica(), _FakeReplica(open_requests=3)]
+    router = InferenceRouter(reps, RouterConfig())
+    hm = telemetry.get_health_monitor()
+    assert hm is not None and router._hm is hm
+    for _ in range(8):
+        hm.heartbeat("replica0", now=clock.t)
+        hm.heartbeat("replica1", now=clock.t)
+        clock.advance(1.0)
+
+    cands, rule = router._place([1, 2, 3])
+    assert [n for n, _ in cands] == ["replica0", "replica1"]
+    assert rule == "least_loaded"
+
+    # replica0 goes silent -> suspect: excluded, not even last resort
+    hm.heartbeat("replica1", now=clock.advance(12.0))
+    assert hm.state("replica0", now=clock.t) == "suspect"
+    cands, _ = router._place([1, 2, 3])
+    assert [n for n, _ in cands] == ["replica1"]
+    assert router.stats["health_skips"] == 1
+    # the placement log records the health snapshot the decision saw
+    entry = router.placement_log[-1]
+    assert entry["health"]["replica0"] == "suspect"
+    assert entry["candidates"] == ["replica1"]
+
+    # degraded (composite score under floor) -> drain semantics:
+    # last-resort only
+    hm.observe("replica1", violations=1)
+    assert hm.state("replica1", now=clock.t) == "degraded"
+    cands, _ = router._place([1, 2, 3])
+    assert [n for n, _ in cands] == ["replica1"]     # sole survivor
+    assert router.stats["drain_skips"] >= 1
+    assert router.metrics()["health"]["replica0"] == "suspect"
+
+
+def test_router_health_gating_off_without_telemetry():
+    """Telemetry off: the router never touches the health plane and
+    placement is the pre-ISSUE-17 logic byte-for-byte."""
+    from deepspeed_tpu.serving import InferenceRouter, RouterConfig
+    assert not telemetry.is_active()
+    router = InferenceRouter([_FakeReplica(), _FakeReplica()],
+                             RouterConfig())
+    assert router._hm is None
+    cands, rule = router._place([1, 2, 3])
+    assert len(cands) == 2 and rule == "least_loaded"
+    assert router.stats["health_skips"] == 0
+    assert len(router.placement_log) == 0
+    assert "health" not in router.metrics()
+
+
+# ---------------------------------------------------------------------
+# engine-backed kill -> drain-and-reroute (slow tier)
+# ---------------------------------------------------------------------
+
+def test_replica_kill_drains_and_reroutes_zero_drops(devices8):
+    """End-to-end acceptance: kill one replica's serving loop through
+    the supported fault-injection path while its requests stream; the
+    router reroutes every in-flight request to the survivor, the
+    client sees zero drops, and the incident is recorded in
+    replica_errors + the health/placement surfaces."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    from deepspeed_tpu.serving import (AsyncInferenceServer,
+                                       InferenceRouter, RouterConfig,
+                                       ServingConfig)
+    telemetry.configure()
+    model = Llama(size="tiny")
+
+    def mk(params=None):
+        return InferenceEngineV2(model, RaggedInferenceEngineConfig(
+            dtype="float32", kv_block_size=8, num_kv_blocks=64,
+            max_chunk_size=16), params=params)
+
+    e0 = mk()
+    e1 = mk(e0.params)
+    servers = [AsyncInferenceServer(e, ServingConfig(k_steps=2))
+               for e in (e0, e1)]
+    router = InferenceRouter(servers, RouterConfig(
+        health={"phi_suspect": 2.0, "phi_dead": 5.0}))
+    prompts = [[i + 1, i + 2, i + 3] for i in range(8)]
+
+    async def main():
+        async with router:
+            handles = [await router.submit(p, max_new_tokens=24)
+                       for p in prompts]
+            while servers[0].open_requests == 0:
+                await asyncio.sleep(0.005)
+            servers[0].kill()
+            return [await h.tokens() for h in handles]
+
+    outs = asyncio.run(main())
+    assert len(outs) == 8 and all(len(o) == 24 for o in outs)
+    assert router.stats["reroutes"] >= 1
+    assert router.stats["completed"] == 8
+    assert router.stats["failed"] == 0
+    assert list(router.replica_errors) == ["replica0"]
+    assert "fault injection" in router.replica_errors["replica0"]
+    # rerouted streams keep prefix + budget: the survivor's output is
+    # the same length the client asked for, already asserted above;
+    # the survivor must end the run without leaked sequences
+    assert e1.free_blocks == 64 and not e1.state_manager.seqs

@@ -493,6 +493,11 @@ def test_disabled_mode_zero_events_and_no_hot_path_errors(devices8):
     assert telemetry.get_ledger() is None
     assert telemetry.get_flight_recorder() is None
     assert telemetry.get_watchdog() is None
+    # fleet plane (ISSUE 17): same contract — no ring, detector, or
+    # aggregator state while telemetry is off
+    assert telemetry.get_timeseries() is None
+    assert telemetry.get_health_monitor() is None
+    assert telemetry.get_fleet() is None
 
 
 def test_device_truth_opt_in_defaults_off():
@@ -502,6 +507,11 @@ def test_device_truth_opt_in_defaults_off():
     assert telemetry.get_ledger() is None
     assert telemetry.get_flight_recorder() is None
     assert telemetry.get_watchdog() is None
+    # the ISSUE 17 fleet plane is its own opt-in too: plain
+    # configure() must not allocate the ring/detector/aggregator
+    assert telemetry.get_timeseries() is None
+    assert telemetry.get_health_monitor() is None
+    assert telemetry.get_fleet() is None
 
 
 def test_disabled_guard_no_import_no_state():
@@ -545,6 +555,9 @@ assert "deepspeed_tpu.telemetry" not in sys.modules, \
     "telemetry was imported on the disabled path"
 assert "deepspeed_tpu.telemetry.reqtrace" not in sys.modules, \
     "reqtrace was imported on the disabled path"
+for mod in ("timeseries", "health", "fleet"):
+    assert f"deepspeed_tpu.telemetry.{mod}" not in sys.modules, \
+        f"{mod} was imported on the disabled path"
 print("GUARD_OK")
 """
     env = dict(os.environ, JAX_PLATFORMS="cpu")

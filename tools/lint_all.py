@@ -15,7 +15,9 @@ no jax import anywhere):
 3. **host-only audits** — ``traced_roots`` over the packages whose
    contract forbids jit-reachable code: ``autotuning/`` (deterministic
    planner ranking) and ``serving/`` + ``telemetry/reqtrace.py`` (the
-   request-trace recorder runs on the event loop).
+   request-trace recorder runs on the event loop) +
+   ``telemetry/{timeseries,health,fleet}.py`` (the ISSUE 17 fleet
+   health plane is stdlib-only host logic).
 
 Exit codes: 0 = every section clean; 1 = any section failed;
 2 = usage/environment error. The tier-1 suite asserts this exits 0 at
@@ -88,9 +90,14 @@ def run_sections() -> list[dict]:
     for label, paths in (
             ("host-only: autotuning",
              [os.path.join(_PACKAGE, "autotuning")]),
-            ("host-only: serving + reqtrace",
+            ("host-only: serving + reqtrace + fleet plane",
              [os.path.join(_PACKAGE, "serving"),
-              os.path.join(_PACKAGE, "telemetry", "reqtrace.py")])):
+              os.path.join(_PACKAGE, "telemetry", "reqtrace.py"),
+              # ISSUE 17: the fleet health plane is host-side control
+              # logic — stdlib-only, nothing jit-reachable
+              os.path.join(_PACKAGE, "telemetry", "timeseries.py"),
+              os.path.join(_PACKAGE, "telemetry", "health.py"),
+              os.path.join(_PACKAGE, "telemetry", "fleet.py")])):
         roots = analysis.traced_roots(paths, root=_REPO)
         sections.append({
             "name": label,

@@ -126,11 +126,15 @@ def serving_summary(metrics: dict) -> dict:
     present. Runtime-sanitizer violation counters (``ds_blocksan_*`` /
     ``ds_affinity_*``, ISSUE 11; ``ds_meshsan_*``, ISSUE 15) ride
     along when present — a nonzero value there is a correctness
-    finding, not a perf number."""
+    finding, not a perf number. The MoE router gauges (``ds_moe_*``
+    drop-fraction / expert-load / capacity, ISSUE 16) and the fleet
+    health gauges (``ds_fleet_*`` per-replica phi / score / state,
+    ISSUE 17) join the same table, so MoE and fleet serving health
+    read without raw snapshots."""
     out = {k: v for k, v in sorted(metrics.items())
            if "ds_serving_" in k or "ds_blocksan_" in k
            or "ds_affinity_" in k or "ds_meshsan_" in k
-           or "ds_kv_" in k}
+           or "ds_kv_" in k or "ds_moe_" in k or "ds_fleet_" in k}
 
     def total(stem: str):
         vals = [v for k, v in metrics.items() if stem in k
@@ -216,6 +220,83 @@ def print_report(report: dict) -> None:
                 row = traffic[key]
                 print(f"{key[:29]:<30}{row['sites']:>7}"
                       f"{row['bytes']:>16}")
+
+
+# ---------------------------------------------------------------------
+# --fleet: fleet.json artifact -> per-replica + fleet rollup view
+# ---------------------------------------------------------------------
+
+def fleet_report(path: str) -> dict:
+    """Per-replica + fleet rollup view from the versioned
+    ``fleet.json`` artifact ALONE (``telemetry.export_artifacts``
+    writes it when the fleet plane is on) — no registry, no process,
+    no other file needed."""
+    with open(path) as f:
+        doc = json.load(f)
+    replicas = doc.get("replicas") or {}
+    return {
+        "fleet_id": doc.get("fleet_id"),
+        "schema_version": doc.get("schema_version"),
+        "version": doc.get("version"),
+        "n_replicas": len(replicas),
+        "replicas": {n: serving_summary(flat)
+                     for n, flat in sorted(replicas.items())},
+        "fleet": serving_summary(doc.get("fleet_flat") or {}),
+        "health": doc.get("health") or {},
+        "errors": doc.get("errors") or {},
+    }
+
+
+def print_fleet(report: dict) -> None:
+    print(f"fleet '{report['fleet_id']}' — "
+          f"{report['n_replicas']} replica(s), artifact version "
+          f"{report['version']} (schema v{report['schema_version']})")
+    health = report["health"]
+    if health:
+        print()
+        print("replica health (phi-accrual detector + composite "
+              "score):")
+        print(f"{'replica':<18}{'state':>10}{'phi':>9}{'score':>8}"
+              f"{'beats':>8}{'deaths':>8}{'beat age s':>12}")
+        for name in sorted(health):
+            row = health[name]
+            age = row.get("last_heartbeat_age_s")
+            print(f"{name[:17]:<18}{row.get('state', '?'):>10}"
+                  f"{row.get('phi', 0.0):>9.3f}"
+                  f"{row.get('score', 0.0):>8.3f}"
+                  f"{row.get('heartbeats', 0):>8}"
+                  f"{row.get('deaths', 0):>8}"
+                  f"{age if age is not None else '-':>12}")
+    names = sorted(report["replicas"])
+    series = sorted({s for flat in report["replicas"].values()
+                     for s in flat})
+    if series:
+        print()
+        print("per-replica serving series:")
+        print(f"{'series':<52}" + "".join(f"{n[:13]:>14}"
+                                          for n in names))
+        for s in series:
+            cells = "".join(
+                f"{report['replicas'][n].get(s, ''):>14.6g}"
+                if isinstance(report["replicas"][n].get(s), float)
+                else f"{report['replicas'][n].get(s, '-')!s:>14}"
+                for n in names)
+            print(f"{s[:51]:<52}{cells}")
+    fleet = report["fleet"]
+    if fleet:
+        print()
+        print("fleet rollup (counters summed exactly across "
+              "replicas; gauges summed — see fleet.json aggregates "
+              "for min/max/mean):")
+        print(f"{'series':<64}{'value':>14}")
+        for s in sorted(fleet):
+            v = fleet[s]
+            sval = f"{v:.6g}" if isinstance(v, float) else str(v)
+            print(f"{s[:63]:<64}{sval:>14}")
+    if report["errors"]:
+        print()
+        for name, err in sorted(report["errors"].items()):
+            print(f"unreadable replica {name}: {err}")
 
 
 # ---------------------------------------------------------------------
@@ -380,6 +461,22 @@ _GATES = {
         ("greedy_parity_horizon", +1, 0.0),
         ("tokens_per_sec", +1, 0.05),
     ),
+    # fleet gate (ISSUE 17, bench `fleet` stage): a replica is killed
+    # under open-loop load — how fast the phi-accrual detector marks
+    # it and the router stops placing onto it (detection /
+    # detection-to-reroute latency), the multi-window SLO burn rates
+    # during the incident, and the per-replica placement skew must not
+    # creep up; dropped requests are ZERO-tolerance (the drain-and-
+    # reroute contract — any drop from a zero baseline gates), and
+    # surviving-fleet throughput must hold.
+    "fleet": (
+        ("detection_to_reroute_ms", -1, 0.25),
+        ("detection_ms", -1, 0.25),
+        ("slo_burn_rate", -1, 0.25),
+        ("dropped", -1, 0.0),
+        ("replica_skew", -1, 0.15),
+        ("tokens_per_sec", +1, 0.05),
+    ),
 }
 
 # metric families a gate must NOT touch even though a stem matches by
@@ -526,9 +623,22 @@ def main(argv=None) -> int:
                          "thresholds (e.g. 'serving': tick_p50_ms, "
                          "dispatches_per_token, TTFT/ITL p99, "
                          "tokens_per_sec); exit 1 on regression")
+    ap.add_argument("--fleet", metavar="FLEET_JSON", default=None,
+                    help="render per-replica + fleet rollup + health "
+                         "views from a telemetry *.fleet.json "
+                         "artifact (standalone mode)")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON object")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        report = fleet_report(args.fleet)
+        if args.json:
+            json.dump(report, sys.stdout)
+            print()
+        else:
+            print_fleet(report)
+        return 0
 
     if args.merge:
         if len(args.paths) < 1:
