@@ -2409,6 +2409,66 @@ def zeropp_bench(ds, on_tpu: bool):
     }
 
 
+def numsan_bench(ds, on_tpu: bool):
+    """numsan overhead stage (ISSUE 18): the same training config run
+    three ways — no numsan block at all, the block present but
+    disabled, and armed in warn mode (per-leaf grad stats folded into
+    the compiled step + the deferred host check) — reporting
+
+    - ``numsan_overhead_pct``: armed-vs-off tokens/s delta (the ≤3%
+      acceptance figure; the armed step adds one fused per-leaf
+      count/max reduction and a deferred-by-one-dispatch host check);
+    - ``extra_executables``: backend-compile events of the
+      disabled-block run minus the no-block run — MUST be 0 (the
+      disabled path traces byte-identical graphs; the ``--gate
+      numerics`` family zero-tolerates this field);
+    - the sanitizer's own counters from the armed run (checked steps,
+      violations — a healthy run reports 0 violations).
+    """
+    from deepspeed_tpu.models import GPT2
+    from deepspeed_tpu.telemetry import bridges
+    bridges.install_jax_compile_listener()
+    seq = 1024 if on_tpu else 64
+    batch = 8 if on_tpu else _cpu_batch()
+    steps = 10 if on_tpu else 3
+    model_kw = dict(max_seq_len=seq)
+
+    # run 1 also warms every process-level jit cache (module-level
+    # helpers compile once per process, not per engine) so the later
+    # compile-count comparison sees per-engine executables only
+    off_tps, _ = _train_tput(ds, GPT2(size="tiny", **model_kw), {},
+                             batch, seq, steps,
+                             windows=2 if on_tpu else 1)
+    # executable-count parity check (warm vs warm): a second no-block
+    # run vs a numsan-key-present-but-disabled run must compile the
+    # SAME number of executables — the disabled path is byte-identical
+    c0 = bridges.compile_event_count()
+    _train_tput(ds, GPT2(size="tiny", **model_kw), {}, batch, seq, 1)
+    c1 = bridges.compile_event_count()
+    _train_tput(ds, GPT2(size="tiny", **model_kw),
+                {"numsan": {"enabled": False}}, batch, seq, 1)
+    c2 = bridges.compile_event_count()
+
+    on_tps, _ = _train_tput(ds, GPT2(size="tiny", **model_kw),
+                            {"numsan": {"enabled": True, "mode": "warn"}},
+                            batch, seq, steps,
+                            windows=2 if on_tpu else 1)
+    from deepspeed_tpu.analysis.numsan import get_numsan
+    san = get_numsan()
+    counters = dict(san.counters) if san is not None else {}
+    overhead = (off_tps - on_tps) / off_tps * 100.0 if off_tps else 0.0
+    return {
+        "metric": "numsan_overhead_pct",
+        "value": round(overhead, 2),
+        "unit": "% tokens/s lost with the sanitizer armed (warn mode)",
+        "tokens_per_sec": round(on_tps, 1),
+        "tokens_per_sec_numsan_off": round(off_tps, 1),
+        "extra_executables": int((c2 - c1) - (c1 - c0)),
+        "numsan_checked_steps": int(counters.get("checked_steps", 0)),
+        "numsan_violations": int(counters.get("violations", 0)),
+    }
+
+
 def offload_smoke(ds, on_tpu: bool):
     """ZeRO-Offload tier on real hardware. Sweeps the Twin-Flow
     `ratio` (reference offload_config.py:93): 1.0 = everything in
@@ -2713,6 +2773,7 @@ STAGES = [("headline", headline_bench),
           ("offload", offload_smoke),
           ("autotune", autotune_bench),
           ("zeropp", zeropp_bench),
+          ("numsan", numsan_bench),
           ("domino", domino_bench),
           ("kernel_smoke", lambda *_: kernel_smoke()),
           ("serve7b", serve7b_int8),

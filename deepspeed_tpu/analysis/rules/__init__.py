@@ -7,12 +7,12 @@ source of truth. IDs are stable — retired rules are never reused.
 
 from __future__ import annotations
 
-from . import (concurrency, donation, dtype_rules, host_sync, recompile,
-               spmd, telemetry_rules)
+from . import (concurrency, donation, dtype_rules, host_sync, numerics,
+               recompile, spmd, telemetry_rules)
 
 ALL_RULES = (host_sync.RULES + recompile.RULES + donation.RULES
              + dtype_rules.RULES + telemetry_rules.RULES
-             + concurrency.RULES + spmd.RULES)
+             + concurrency.RULES + spmd.RULES + numerics.RULES)
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
 
@@ -24,6 +24,12 @@ RULE_GROUPS = {
     "telemetry": tuple(r.id for r in telemetry_rules.RULES),
     "concurrency": tuple(r.id for r in concurrency.RULES),
     "spmd": tuple(r.id for r in spmd.RULES),
+    "numerics": tuple(r.id for r in numerics.RULES),
+}
+
+# CLI spellings: ``graftlint --select NUM`` == ``--select numerics``
+RULE_GROUP_ALIASES = {
+    "num": "numerics",
 }
 
 assert len(RULES_BY_ID) == len(ALL_RULES), "duplicate rule id"

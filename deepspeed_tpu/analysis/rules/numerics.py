@@ -1,0 +1,537 @@
+"""Mixed-precision / numerics rules (GL070-GL073) — ISSUE 18.
+
+The codebase runs saturated with reduced precision (bf16 training,
+fp16 dynamic loss scaling, int8/fp8 KV cache, stochastic-rounded
+quantized wire, int8 MoE dispatch): exactly the regimes where ZeRO++
+and EQuARX show quantized paths live or die on accumulation-dtype and
+clipping discipline. These rules are the static half of that
+discipline; the runtime half is ``analysis/numsan.py``.
+
+- **GL070** low-precision accumulation: a reduce/contraction
+  (``sum``/``mean``/``einsum``/``dot``/``matmul``/softmax/norm) over a
+  value the module committed to bf16/fp16, with no fp32 accumulator
+  route (``preferred_element_type=``, ``precision=``, an accumulator
+  ``dtype=``, or widening ``.astype`` before the reduce).
+- **GL071** unguarded ``exp``/``log``/``sqrt``/``rsqrt``/division on
+  traced values with no clamp/eps/max guard in the expression.
+- **GL072** precision-losing cast to an 8-bit dtype with no rounding
+  route (``stochastic_round``/``round``/``clip`` before the cast) —
+  a plain ``.astype(int8)`` on a grad/wire value silently truncates.
+- **GL073** PRNG key reuse: the same key reaching two sampling /
+  rounding call sites with no ``split``/reassignment between them
+  (the determinism contract every parity test rests on).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Context, Rule, attr_chain
+
+# dtypes that commit a value to reduced precision
+_LOW_PREC = {"bfloat16", "float16"}
+# reduce/contraction heads (tail of the attr chain, jnp/jax rooted)
+_REDUCE_TAILS = {"sum", "mean", "einsum", "dot", "matmul", "var", "std",
+                 "softmax", "log_softmax", "logsumexp", "norm", "tensordot"}
+# kwargs that route accumulation through a wider dtype
+_ACC_KWARGS = {"preferred_element_type", "precision", "dtype", "acc_dtype"}
+# call tails that widen / re-commit the dtype of their operand
+_WIDEN_TAILS = {"float32", "float64", "promote_types"}
+# guard call tails: clamp / eps / max-subtract / provably-safe shapes
+_GUARD_TAILS = {"clip", "clamp", "minimum", "maximum", "max", "min",
+                "where", "abs", "square", "softmax", "log_softmax",
+                "logsumexp", "sigmoid", "tanh", "log1p", "expm1",
+                "nan_to_num", "relu", "norm", "isfinite", "floor", "ceil"}
+# jax.random samplers that CONSUME a key (fold_in derives, PRNGKey
+# mints — neither consumes)
+_KEY_CONSUMERS = {"split", "normal", "uniform", "bernoulli", "categorical",
+                  "gumbel", "randint", "truncated_normal", "permutation",
+                  "choice", "exponential", "laplace", "bits", "gamma",
+                  "beta", "poisson", "dirichlet"}
+
+
+def _is_eps_name(node: ast.AST) -> bool:
+    """A Name/Attribute whose identifier looks like an epsilon."""
+    tail = None
+    if isinstance(node, ast.Name):
+        tail = node.id
+    elif isinstance(node, ast.Attribute):
+        tail = node.attr
+    return tail is not None and "eps" in tail.lower()
+
+
+def _is_small_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.UAdd):
+        return _is_small_literal(node.operand)
+    return isinstance(node, ast.Constant) \
+        and isinstance(node.value, (int, float))
+
+
+def _low_prec_cast(node: ast.AST) -> bool:
+    """Expression commits its result to bf16/fp16: ``.astype(jnp.
+    bfloat16)`` / ``.astype("float16")`` / ``dtype=jnp.bfloat16``."""
+    if not isinstance(node, ast.Call):
+        return False
+    def dt_low(arg: ast.AST) -> bool:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value in _LOW_PREC
+        chain = attr_chain(arg)
+        return bool(chain) and chain[-1] in _LOW_PREC
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args and dt_low(node.args[0]):
+        return True
+    return any(k.arg == "dtype" and dt_low(k.value) for k in node.keywords)
+
+
+def _has_widening(expr: ast.AST) -> bool:
+    """Expression routes through fp32+ somewhere (``.astype(jnp.
+    float32)``, ``jnp.float32(...)``, fp32 ``dtype=``)."""
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Call):
+            continue
+        if isinstance(n.func, ast.Attribute) and n.func.attr == "astype" \
+                and n.args:
+            chain = attr_chain(n.args[0])
+            if (chain and chain[-1] in _WIDEN_TAILS) or (
+                    isinstance(n.args[0], ast.Constant)
+                    and n.args[0].value in ("float32", "float64")):
+                return True
+        chain = attr_chain(n.func)
+        if chain and chain[-1] in _WIDEN_TAILS:
+            return True
+        for k in n.keywords:
+            if k.arg in _ACC_KWARGS:
+                kchain = attr_chain(k.value)
+                if not kchain or kchain[-1] not in _LOW_PREC:
+                    return True
+    return False
+
+
+def _low_prec_names(info) -> set[str]:
+    """Names this function commits to bf16/fp16: assigned from a
+    low-precision cast, or propagated through arithmetic on such a name
+    with no widening route (weak-typed Python scalars don't widen)."""
+    low: set[str] = set()
+
+    def expr_low(expr: ast.AST) -> bool:
+        if _has_widening(expr):
+            return False
+        for n in ast.walk(expr):
+            if _low_prec_cast(n):
+                return True
+            if isinstance(n, ast.Name) and n.id in low:
+                return True
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(info.node):
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets, value = [node.target], node.value
+            if value is None or not expr_low(value):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id not in low:
+                    low.add(t.id)
+                    changed = True
+    return low
+
+
+def _guarded_names(info) -> set[str]:
+    """Names assigned from expressions that carry a guard (clip /
+    maximum / + eps ...): dividing by such a name is safe."""
+    guarded: set[str] = set()
+    for node in ast.walk(info.node):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        if _expr_guarded(node.value, guarded):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    guarded.add(t.id)
+    return guarded
+
+
+def _expr_guarded(expr: ast.AST, guarded: set[str] = frozenset()) -> bool:
+    """Expression carries a clamp/eps/max guard somewhere inside."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            chain = attr_chain(n.func)
+            if chain and chain[-1] in _GUARD_TAILS:
+                return True
+        if isinstance(n, ast.BinOp) and isinstance(n.op, (ast.Add, ast.Sub)):
+            for side in (n.left, n.right):
+                if _is_small_literal(side) or _is_eps_name(side):
+                    return True
+        if isinstance(n, ast.Name) and n.id in guarded:
+            return True
+        if _is_eps_name(n):
+            return True
+    return False
+
+
+class LowPrecisionAccumulation(Rule):
+    id = "GL070"
+    name = "low-precision-accumulation"
+    summary = ("reduce/contraction (sum/mean/einsum/dot/softmax/norm) "
+               "over a bf16/fp16-committed value with no fp32 "
+               "accumulator route (preferred_element_type=, "
+               "precision=, dtype=, or a widening .astype)")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            low = _low_prec_names(info)
+            if not low:
+                continue
+            for node in ast.walk(info.node):
+                if ctx.index.enclosing_function(node) is not info.node:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                tail = None
+                if chain and chain[0] in ("jnp", "jax", "lax"):
+                    tail = chain[-1]
+                elif isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in low:
+                    tail = node.func.attr       # x.sum() / x.mean()
+                if tail not in _REDUCE_TAILS:
+                    continue
+                if any(k.arg in _ACC_KWARGS for k in node.keywords):
+                    continue
+                args = node.args
+                if chain and tail == "einsum" and len(args) > 1:
+                    args = args[1:]             # skip the equation
+                hit = None
+                for a in args:
+                    if _has_widening(a):
+                        continue
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Name) and n.id in low:
+                            hit = n.id
+                            break
+                    if hit:
+                        break
+                if isinstance(node.func, ast.Attribute) and not chain \
+                        and isinstance(node.func.value, ast.Name):
+                    hit = hit or node.func.value.id
+                if hit is None:
+                    continue
+                ctx.report(
+                    self.id, node,
+                    f"'{hit}' is committed to bf16/fp16 but this "
+                    f"'{tail}' has no fp32 accumulator: route through "
+                    "preferred_element_type=jnp.float32, precision=, "
+                    "an accumulator dtype=, or .astype(jnp.float32) "
+                    "before the reduce")
+
+
+class UnguardedTranscendental(Rule):
+    id = "GL071"
+    name = "unguarded-transcendental"
+    summary = ("exp/log/sqrt/rsqrt/division on a traced value with no "
+               "clamp/eps/max guard in the expression — NaN/Inf "
+               "factory in reduced precision")
+
+    _FNS = {"exp", "log", "sqrt", "rsqrt", "log2", "log10", "exp2"}
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            traced = ctx.index.traced_union(info)
+            guarded = _guarded_names(info)
+            for node in ast.walk(info.node):
+                if ctx.index.enclosing_function(node) is not info.node:
+                    continue
+                if isinstance(node, ast.Call):
+                    self._check_call(ctx, node, traced, guarded)
+                elif isinstance(node, ast.BinOp) \
+                        and isinstance(node.op, ast.Div):
+                    self._check_div(ctx, node, traced, guarded)
+
+    def _check_call(self, ctx, node, traced, guarded) -> None:
+        chain = attr_chain(node.func)
+        if not chain or chain[0] not in ("jnp", "jax", "lax"):
+            return
+        fn = chain[-1]
+        if fn not in self._FNS or not node.args:
+            return
+        arg = node.args[0]
+        if not ctx.index.mentions_device_value(arg, traced):
+            return
+        if _expr_guarded(arg, guarded):
+            return
+        if fn in ("exp", "exp2"):
+            # exp(x - m) / exp(-d) are the guarded idioms: any
+            # subtraction or negation bounds the argument above
+            for n in ast.walk(arg):
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+                    return
+                if isinstance(n, ast.UnaryOp) and isinstance(n.op, ast.USub):
+                    return
+        if fn in ("sqrt", "rsqrt"):
+            # sum of squares / x**2 is non-negative by construction
+            for n in ast.walk(arg):
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Pow):
+                    return
+        ctx.report(
+            self.id, node,
+            f"unguarded '{fn}' on a traced value: clamp the argument "
+            "(jnp.clip / jnp.maximum), add an eps, or subtract the max "
+            "before exponentiating")
+
+    def _check_div(self, ctx, node, traced, guarded) -> None:
+        den = node.right
+        # only flag denominators we can positively identify as traced
+        # and unguarded: a bare traced Name, or a jnp reduce call
+        if isinstance(den, ast.Name):
+            if den.id not in traced or den.id in guarded \
+                    or _is_eps_name(den):
+                return
+        elif isinstance(den, ast.Call):
+            chain = attr_chain(den.func)
+            if not chain or chain[0] not in ("jnp", "jax", "lax"):
+                return
+            if chain[-1] in _GUARD_TAILS or chain[-1] not in (
+                    "sum", "mean", "prod", "dot"):
+                return
+        else:
+            return
+        if not ctx.index.mentions_device_value(den, traced):
+            return
+        ctx.report(
+            self.id, node,
+            "division by an unguarded traced value: bound the "
+            "denominator away from zero (jnp.maximum(d, eps) / + eps)")
+
+
+class UnroundedNarrowingCast(Rule):
+    id = "GL072"
+    name = "unrounded-narrowing-cast"
+    summary = ("plain .astype to an 8-bit dtype on a traced value with "
+               "no rounding/clipping route — grad/wire values must go "
+               "through round+clip or stochastic_round before the cast")
+
+    _NARROW = {"int8", "uint8", "float8_e4m3fn", "float8_e5m2",
+               "float8_e4m3", "float8_e5m2fnuz", "float8_e4m3fnuz"}
+    _ROUND_TAILS = {"round", "rint", "clip", "floor", "ceil", "trunc",
+                    "stochastic_round", "quantize_int8", "quantize_fp8",
+                    "kv_quantize", "sign", "where"}
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            traced = ctx.index.traced_union(info)
+            for node in ast.walk(info.node):
+                if ctx.index.enclosing_function(node) is not info.node:
+                    continue
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr != "astype" or not node.args:
+                    continue
+                target = node.args[0]
+                narrow = False
+                if isinstance(target, ast.Constant) \
+                        and target.value in self._NARROW:
+                    narrow = True
+                else:
+                    chain = attr_chain(target)
+                    narrow = bool(chain) and chain[-1] in self._NARROW
+                if not narrow:
+                    continue
+                obj = node.func.value
+                if not ctx.index.mentions_device_value(obj, traced):
+                    continue
+                if self._rounded(obj, info, ctx):
+                    continue
+                ctx.report(
+                    self.id, node,
+                    "8-bit cast with no rounding route: .astype(int8/"
+                    "fp8) truncates toward zero — round+clip first "
+                    "(quantize_int8 / stochastic_round, cf. "
+                    "zero_quantized_rounding)")
+
+    def _rounded(self, obj: ast.AST, info, ctx) -> bool:
+        for n in ast.walk(obj):
+            if isinstance(n, ast.Call):
+                chain = attr_chain(n.func)
+                if chain and chain[-1] in self._ROUND_TAILS:
+                    return True
+        # a bare name: accept when IT was assigned through a rounding
+        # route anywhere in the function (codes out of a quantizer)
+        if isinstance(obj, ast.Name):
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == obj.id
+                        for t in node.targets):
+                    for n in ast.walk(node.value):
+                        if isinstance(n, ast.Call):
+                            chain = attr_chain(n.func)
+                            if chain and chain[-1] in self._ROUND_TAILS:
+                                return True
+        return False
+
+
+class PRNGKeyReuse(Rule):
+    id = "GL073"
+    name = "prng-key-reuse"
+    summary = ("the same PRNG key reaches two sampling/rounding call "
+               "sites with no split/reassignment between them — "
+               "correlated noise breaks the determinism contract")
+
+    def check(self, ctx: Context) -> None:
+        for info in ctx.index.reachable_functions():
+            self._check_function(ctx, info)
+
+    # -- key identification ----------------------------------------
+    @staticmethod
+    def _key_id(node: ast.AST) -> Optional[str]:
+        """Stable identifier for a key operand: a bare Name or a
+        Name[int-literal] subscript; None for anything else."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name) \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, int):
+            return f"{node.value.id}[{node.slice.value}]"
+        return None
+
+    @classmethod
+    def _consumed_key(cls, call: ast.Call) -> Optional[str]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if len(chain) >= 2 and chain[-2] == "random" \
+                and chain[-1] in _KEY_CONSUMERS and call.args:
+            return cls._key_id(call.args[0])
+        if chain[-1] == "stochastic_round":
+            for k in call.keywords:
+                if k.arg == "key":
+                    return cls._key_id(k.value)
+            if len(call.args) >= 2:
+                return cls._key_id(call.args[1])
+        return None
+
+    # -- branch-awareness ------------------------------------------
+    def _branch_path(self, ctx, node: ast.AST):
+        """(id(If), arm) ancestry so two uses in MUTUALLY EXCLUSIVE
+        arms of one If never conflict."""
+        path = []
+        cur = ctx.index.parent(node)
+        child = node
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(cur, ast.If):
+                arm = "body" if self._in_list(cur.body, child) else "orelse"
+                path.append((id(cur), arm))
+            child = cur
+            cur = ctx.index.parent(cur)
+        return path
+
+    @staticmethod
+    def _in_list(stmts, node) -> bool:
+        for s in stmts:
+            if s is node or any(n is node for n in ast.walk(s)):
+                return True
+        return False
+
+    @staticmethod
+    def _exclusive(p1, p2) -> bool:
+        d1, d2 = dict(p1), dict(p2)
+        return any(d1.get(k) != arm for k, arm in d2.items() if k in d1)
+
+    def _check_function(self, ctx, info) -> None:
+        events = []      # (lineno, kind, key_id, node)
+        for node in ast.walk(info.node):
+            if ctx.index.enclosing_function(node) is not info.node:
+                continue
+            if isinstance(node, ast.Call):
+                kid = self._consumed_key(node)
+                if kid is not None:
+                    events.append((node.lineno, "use", kid, node))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for name in self._names_in_target(t):
+                        events.append((node.lineno, "redef", name, node))
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                lineno = getattr(node, "lineno",
+                                 getattr(node.target, "lineno", 0))
+                for name in self._names_in_target(node.target):
+                    events.append((lineno, "redef", name, node))
+        events.sort(key=lambda e: e[0])
+        last_use: dict = {}
+        for lineno, kind, kid, node in events:
+            if kind == "redef":
+                last_use.pop(kid, None)
+                # redefining `ks` invalidates every tracked ks[i]
+                for k in [k for k in last_use if k.startswith(f"{kid}[")]:
+                    last_use.pop(k, None)
+                continue
+            prev = last_use.get(kid)
+            if prev is not None and not self._exclusive(
+                    self._branch_path(ctx, prev), self._branch_path(ctx, node)):
+                ctx.report(
+                    self.id, node,
+                    f"PRNG key '{kid}' already consumed at line "
+                    f"{prev.lineno} with no split/reassignment since: "
+                    "derive fresh keys (jax.random.split / fold_in) "
+                    "per call site")
+            else:
+                if prev is None and ctx.index.in_loop(node) \
+                        and not self._redef_in_loop(ctx, node, kid):
+                    ctx.report(
+                        self.id, node,
+                        f"PRNG key '{kid}' consumed inside a loop "
+                        "without a per-iteration split/fold_in: every "
+                        "iteration samples identical noise")
+            last_use[kid] = node
+
+    @staticmethod
+    def _names_in_target(t: ast.AST) -> list[str]:
+        out = []
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                out.extend(PRNGKeyReuse._names_in_target(e))
+        elif isinstance(t, ast.Starred):
+            out.extend(PRNGKeyReuse._names_in_target(t.value))
+        return out
+
+    def _redef_in_loop(self, ctx, node, kid) -> bool:
+        base = kid.split("[")[0]
+        cur = ctx.index.parent(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(cur, (ast.For, ast.While)):
+                for n in ast.walk(cur):
+                    if isinstance(n, (ast.Assign, ast.AugAssign)):
+                        targets = (n.targets if isinstance(n, ast.Assign)
+                                   else [n.target])
+                        for t in targets:
+                            if base in self._names_in_target(t):
+                                return True
+                    if isinstance(n, ast.For) and base in \
+                            self._names_in_target(n.target):
+                        return True
+                return False
+            cur = ctx.index.parent(cur)
+        return False
+
+
+RULES = [LowPrecisionAccumulation(), UnguardedTranscendental(),
+         UnroundedNarrowingCast(), PRNGKeyReuse()]

@@ -263,6 +263,46 @@ class InferenceMeshsanConfig(DeepSpeedConfigModel):
     axes: Optional[list[str]] = None
 
 
+class InferenceNumsanConfig(DeepSpeedConfigModel):
+    """numsan numerics sanitizer, serving side (ISSUE 18,
+    ``analysis/numsan.py`` — the runtime half of the numlint
+    GL070-GL073 static pass; the training-side block is ``numsan`` in
+    runtime/config.py). Probes are opt-in and cadence-gated:
+
+    - every ``probe_interval``-th per-tick dispatch checks the batch
+      logits for non-finite values and for ``|logit| > logits_limit``
+      (the pre-NaN saturation signature of a mis-scaled KV cache) — a
+      small fused reduction plus one host sync on the probe cadence;
+    - with a quantized KV cache, the same cadence audits the scale
+      slabs (``pools["ks"]/["vs"]``) for non-finite scales
+      (``kv_scale_probe``);
+    - every quantize site armed at trace time (the KV write,
+      ``ops/pallas/quantization.saturation_probe``) reports its
+      saturating-code fraction to ``ds_numsan_saturation_ratio{site}``;
+      a fraction above ``saturation_ceiling`` is a finding, raised at
+      the next dispatch boundary (``drain``).
+
+    Off by default — nothing imported, executables byte-identical. Env
+    ``DS_NUMSAN=1`` force-enables (the conftest/CI opt-in knob). Rule
+    catalog + probe cost model: docs/static-analysis.md,
+    "Numerics"."""
+    enabled: bool = False
+    mode: Literal["raise", "warn"] = "raise"
+    # |logit| beyond this is a "logits-range" finding
+    logits_limit: float = Field(1e4, gt=0.0)
+    # check logits / KV scales every N-th per-tick dispatch (each
+    # check costs one host sync)
+    probe_interval: int = Field(16, ge=1)
+    # audit the quantized KV scale slabs on the probe cadence
+    kv_scale_probe: bool = True
+    # saturating-code fraction above this is a finding; the healthy
+    # baseline is ~1/head_dim (each written vector's absmax lands
+    # exactly on the clip boundary)
+    saturation_ceiling: float = Field(0.05, ge=0.0, le=1.0)
+    # arm the in-graph quantize-site probes (KV write) at trace time
+    saturation_probe: bool = True
+
+
 class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     """reference: inference/v2/config_v2.py RaggedInferenceEngineConfig
     (state_manager block/pool sizing knobs + the fused-decode loop)."""
@@ -327,6 +367,11 @@ class RaggedInferenceEngineConfig(DeepSpeedInferenceConfig):
     # docs/static-analysis.md, "SPMD correctness")
     meshsan: InferenceMeshsanConfig = Field(
         default_factory=InferenceMeshsanConfig)
+    # numsan numerics sanitizer (ISSUE 18): logits-range / KV-scale
+    # probes + quantize-site saturation attribution (see
+    # docs/static-analysis.md, "Numerics")
+    numsan: InferenceNumsanConfig = Field(
+        default_factory=InferenceNumsanConfig)
 
 
 class InferenceEngineV2:
@@ -512,6 +557,27 @@ class InferenceEngineV2:
             for fam in ("v2/dispatch", "v2/fused_dispatch"):
                 self._meshsan.declare(fam, contract)
             _msan.set_meshsan(self._meshsan)
+        # numsan (ISSUE 18): logits-range / KV-scale probes on the
+        # dispatch path + trace-time-armed quantize-site saturation
+        # attribution (the KV write probe in paged.py). Opt-in, lazily
+        # imported; the off path traces byte-identical executables.
+        self._numsan = None
+        self._numsan_dispatches = 0
+        self._logits_stats_fn = None
+        ns = config.numsan
+        self._numsan_kv_probe = bool(ns.kv_scale_probe)
+        if ns.enabled or os.environ.get("DS_NUMSAN", "") \
+                not in ("", "0"):
+            from ...analysis import numsan as _nsan
+            self._numsan = _nsan.NumericsSanitizer(
+                mode=ns.mode,
+                saturation_ceiling=ns.saturation_ceiling,
+                logits_limit=ns.logits_limit,
+                probe_interval=ns.probe_interval,
+                saturation_probe=ns.saturation_probe)
+            # registered process-wide: the quantize-site probes and
+            # hang-watchdog dumps read it back without an engine ref
+            _nsan.set_numsan(self._numsan)
         # serving counters behind serving_metrics(): host dispatches vs
         # decoded tokens measures how host-free the decode loop is.
         # Schema-driven (SERVING_COUNTER_KEYS) so reset/emission can
@@ -587,6 +653,8 @@ class InferenceEngineV2:
             # prefix cache: blocks this chunk completed are now fully in
             # the pool — index them for reuse (no-op when disabled)
             mgr.publish_full_blocks(seq)
+        if self._numsan is not None:
+            self._numsan_probe(logits[:len(seqs)])
         return logits[:len(seqs)]
 
     # ------------------------------------------------------------------
@@ -1248,6 +1316,16 @@ class InferenceEngineV2:
         if tel is not None:
             self._record_dispatch_telemetry(
                 tel, time.perf_counter() - t0)
+        if self._numsan is not None:
+            # the fused loop returns tokens, not logits — the numsan
+            # work here is the dispatch-boundary choke point: cadenced
+            # KV-scale audit, then surface any deferred quantize-site
+            # saturation findings from the executed loop
+            self._numsan_dispatches += 1
+            if (self._numsan_dispatches
+                    % self._numsan.probe_interval == 0):
+                self.numsan_check_kv_pools()
+            self._numsan.drain()
         return res
 
     def _absorb_spec_stats(self, stats) -> None:
@@ -1258,6 +1336,41 @@ class InferenceEngineV2:
         self.serving_stats["spec_accepted_tokens"] += int(stats[1])
         self.serving_stats["spec_hit_slots"] += int(stats[2])
         self.serving_stats["fused_live_slots"] += int(stats[3])
+
+    def _numsan_probe(self, logits) -> None:
+        """Per-tick dispatch numsan hook: every ``probe_interval``-th
+        dispatch runs the fused logits stats (non-finite count +
+        masked max|logit|) and, with a quantized cache, the KV-scale
+        audit — one host sync on the cadence; then drains any deferred
+        quantize-site saturation findings (always, pure host work)."""
+        san = self._numsan
+        self._numsan_dispatches += 1
+        if self._numsan_dispatches % san.probe_interval == 0:
+            if self._logits_stats_fn is None:
+                self._logits_stats_fn = jax.jit(lambda x: (
+                    jnp.sum(~jnp.isfinite(x)).astype(jnp.int32),
+                    jnp.max(jnp.where(jnp.isfinite(x),
+                                      jnp.abs(x), 0.0))))
+            nf, ma = self._logits_stats_fn(logits)
+            san.check_logits("v2/dispatch", int(nf), float(ma))
+            self.numsan_check_kv_pools()
+        san.drain()
+
+    def numsan_check_kv_pools(self) -> None:
+        """Audit the quantized KV scale slabs for non-finite scales (a
+        non-finite activation quantized into the cache poisons every
+        later read of its block). Rides the numsan probe cadence;
+        callable directly for forensics. No-op without a quantized
+        cache or with ``kv_scale_probe`` off."""
+        if (self._numsan is None or not self._kv_quant
+                or not self._numsan_kv_probe):
+            return
+        scales = jnp.concatenate([self.pools["ks"].reshape(-1),
+                                  self.pools["vs"].reshape(-1)])
+        finite = jnp.isfinite(scales)
+        nf = int(jnp.sum(~finite))
+        ms = float(jnp.max(jnp.where(finite, scales, 0.0)))
+        self._numsan.check_kv_scales("v2/kv_pools", nf, ms)
 
     def _device_truth_observe(self, tel, name: str, fn,
                               dev_ops: tuple) -> None:

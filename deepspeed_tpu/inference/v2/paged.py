@@ -434,6 +434,10 @@ def paged_forward(model, params: PyTree, pools: PyTree, tokens: jax.Array,
         hs = pools["ks"].shape[-1]
         qk, sk = kv_quantize(new_k, kv_dtype, hs)      # [L,B,S,H(s)]
         qv, sv = kv_quantize(new_v, kv_dtype, hs)
+        from ...ops.pallas.quantization import KV_QMAX, saturation_probe
+        # numsan probe on the k codes (k and v share scale granularity;
+        # one fused reduction keeps the armed-probe cost at one pass)
+        saturation_probe("kv_write", qk, qmax=KV_QMAX[kv_dtype])
         new_pools = {
             "k": pools["k"].at[:, blk, off].set(qk, mode="drop"),
             "v": pools["v"].at[:, blk, off].set(qv, mode="drop"),

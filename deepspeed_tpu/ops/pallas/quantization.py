@@ -224,6 +224,36 @@ def dequantize_fp8(q, s, meta):
                          dtype=dtype)
 
 
+def saturation_probe(site: str, codes, qmax: float = 127.0) -> None:
+    """numsan quantize-site probe (ISSUE 18): when a
+    :class:`..analysis.numsan.NumericsSanitizer` with saturation
+    probing is active AT TRACE TIME, fold one tiny fused reduction —
+    the fraction of codes sitting on the clip boundary — into the
+    caller's graph and ship it off-device through
+    ``jax.debug.callback`` (the moe/dispatch router-telemetry pattern)
+    into ``NumericsSanitizer.report_saturation`` →
+    ``ds_numsan_saturation_ratio{site}``. Arming is read through a
+    ``sys.modules`` lookup, so a sanitizer-off process imports nothing
+    and the traced graph is byte-identical; findings (fraction above
+    the configured ceiling) are deferred to the next host
+    :meth:`drain` — a callback thread cannot usefully raise."""
+    import sys
+    mod = sys.modules.get("deepspeed_tpu.analysis.numsan")
+    san = mod.get_numsan() if mod is not None else None
+    if san is None or not getattr(san, "saturation_probe", False):
+        return
+    frac = jnp.mean((jnp.abs(codes.astype(jnp.float32))
+                     >= float(qmax)).astype(jnp.float32))
+
+    def _emit(f, _site=site):
+        m = sys.modules.get("deepspeed_tpu.analysis.numsan")
+        s = m.get_numsan() if m is not None else None
+        if s is not None:
+            s.report_saturation(_site, float(f))
+
+    jax.debug.callback(_emit, frac)
+
+
 def wire_bytes_per_element(wire_dtype: str, block: int = QBLOCK) -> float:
     """Effective wire bytes per payload element, per-block fp32 scales
     included — the single number the autotuning cost model and the
@@ -260,6 +290,8 @@ def quantized_all_gather(x, axes, dim: int = 0, wire_dtype: str = "int8"):
 
     quant, dequant = _wire_quantizer(wire_dtype)
     q, s, meta = quant(x)
+    saturation_probe("qwz_wire", q,
+                     qmax=448.0 if wire_dtype == "fp8" else 127.0)
     qg = lax.all_gather(q, axes, axis=0, tiled=False)
     sg = lax.all_gather(s, axes, axis=0, tiled=False)
     if wire_dtype == "fp8":

@@ -40,7 +40,8 @@ import numpy as np
 from jax import lax
 
 from ...ops.pallas.quantization import (QBLOCK, quantize_fp8,
-                                        quantize_int8, stochastic_round)
+                                        quantize_int8, saturation_probe,
+                                        stochastic_round)
 
 
 def _flat_padded(t: jax.Array, world: int, block: int = 1) -> jax.Array:
@@ -75,17 +76,21 @@ def _axis_key(seed, axes: tuple[str, ...], salt: int):
     return key
 
 
-def _quant_rows(rows, wire_dtype: str, rounding: str, key):
+def _quant_rows(rows, wire_dtype: str, rounding: str, key,
+                site: str = "qgz_wire"):
     """Block-quantize each row of ``rows`` [n, c] independently ->
     (codes [n, nb, QBLOCK], scales [n, nb, 1]). Rows are padded to a
     block multiple inside the per-row quantizer; callers that must
     keep rows block-aligned across ranks pad with _flat_padded
-    first."""
+    first. ``site`` labels the numsan saturation probe (no-op unless a
+    sanitizer is armed at trace time)."""
     if wire_dtype == "fp8":
         def q1(c):
             q, s, _ = quantize_fp8(c)
             return q, s
-        return jax.vmap(q1)(rows)
+        q, s = jax.vmap(q1)(rows)
+        saturation_probe(site, q, qmax=448.0)
+        return q, s
     if rounding == "stochastic":
         # quantize all rows under ONE key: the uniform draw is shaped
         # like the whole [n, blocks] tensor, so each block still gets
@@ -98,20 +103,24 @@ def _quant_rows(rows, wire_dtype: str, rounding: str, key):
         s = jnp.maximum(amax / 127.0, 1e-12)
         q = jnp.clip(stochastic_round(blocks / s, key),
                      -127, 127).astype(jnp.int8)
+        saturation_probe(site, q)
         return q, s
 
     def q1(c):
         q, s, _ = quantize_int8(c, use_pallas=False)
         return q, s
-    return jax.vmap(q1)(rows)
+    q, s = jax.vmap(q1)(rows)
+    saturation_probe(site, q)
+    return q, s
 
 
 def _exchange_reduce(rows, axes: tuple[str, ...], wire_dtype: str,
-                     rounding: str, key) -> jax.Array:
+                     rounding: str, key,
+                     site: str = "qgz_wire") -> jax.Array:
     """One hop of qgZ: quantize ``rows`` [world, c] (row i is the chunk
     destined for group rank i), all-to-all the codes + scales along
     ``axes``, dequantize and SUM the received chunks -> [c]."""
-    q, s = _quant_rows(rows, wire_dtype, rounding, key)
+    q, s = _quant_rows(rows, wire_dtype, rounding, key, site=site)
     qx = lax.all_to_all(q, axes, split_axis=0, concat_axis=0, tiled=True)
     sx = lax.all_to_all(s, axes, split_axis=0, concat_axis=0, tiled=True)
     deq = qx.astype(jnp.float32) * sx            # [world, nb, QBLOCK]
@@ -122,7 +131,7 @@ def _exchange_reduce(rows, axes: tuple[str, ...], wire_dtype: str,
 def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
                              dim: int, wire_dtype: str = "int8",
                              rounding: str = "nearest",
-                             seed=0) -> jax.Array:
+                             seed=0, site: str = "qgz_wire") -> jax.Array:
     """qgZ: chunk `g` (full-size local gradient) along `dim`, quantize
     each chunk, exchange with one int8/fp8 all-to-all, dequantize + sum
     received chunks. Returns this device's gradient shard (SUM
@@ -140,7 +149,8 @@ def quantized_reduce_scatter(g: jax.Array, axes: tuple[str, ...],
     key = (_axis_key(seed, axes, salt=0x9c2)
            if rounding == "stochastic" else None)
     rows = chunks.reshape(world, -1)
-    summed = _exchange_reduce(rows, axes, wire_dtype, rounding, key)
+    summed = _exchange_reduce(rows, axes, wire_dtype, rounding, key,
+                              site=site)
     m = chunks.shape[1:]
     return summed[: int(np.prod(m))].reshape(m).astype(g.dtype)
 
@@ -149,7 +159,7 @@ def hierarchical_quantized_reduce_scatter(
         g: jax.Array, outer_axes: tuple[str, ...],
         inner_axes: tuple[str, ...], dim: int,
         wire_dtype: str = "int8", rounding: str = "nearest",
-        seed=0) -> jax.Array:
+        seed=0, site: str = "qgz_wire") -> jax.Array:
     """Two-hop qgZ over a hierarchically split shard group (outer =
     slow inter-group links, e.g. ``fsdp``; inner = fast intra-group
     links, e.g. ``zps``).
@@ -177,7 +187,7 @@ def hierarchical_quantized_reduce_scatter(
     # hop 1 (fast links): for each outer-major chunk, exchange the
     # inner-minor pieces and reduce over the inner group
     rows = arr.reshape(n_outer * n_inner, c)
-    q, s = _quant_rows(rows, wire_dtype, rounding, k1)
+    q, s = _quant_rows(rows, wire_dtype, rounding, k1, site=site)
     q = q.reshape((n_outer, n_inner) + q.shape[1:])
     s = s.reshape((n_outer, n_inner) + s.shape[1:])
     qx = lax.all_to_all(q, inner_axes, split_axis=1, concat_axis=1,
@@ -189,7 +199,7 @@ def hierarchical_quantized_reduce_scatter(
     # hop 2 (slow links): exchange the reduced partials over the outer
     # group — 1/inner of the one-hop slow-link payload
     shard = _exchange_reduce(partial, outer_axes, wire_dtype, rounding,
-                             k2)
+                             k2, site=site)
     out = shard.reshape((d // (n_outer * n_inner),) + rest)
     return jnp.moveaxis(out, 0, dim).astype(g.dtype)
 

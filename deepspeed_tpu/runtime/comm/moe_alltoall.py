@@ -59,10 +59,10 @@ def _quantized_dispatch_fn(outer_axes: tuple[str, ...],
         if outer_axes and inner_axes:
             return hierarchical_quantized_reduce_scatter(
                 x, outer_axes, inner_axes, dim, wire_dtype=wire_dtype,
-                rounding=rounding, seed=seed)
+                rounding=rounding, seed=seed, site="moe_dispatch")
         return quantized_reduce_scatter(
             x, axes, dim, wire_dtype=wire_dtype, rounding=rounding,
-            seed=seed)
+            seed=seed, site="moe_dispatch")
 
     @jax.custom_vjp
     def exchange(x, seed):

@@ -321,6 +321,37 @@ class MeshsanConfig(DeepSpeedConfigModel):
     wire_min_bytes: int = Field(65536, ge=0)
 
 
+class NumsanConfig(DeepSpeedConfigModel):
+    """Runtime numerics sanitizer (ISSUE 18,
+    ``deepspeed_tpu/analysis/numsan.py`` — the runtime half of the
+    GL070-GL073 numerics lint). When enabled the compiled train step
+    folds per-leaf non-finite counts + max|g| into the fused reduction
+    that already computes the overflow bit, so a blown-up step becomes
+    a named finding carrying the executable's ledger name and the
+    worst leaf's PyTree path (instead of one anonymous bit feeding the
+    loss scaler); every quantize site (KV write, qgZ wire, MoE
+    dispatch) additionally reports its saturating-code fraction to the
+    ``ds_numsan_saturation_ratio{site}`` gauge via a trace-time-armed
+    ``jax.debug.callback``, and a fraction above ``saturation_ceiling``
+    is a finding. Violations bump ``ds_numsan_violations_total{kind}``
+    and the sanitizer's state rides hang-watchdog dumps next to
+    blocksan's/meshsan's sections. Off by default — nothing is
+    imported and every executable stays byte-identical. Env
+    ``DS_NUMSAN=1`` force-enables (the conftest/CI opt-in knob). See
+    docs/static-analysis.md, "Numerics"."""
+    enabled: bool = False
+    # "raise" fails fast (tests/bench); "warn" logs, counts, and keeps
+    # training (violations still reach ds_numsan_violations_total)
+    mode: Literal["raise", "warn"] = "raise"
+    # saturating-code fraction above which a quantize site is a
+    # finding (the healthy baseline is ~1/block_size: the block max
+    # lands exactly on the clip boundary by construction)
+    saturation_ceiling: float = Field(0.05, ge=0.0, le=1.0)
+    # arm the quantize-site jax.debug.callback probes (qgZ wire, MoE
+    # dispatch; adds one small fused reduction per armed site)
+    saturation_probe: bool = True
+
+
 class MoEConfig(DeepSpeedConfigModel):
     """Expert-parallel MoE training (ISSUE 16, docs/moe.md). Routes the
     dispatch/combine token shuffle of an MoE model (``num_experts > 0``)
@@ -484,6 +515,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     sentinels: SentinelsConfig = Field(default_factory=SentinelsConfig)
     meshsan: MeshsanConfig = Field(default_factory=MeshsanConfig)
+    numsan: NumsanConfig = Field(default_factory=NumsanConfig)
     moe: MoEConfig = Field(default_factory=MoEConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)

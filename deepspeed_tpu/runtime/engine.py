@@ -39,7 +39,8 @@ from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                            STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
                            ThroughputTimer, TRAIN_BATCH_TIMER)
 from .config import DeepSpeedConfig
-from .loss_scaler import LossScaleState, init_loss_scale, update_loss_scale
+from .loss_scaler import (LossScaleState, grads_finite, init_loss_scale,
+                          update_loss_scale)
 from .lr_schedules import LRSchedulerShim, build_schedule
 from .optimizers import build_optimizer
 from .zero import ZeroShardingPlan
@@ -298,6 +299,31 @@ class DeepSpeedEngine:
                 "thread compressor.activation_quantizer() through the "
                 "model's forward (weight-side techniques apply "
                 "automatically)")
+
+        # numsan (ISSUE 18): per-leaf gradient finiteness attribution +
+        # quantize-site saturation probes. Opt-in via config or
+        # DS_NUMSAN=1; lazily imported so a sanitizer-off process never
+        # loads analysis/numsan and every executable stays
+        # byte-identical. Initialized BEFORE the compiled step is built:
+        # the step folds the per-leaf stats into its metrics, and the
+        # quantize-site probes (qgZ wire, MoE dispatch) arm themselves
+        # at trace time off the process-wide handle. The per-leaf check
+        # is deferred one dispatch (_numsan_feed), so the steady-state
+        # pipeline never gains a sync.
+        self._numsan = None
+        self._numsan_pending = None
+        self._numsan_leaf_paths = None
+        ns_cfg = self.config.numsan
+        if ns_cfg.enabled or os.environ.get("DS_NUMSAN", "") \
+                not in ("", "0"):
+            from ..analysis import numsan as _nsan
+            self._numsan = _nsan.NumericsSanitizer(
+                mode=ns_cfg.mode,
+                saturation_ceiling=ns_cfg.saturation_ceiling,
+                saturation_probe=ns_cfg.saturation_probe)
+            # registered process-wide so the trace-time probes and
+            # hang-watchdog dumps can reach it without an engine ref
+            _nsan.set_numsan(self._numsan)
 
         # --- compiled step ----------------------------------------------
         def _loss_on_device(params, batch):
@@ -637,6 +663,11 @@ class DeepSpeedEngine:
         fetch = fetch_to_device
         compress = (self.compressor.transform
                     if self.compressor is not None else None)
+        # numsan (ISSUE 18): fold per-leaf non-finite counts + max|g|
+        # into the step's metrics — one extra fused reduction over the
+        # grads the step already holds; absent (byte-identical
+        # executable) when the sanitizer is off
+        numsan_stats = self._numsan is not None
 
         def micro_loss(params, batch, scale, step):
             if compress is not None:
@@ -684,12 +715,11 @@ class DeepSpeedEngine:
             inv = 1.0 / (scale * ga)
             grads = jax.tree.map(lambda g: g * inv, grads)
 
-            # overflow check (reference: stage_1_and_2.py:1997 CheckOverflow)
+            # overflow check (loss_scaler.grads_finite: the shared
+            # fused reduction; numsan's per-leaf stats extend it below)
             finite = jnp.array(True)
             if fp16:
-                leaves = jax.tree.leaves(
-                    jax.tree.map(lambda g: jnp.isfinite(g).all(), grads))
-                finite = functools.reduce(jnp.logical_and, leaves)
+                finite = grads_finite(grads)
 
             # global grad norm + clip (reference: runtime/utils.py
             # clip_grad_norm_)
@@ -737,6 +767,14 @@ class DeepSpeedEngine:
                 "loss_scale": ls.scale,
                 "overflow": ~finite,
             }
+            if numsan_stats:
+                gl = jax.tree.leaves(grads)
+                metrics["numsan_nonfinite"] = jnp.stack(
+                    [jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+                     for g in gl])
+                metrics["numsan_maxabs"] = jnp.stack(
+                    [jnp.max(jnp.abs(g)).astype(jnp.float32)
+                     for g in gl])
             return new_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0,),
@@ -789,9 +827,7 @@ class DeepSpeedEngine:
 
             finite = jnp.array(True)
             if fp16:
-                leaves = jax.tree.leaves(
-                    jax.tree.map(lambda g: jnp.isfinite(g).all(), grads))
-                finite = functools.reduce(jnp.logical_and, leaves)
+                finite = grads_finite(grads)
             sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
             grad_norm = jnp.sqrt(sq)
 
@@ -908,6 +944,8 @@ class DeepSpeedEngine:
             self.global_steps += 1
             self.global_samples += self.train_batch_size_
             self._last_metrics = metrics
+            if self._numsan is not None:
+                self._numsan_feed(metrics)
             if self.global_steps % self.config.steps_per_print == 0:
                 self.tput_timer.stop(sync=metrics["loss"])
                 self._report(metrics)
@@ -969,6 +1007,62 @@ class DeepSpeedEngine:
         device counter, so callers should be paths that already sync
         (monitor writes, user accessors) — not the hot step loop."""
         return int(self.state["step"])
+
+    @property
+    def overflow_steps(self) -> int:
+        """Steps skipped on fp16 overflow, derived from device truth:
+        every step path advances ``state["step"]`` only on finite
+        grads, so the gap to ``global_steps`` IS the overflow count —
+        no per-step host pull needed on the compiled path (unlike
+        ``skipped_steps``, which only the eager/offload paths tally).
+        Reading this syncs on the step counter; callers are boundary
+        paths (telemetry bridges, accessors), not the hot loop."""
+        try:
+            return max(0, self.global_steps - int(self.state["step"]))
+        except Exception:
+            return self.skipped_steps
+
+    # --- numsan (ISSUE 18) --------------------------------------------
+    def _numsan_feed(self, metrics):
+        """Queue this step's per-leaf grad stats and check the
+        PREVIOUS step's — already materialized by the donated-state
+        pipeline (the dispatch just issued blocks on it anyway), so
+        steady-state checking never adds a device sync. Also drains
+        any saturation findings the in-graph quantize-site probes
+        deferred from the callback thread."""
+        pending, self._numsan_pending = self._numsan_pending, metrics
+        if pending is not None:
+            self._numsan_check(pending)
+        self._numsan.drain()
+
+    def _numsan_check(self, metrics):
+        nf = metrics.get("numsan_nonfinite")
+        if nf is None:
+            return
+        if self._numsan_leaf_paths is None:
+            # grads mirror the params treedef; keystr paths pair with
+            # the fused reduction's leaf-order vectors
+            self._numsan_leaf_paths = [
+                jax.tree_util.keystr(p) for p, _ in
+                jax.tree_util.tree_leaves_with_path(self.state["params"])]
+        ls = metrics.get("loss_scale")
+        self._numsan.check_grad_vectors(
+            "compiled_step", self._numsan_leaf_paths,
+            np.asarray(nf).tolist(),
+            np.asarray(metrics["numsan_maxabs"]).tolist(),
+            loss_scale=float(ls) if ls is not None else None)
+
+    def numsan_drain(self):
+        """Check any queued per-leaf stats NOW (the deferred-by-one
+        pipeline otherwise leaves a run's final step unchecked) and
+        raise pending in-graph findings. Test/boundary hook; no-op
+        when numsan is off."""
+        if self._numsan is None:
+            return
+        pending, self._numsan_pending = self._numsan_pending, None
+        if pending is not None:
+            self._numsan_check(pending)
+        self._numsan.drain()
 
     def _report(self, metrics):
         lr = float(self.lr_schedule(self._applied_steps()))
@@ -1303,9 +1397,7 @@ class DeepSpeedEngine:
             grads = jax.tree.map(lambda g: g * inv, grads)
             finite = jnp.array(True)
             if fp16:
-                leaves = jax.tree.leaves(
-                    jax.tree.map(lambda g: jnp.isfinite(g).all(), grads))
-                finite = functools.reduce(jnp.logical_and, leaves)
+                finite = grads_finite(grads)
             sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
             grad_norm = jnp.sqrt(sq)
             if clip > 0:

@@ -9,8 +9,10 @@ consecutive good steps, double it.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -34,6 +36,21 @@ def init_loss_scale(config) -> LossScaleState:
         good_steps=jnp.asarray(0, jnp.int32),
         hysteresis=jnp.asarray(config.hysteresis, jnp.int32),
     )
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """The overflow bit: one fused all-leaves ``isfinite`` reduction
+    over a gradient pytree (reference: stage_1_and_2.py:1997
+    CheckOverflow) — shared by every engine step builder so the skip /
+    backoff semantics can never drift between paths. This bit is
+    anonymous by design (it must stay one scalar on the hot path);
+    when the numsan sanitizer is armed (``analysis/numsan.py``,
+    ISSUE 18) the engine extends the same reduction with per-leaf
+    non-finite counts and max|g| so an overflow step also names the
+    worst leaf instead of only halving the scale."""
+    leaves = jax.tree.leaves(
+        jax.tree.map(lambda g: jnp.isfinite(g).all(), grads))
+    return functools.reduce(jnp.logical_and, leaves, jnp.array(True))
 
 
 def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray, *,

@@ -273,6 +273,15 @@ def record_train_step(reg: MetricsRegistry, engine, metrics) -> None:
     reg.counter("ds_train_skipped_steps_total",
                 "overflow-skipped optimizer steps").set_total(
         engine.skipped_steps)
+    # device-truth overflow count (ISSUE 18): global_steps minus the
+    # on-device applied-step counter — covers the compiled path, which
+    # never tallies skipped_steps on the host
+    ov = getattr(engine, "overflow_steps", None)
+    if ov is not None:
+        reg.counter("ds_overflow_steps_total",
+                    "fp16 overflow steps (optimizer update skipped, "
+                    "loss scale backed off) — derived from the "
+                    "on-device applied-step counter").set_total(int(ov))
     if metrics:
         if "loss" in metrics:
             reg.gauge("ds_train_loss", "last reported loss").set(

@@ -219,6 +219,19 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
     except Exception as e:   # noqa: BLE001
         doc["meshsan_error"] = repr(e)
     try:
+        # numsan numerics state (ISSUE 18): when the numerics
+        # sanitizer is active, the dump carries its counters, recent
+        # findings, any deferred (not-yet-drained) quantize-site
+        # saturation findings and the last/max saturation per site —
+        # a hang that follows an fp16 death spiral or a clipping
+        # quantizer is attributable from the artifact alone
+        from ..analysis.numsan import get_numsan
+        nsan = get_numsan()
+        if nsan is not None:
+            doc["numsan"] = nsan.snapshot()
+    except Exception as e:   # noqa: BLE001
+        doc["numsan_error"] = repr(e)
+    try:
         # fleet health (ISSUE 17): when the failure detector is
         # active, the dump says what the health plane believed about
         # every replica at the moment of the hang — phi, score, state,

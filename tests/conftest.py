@@ -238,6 +238,13 @@ _SLOW = {
     # the real-engine sharded-DP train run is the heavy tail
     ("test_meshsan.py",
      "test_engine_seeded_meshsan_contract_matches_training_traffic"),
+    # numsan (ISSUE 18): the seeded-stats/probe/saturation unit tests
+    # stay tier-1 (host-only, no engine); the engine-building
+    # seeded-fault acceptance runs are the heavy tail
+    ("test_numsan.py", "test_engine_seeded_nan_grad_attribution"),
+    ("test_numsan.py", "test_engine_fp16_overflow_counter_and_bridge"),
+    ("test_numsan.py", "test_v2_kv_write_saturation_site_gauge_and_raise"),
+    ("test_numsan.py", "test_v2_logits_limit_probe_raises"),
 }
 
 

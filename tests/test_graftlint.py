@@ -387,6 +387,75 @@ FIXTURES = {
         SPEC = P("dp", "tp")
         """,
     ),
+    "GL070": (
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            h = x.astype(jnp.bfloat16)
+            return jnp.sum(h)
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            h = x.astype(jnp.bfloat16)
+            return jnp.sum(h.astype(jnp.float32))
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL071": (
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            y = jnp.dot(x, x)
+            return jnp.log(y)
+        step_j = jax.jit(step)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def step(x):
+            y = jnp.dot(x, x)
+            return jnp.log(y + 1e-6)
+        step_j = jax.jit(step)
+        """,
+    ),
+    "GL072": (
+        """
+        import jax, jax.numpy as jnp
+        def quantize(g):
+            s = jnp.max(jnp.abs(g)) / 127.0
+            q = (g / s).astype(jnp.int8)
+            return q, s
+        quantize_j = jax.jit(quantize)
+        """,
+        """
+        import jax, jax.numpy as jnp
+        def quantize(g):
+            s = jnp.max(jnp.abs(g)) / 127.0
+            q = jnp.clip(jnp.round(g / s), -127, 127).astype(jnp.int8)
+            return q, s
+        quantize_j = jax.jit(quantize)
+        """,
+    ),
+    "GL073": (
+        """
+        import jax
+        def sample(key, shape):
+            a = jax.random.normal(key, shape)
+            b = jax.random.uniform(key, shape)
+            return a + b
+        f = jax.jit(sample)
+        """,
+        """
+        import jax
+        def sample(key, shape):
+            k1, k2 = jax.random.split(key)
+            a = jax.random.normal(k1, shape)
+            b = jax.random.uniform(k2, shape)
+            return a + b
+        f = jax.jit(sample)
+        """,
+    ),
     "GL041": (
         """
         import jax, jax.numpy as jnp

@@ -1,0 +1,391 @@
+"""numsan (ISSUE 18, runtime half): per-leaf gradient attribution,
+logits/KV-scale probes, quantize-site saturation reporting with
+deferred drain, violation-counter + train-summary surfacing through
+telemetry_report, hang-dump embedding, and the config wiring. The
+host-only unit tests stay tier-1; the engine-backed seeded-fault
+variants (NaN-grad attribution, fp16 overflow counter, v2 KV-write
+saturation) live in conftest._SLOW."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.analysis.numsan import (NumericsSanitizer, NumSanError,
+                                           env_enabled, get_numsan,
+                                           set_numsan)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_report_tool():
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        os.path.join(REPO, "tools", "telemetry_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    return tr
+
+
+# ---------------------------------------------------------------------
+# gradient attribution (seeded stats)
+# ---------------------------------------------------------------------
+
+def test_grad_finding_names_executable_and_worst_leaf():
+    """ISSUE 18 acceptance: a step with non-finite grads produces a
+    finding carrying the executable's ledger name and the worst leaf's
+    PyTree path — not one anonymous overflow bit."""
+    san = NumericsSanitizer(mode="raise")
+    stats = [("['embed']['tokens']", 0, 1.2),
+             ("['blocks'][0]['attn']['wq']", 3, float("inf")),
+             ("['final_norm']['scale']", 1, 2.0)]
+    with pytest.raises(NumSanError) as e:
+        san.check_grad_stats("compiled_step", stats, loss_scale=1024.0)
+    msg = str(e.value)
+    assert "compiled_step" in msg
+    assert "4 non-finite gradient element(s)" in msg
+    assert "2/3 leaves" in msg
+    assert "worst leaf" in msg
+    assert "['blocks'][0]['attn']['wq']" in msg
+    assert "loss_scale=1024" in msg
+    assert san.counters["violations"] == 1
+    assert san.counters["checked_steps"] == 1
+
+
+def test_grad_vectors_all_finite_fast_path():
+    """The vector form's common case (all leaves finite) is one sum —
+    no findings, step counted."""
+    san = NumericsSanitizer(mode="raise")
+    assert san.check_grad_vectors(
+        "compiled_step", ["['a']", "['b']"], [0, 0], [0.5, 1.5]) == []
+    assert san.counters["checked_steps"] == 1
+    assert san.counters["violations"] == 0
+
+
+def test_warn_mode_counts_without_raising():
+    san = NumericsSanitizer(mode="warn")
+    msgs = san.check_grad_vectors(
+        "compiled_step", ["['a']", "['b']"], [2, 0], [1.0, 1.0])
+    assert len(msgs) == 1 and "['a']" in msgs[0]
+    assert san.counters["violations"] == 1
+    assert san.violation_log == msgs
+
+
+def test_logits_and_kv_scale_probes():
+    san = NumericsSanitizer(mode="warn", logits_limit=100.0)
+    # clean
+    assert san.check_logits("v2/dispatch", 0, 50.0) == []
+    # non-finite logits
+    msgs = san.check_logits("v2/dispatch", 7, 50.0)
+    assert len(msgs) == 1 and "7 non-finite logit(s)" in msgs[0]
+    # the pre-NaN saturation signature: |logit| over the limit
+    msgs = san.check_logits("v2/dispatch", 0, 5e3)
+    assert len(msgs) == 1 and "max|logit|" in msgs[0]
+    assert "100" in msgs[0]
+    # KV scale slabs
+    assert san.check_kv_scales("v2/kv_pools", 0, 3.0) == []
+    msgs = san.check_kv_scales("v2/kv_pools", 2, 3.0)
+    assert len(msgs) == 1
+    assert "non-finite KV quantization scale(s)" in msgs[0]
+    assert san.counters["violations"] == 3
+
+
+# ---------------------------------------------------------------------
+# quantize-site saturation: gauge state + deferred drain
+# ---------------------------------------------------------------------
+
+def test_saturation_defers_in_raise_mode_until_drain():
+    """report_saturation runs on the jax.debug.callback thread where a
+    raise would be swallowed — raise mode defers to the next host
+    choke-point's drain()."""
+    san = NumericsSanitizer(mode="raise", saturation_ceiling=0.05)
+    san.report_saturation("qgz_wire", 0.01)      # healthy: 1/QBLOCK-ish
+    san.drain()                                   # nothing pending
+    san.report_saturation("kv_write", 0.30)       # silently clipping
+    assert san.counters["saturation_reports"] == 2
+    assert san.last_saturation["kv_write"] == 0.30
+    assert san.max_saturation["kv_write"] == 0.30
+    with pytest.raises(NumSanError) as e:
+        san.drain()
+    msg = str(e.value)
+    assert "'kv_write'" in msg and "0.3000" in msg and "0.05" in msg
+    san.drain()                                   # drained: no re-raise
+    # warn mode never defers
+    warn = NumericsSanitizer(mode="warn", saturation_ceiling=0.05)
+    warn.report_saturation("moe_dispatch", 0.9)
+    warn.drain()
+    assert warn.counters["violations"] == 1
+
+
+def test_snapshot_shape():
+    san = NumericsSanitizer(mode="warn", saturation_ceiling=0.1)
+    san.check_grad_vectors("compiled_step", ["['a']"], [1], [2.0])
+    san.report_saturation("qgz_wire", 0.2)
+    snap = san.snapshot()
+    assert snap["mode"] == "warn"
+    assert snap["saturation_ceiling"] == 0.1
+    assert snap["counters"]["violations"] == 2
+    assert snap["pending"] == 0                   # warn never defers
+    assert snap["saturation"] == {"qgz_wire": 0.2}
+    assert snap["saturation_max"] == {"qgz_wire": 0.2}
+    assert len(snap["violations"]) == 2
+
+
+def test_hang_dump_embeds_numsan(tmp_path):
+    """A wedged run's watchdog dump carries the sanitizer's forensics
+    next to blocksan's/meshsan's sections."""
+    from deepspeed_tpu.telemetry.flightrec import dump_state
+    san = NumericsSanitizer(mode="warn", saturation_ceiling=0.05)
+    san.report_saturation("kv_write", 0.25)
+    set_numsan(san)
+    try:
+        path = dump_state("unit-test stall", str(tmp_path))
+        assert path
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["numsan"]["saturation"] == {"kv_write": 0.25}
+        assert doc["numsan"]["counters"]["violations"] == 1
+    finally:
+        set_numsan(None)
+    assert get_numsan() is None
+
+
+# ---------------------------------------------------------------------
+# telemetry counter + report surfacing
+# ---------------------------------------------------------------------
+
+def test_violations_and_gauge_reach_telemetry_and_report():
+    """Findings bump ds_numsan_violations_total{kind} and saturation
+    lands on ds_numsan_saturation_ratio{site}; telemetry_report's train
+    summary rolls both up next to the overflow counter and derives the
+    overflow rate."""
+    from deepspeed_tpu import telemetry
+    telemetry.shutdown()
+    telemetry.configure()
+    try:
+        san = NumericsSanitizer(mode="warn", saturation_ceiling=0.05)
+        san.check_grad_vectors("compiled_step", ["['a']"], [1], [2.0])
+        san.report_saturation("qgz_wire", 0.5)
+        reg = telemetry.get_registry()
+        assert reg.counter("ds_numsan_violations_total").value(
+            kind="nonfinite-grads") == 1
+        assert reg.counter("ds_numsan_violations_total").value(
+            kind="saturation") == 1
+        assert reg.gauge("ds_numsan_saturation_ratio").value(
+            site="qgz_wire") == 0.5
+    finally:
+        telemetry.shutdown()
+    tr = _load_report_tool()
+    summary = tr.train_summary({
+        "ds_train_steps_total": 100.0,
+        "ds_overflow_steps_total": 3.0,
+        "ds_numsan_violations_total/kind=saturation": 1.0,
+        "ds_numsan_saturation_ratio/site=qgz_wire": 0.5,
+        "ds_serving_unrelated": 9.0})
+    assert summary["overflow_rate_derived"] == 0.03
+    assert "ds_numsan_violations_total/kind=saturation" in summary
+    assert "ds_serving_unrelated" not in summary
+    # numsan series also ride the serving summary (v2 probes)
+    assert "ds_numsan_saturation_ratio/site=qgz_wire" in \
+        tr.serving_summary({"ds_numsan_saturation_ratio/site=qgz_wire":
+                            0.5})
+    # the --gate numerics table regresses on saturation / overflow /
+    # recompiles, zero-tolerance
+    stems = [g[0] for g in tr._GATES["numerics"]]
+    assert "saturation_ratio" in stems
+    assert "overflow_steps" in stems
+    assert "extra_executables" in stems
+
+
+# ---------------------------------------------------------------------
+# config wiring
+# ---------------------------------------------------------------------
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.delenv("DS_NUMSAN", raising=False)
+    assert env_enabled() is False
+    monkeypatch.setenv("DS_NUMSAN", "0")
+    assert env_enabled() is False
+    monkeypatch.setenv("DS_NUMSAN", "1")
+    assert env_enabled() is True
+
+
+def test_config_blocks_default_off_and_validate():
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceNumsanConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig, NumsanConfig
+    assert DeepSpeedConfig().numsan.enabled is False
+    assert RaggedInferenceEngineConfig().numsan.enabled is False
+    cfg = NumsanConfig(enabled=True, mode="warn",
+                       saturation_ceiling=0.2, saturation_probe=False)
+    assert cfg.saturation_ceiling == 0.2
+    inf = InferenceNumsanConfig(enabled=True, probe_interval=1,
+                                logits_limit=50.0)
+    assert inf.probe_interval == 1
+    with pytest.raises(Exception):
+        NumsanConfig(mode="explode")
+    with pytest.raises(Exception):
+        InferenceNumsanConfig(mode="explode")
+    with pytest.raises(Exception):
+        NumsanConfig(saturation_ceiling=1.5)
+    with pytest.raises(ValueError):
+        NumericsSanitizer(mode="explode")
+
+
+# ---------------------------------------------------------------------
+# engine-backed seeded faults (conftest._SLOW)
+# ---------------------------------------------------------------------
+
+def _train_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"fsdp": -1},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _token_batch(seed=0, batch=16, seq=16, vocab=512):
+    import jax
+    tokens = jax.random.randint(jax.random.PRNGKey(seed),
+                                (batch, seq + 1), 0, vocab)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def test_engine_seeded_nan_grad_attribution(devices8):
+    """Engine-backed acceptance (ISSUE 18): a NaN poisoned into one
+    param leaf turns the next step's anonymous overflow bit into a
+    finding naming the executable ('compiled_step') and a leaf path.
+    The per-leaf check is deferred one dispatch (the pipelined-stats
+    design), so the boundary hook numsan_drain() surfaces the final
+    step's finding."""
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=_train_config(numsan={"enabled": True, "mode": "raise"}))
+    assert engine._numsan is not None
+    try:
+        batch = _token_batch()
+        engine.train_batch(batch)
+        engine.numsan_drain()                      # clean step: quiet
+        assert engine._numsan.counters["violations"] == 0
+        engine.state["params"]["final_norm"]["scale"] = \
+            engine.state["params"]["final_norm"]["scale"].at[0].set(
+                jnp.nan)
+        engine.train_batch(batch)  # checks the PREVIOUS (clean) step
+        with pytest.raises(NumSanError) as e:
+            engine.numsan_drain()
+        msg = str(e.value)
+        assert "compiled_step" in msg
+        assert "non-finite gradient" in msg
+        assert "worst leaf" in msg and "['" in msg
+    finally:
+        set_numsan(None)
+
+
+def test_engine_fp16_overflow_counter_and_bridge(devices8):
+    """fp16 overflow -> skip -> backoff e2e: the device-truth
+    overflow_steps property counts the skipped step and the telemetry
+    bridge publishes it as ds_overflow_steps_total."""
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    from deepspeed_tpu.telemetry.bridges import record_train_step
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+    engine, _, _, _ = ds.initialize(
+        model=GPT2(size="tiny"),
+        config=_train_config(fp16={"enabled": True,
+                                   "initial_scale_power": 4,
+                                   "loss_scale_window": 2,
+                                   "hysteresis": 1}))
+    batch = _token_batch()
+    engine.train_batch(batch)
+    assert engine.overflow_steps == 0
+    s0 = float(engine.state["loss_scale"].scale)
+    engine.state["params"]["final_norm"]["scale"] = \
+        engine.state["params"]["final_norm"]["scale"].at[0].set(jnp.inf)
+    steps_before = int(engine.state["step"])
+    engine.train_batch(batch)
+    assert int(engine.state["step"]) == steps_before      # skipped
+    assert float(engine.state["loss_scale"].scale) < s0   # backed off
+    assert engine.overflow_steps == 1
+    reg = MetricsRegistry()
+    record_train_step(reg, engine, {"loss_scale": float(
+        engine.state["loss_scale"].scale)})
+    assert reg.counter("ds_overflow_steps_total").value() == 1
+    assert reg.gauge("ds_train_loss_scale").value() == \
+        float(engine.state["loss_scale"].scale)
+
+
+def test_v2_kv_write_saturation_site_gauge_and_raise(devices8):
+    """v2 engine-backed acceptance: the quantized KV write's trace-time
+    saturation probe reports its site gauge every dispatch; a ceiling
+    below the tiny model's healthy baseline (~1/head_dim — the
+    per-vector absmax lands one code on the boundary by construction)
+    turns the same traffic into a seeded 'kv_write' finding raised at
+    the dispatch boundary."""
+    import jax
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+
+    def build(**numsan_over):
+        ns = dict(enabled=True, mode="raise", probe_interval=1)
+        ns.update(numsan_over)
+        return InferenceEngineV2(
+            Llama(size="tiny"),
+            RaggedInferenceEngineConfig(
+                dtype="float32", kv_block_size=8, num_kv_blocks=32,
+                max_chunk_size=16,
+                kv_cache={"enabled": True, "dtype": "int8"},
+                numsan=ns))
+    try:
+        # healthy ceiling: dispatch is clean and the site gauge holds
+        # the measured fraction (head_dim 16 -> ~0.0625 >= 1/16)
+        e = build(saturation_ceiling=0.5)
+        e.put([0], [[1, 2, 3, 4, 5]])
+        jax.effects_barrier()
+        e._numsan.drain()
+        assert e._numsan.counters["violations"] == 0
+        frac = e._numsan.last_saturation.get("kv_write")
+        assert frac is not None and 1.0 / 16 <= frac <= 0.5
+        # a ceiling below the baseline: the same write is a finding
+        # naming the site, deferred to the dispatch-boundary drain
+        e2 = build(saturation_ceiling=0.01)
+        with pytest.raises(NumSanError) as err:
+            e2.put([0], [[1, 2, 3, 4, 5]])
+            jax.effects_barrier()
+            e2._numsan.drain()
+        assert "'kv_write'" in str(err.value)
+        assert "saturating-code fraction" in str(err.value)
+    finally:
+        set_numsan(None)
+
+
+def test_v2_logits_limit_probe_raises(devices8):
+    """The opt-in logits-range probe: an absurdly low limit turns the
+    first probed dispatch's healthy logits into a 'logits-range'
+    finding naming the executable."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import Llama
+    e = InferenceEngineV2(
+        Llama(size="tiny"),
+        RaggedInferenceEngineConfig(
+            dtype="float32", kv_block_size=8, num_kv_blocks=32,
+            max_chunk_size=16,
+            numsan={"enabled": True, "mode": "raise",
+                    "probe_interval": 1, "logits_limit": 1e-6,
+                    "saturation_probe": False}))
+    try:
+        with pytest.raises(NumSanError) as err:
+            e.put([0], [[1, 2, 3, 4, 5]])
+        assert "max|logit|" in str(err.value)
+        assert "v2/dispatch" in str(err.value)
+    finally:
+        set_numsan(None)

@@ -66,7 +66,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     linter = _import_linter()
-    from deepspeed_tpu.analysis.rules import ALL_RULES, RULE_GROUPS
+    from deepspeed_tpu.analysis.rules import (ALL_RULES,
+                                              RULE_GROUP_ALIASES,
+                                              RULE_GROUPS)
 
     if args.list_rules:
         by_id = {}
@@ -94,7 +96,8 @@ def main(argv=None) -> int:
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
     if args.select:
-        groups = [g.strip() for g in args.select.split(",") if g.strip()]
+        groups = [RULE_GROUP_ALIASES.get(g.strip().lower(), g.strip())
+                  for g in args.select.split(",") if g.strip()]
         unknown = [g for g in groups if g not in RULE_GROUPS]
         if unknown:
             print(f"graftlint: unknown rule group(s) {unknown}; "

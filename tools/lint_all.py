@@ -7,17 +7,22 @@
 Runs, in order (ISSUE 15 satellite — one invocation, single exit code,
 no jax import anywhere):
 
-1. **graftlint** — all rules (GL001-GL063 incl. the shardlint SPMD
-   group) over ``deepspeed_tpu/`` against ``.graftlint-baseline.json``;
+1. **graftlint** — all rules (GL001-GL073 incl. the shardlint SPMD
+   group and the numlint numerics group) over ``deepspeed_tpu/``
+   against ``.graftlint-baseline.json``;
 2. **spmd group** — the GL060-family pass alone (same findings subset;
    kept as its own section so a CI lane can see the SPMD gate status
-   at a glance — equivalent to ``graftlint.py --select spmd``);
+   at a glance — equivalent to ``graftlint.py --select spmd``), and
+   the GL070-family **numerics group** the same way (ISSUE 18;
+   equivalent to ``graftlint.py --select numerics``);
 3. **host-only audits** — ``traced_roots`` over the packages whose
    contract forbids jit-reachable code: ``autotuning/`` (deterministic
-   planner ranking) and ``serving/`` + ``telemetry/reqtrace.py`` (the
+   planner ranking), ``serving/`` + ``telemetry/reqtrace.py`` (the
    request-trace recorder runs on the event loop) +
    ``telemetry/{timeseries,health,fleet}.py`` (the ISSUE 17 fleet
-   health plane is stdlib-only host logic).
+   health plane is stdlib-only host logic), and
+   ``analysis/numsan.py`` (the sanitizer shell is host-side state
+   keeping; its in-graph probes live at the call sites).
 
 Exit codes: 0 = every section clean; 1 = any section failed;
 2 = usage/environment error. The tier-1 suite asserts this exits 0 at
@@ -86,6 +91,21 @@ def run_sections() -> list[dict]:
         "errors": [],
     })
 
+    # 2b. the numerics group status (ISSUE 18 — equivalent to
+    # ``graftlint.py --select numerics`` / ``--select NUM``), same
+    # filter-from-the-full-run trick as the spmd section
+    num_ids = set(RULE_GROUPS["numerics"])
+    num_all = [f for f in result.findings if f.rule in num_ids]
+    num_new = [f for f in result.new if f.rule in num_ids]
+    sections.append({
+        "name": "numerics group (GL070-GL073)",
+        "ok": not num_new and not result.errors,
+        "detail": (f"{len(num_all)} finding(s), "
+                   f"{len(num_new)} new"),
+        "new": [f.to_dict() for f in num_new],
+        "errors": [],
+    })
+
     # 3. host-only package audits (no jit-reachable code allowed)
     for label, paths in (
             ("host-only: autotuning",
@@ -97,7 +117,12 @@ def run_sections() -> list[dict]:
               # logic — stdlib-only, nothing jit-reachable
               os.path.join(_PACKAGE, "telemetry", "timeseries.py"),
               os.path.join(_PACKAGE, "telemetry", "health.py"),
-              os.path.join(_PACKAGE, "telemetry", "fleet.py")])):
+              os.path.join(_PACKAGE, "telemetry", "fleet.py")]),
+            # ISSUE 18: the numsan sanitizer shell is host-side state
+            # keeping — the in-graph probes live at the call sites
+            # (engine, ops/pallas/quantization.py), never here
+            ("host-only: numsan module",
+             [os.path.join(_PACKAGE, "analysis", "numsan.py")])):
         roots = analysis.traced_roots(paths, root=_REPO)
         sections.append({
             "name": label,

@@ -134,7 +134,8 @@ def serving_summary(metrics: dict) -> dict:
     out = {k: v for k, v in sorted(metrics.items())
            if "ds_serving_" in k or "ds_blocksan_" in k
            or "ds_affinity_" in k or "ds_meshsan_" in k
-           or "ds_kv_" in k or "ds_moe_" in k or "ds_fleet_" in k}
+           or "ds_kv_" in k or "ds_moe_" in k or "ds_fleet_" in k
+           or "ds_numsan_" in k}
 
     def total(stem: str):
         vals = [v for k, v in metrics.items() if stem in k
@@ -145,6 +146,28 @@ def serving_summary(metrics: dict) -> dict:
     misses = total("ds_serving_prefix_misses_total")
     if hits is not None and misses is not None and hits + misses > 0:
         out["prefix_hit_rate_derived"] = round(hits / (hits + misses), 4)
+    return out
+
+
+def train_summary(metrics: dict) -> dict:
+    """Training-focused rollup (ISSUE 18): the ``ds_train_*`` step /
+    loss / loss-scale series, the device-truth overflow counter
+    (``ds_overflow_steps_total``), and the numsan numerics findings
+    (``ds_numsan_violations_total{kind}`` +
+    ``ds_numsan_saturation_ratio{site}``) in ONE table — a blown-up
+    run reads as "overflow count, which finding kind, which quantize
+    site" without raw snapshots. Adds a derived
+    ``overflow_rate_derived`` (overflow steps / total steps) when both
+    counters are present."""
+    out = {k: v for k, v in sorted(metrics.items())
+           if "ds_train_" in k or "ds_overflow_" in k
+           or "ds_numsan_" in k}
+    steps = next((v for k, v in metrics.items()
+                  if "ds_train_steps_total" in k), None)
+    ov = next((v for k, v in metrics.items()
+               if "ds_overflow_steps_total" in k), None)
+    if steps and ov is not None and steps > 0:
+        out["overflow_rate_derived"] = round(ov / steps, 4)
     return out
 
 
@@ -164,6 +187,7 @@ def build_report(trace_path: str, metrics_path: str | None,
         else:
             report["metrics"] = parse_prometheus(metrics_path)
         report["serving"] = serving_summary(report["metrics"])
+        report["train"] = train_summary(report["metrics"])
     if ledger_path:
         with open(ledger_path) as f:
             report["ledger"] = json.load(f)
@@ -195,6 +219,16 @@ def print_report(report: dict) -> None:
         print(f"{'series':<64}{'value':>14}")
         for series in sorted(serving):
             v = serving[series]
+            sval = f"{v:.6g}" if isinstance(v, float) else str(v)
+            print(f"{series[:63]:<64}{sval:>14}")
+    train = report.get("train")
+    if train:
+        print()
+        print("train summary (ds_train_* + overflow + numsan numerics "
+              "findings/saturation):")
+        print(f"{'series':<64}{'value':>14}")
+        for series in sorted(train):
+            v = train[series]
             sval = f"{v:.6g}" if isinstance(v, float) else str(v)
             print(f"{series[:63]:<64}{sval:>14}")
     ledger = report.get("ledger")
@@ -475,6 +509,20 @@ _GATES = {
         ("slo_burn_rate", -1, 0.25),
         ("dropped", -1, 0.0),
         ("replica_skew", -1, 0.15),
+        ("tokens_per_sec", +1, 0.05),
+    ),
+    # numerics gate (ISSUE 18, bench `numsan` stage + training
+    # snapshots): quantize-site saturation must not creep up from the
+    # healthy baseline (silent clipping shows up here long before it
+    # shows up as loss), fp16 overflow-skipped steps must not grow
+    # (zero-tolerance against a zero baseline), the numsan-disabled
+    # path must keep compiling ZERO extra executables (deterministic,
+    # zero-tolerance), and the armed-probe run's throughput stays
+    # within the usual ±5%.
+    "numerics": (
+        ("saturation_ratio", -1, 0.0),
+        ("overflow_steps", -1, 0.0),
+        ("extra_executables", -1, 0.0),
         ("tokens_per_sec", +1, 0.05),
     ),
 }
