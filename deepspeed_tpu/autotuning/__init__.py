@@ -2,6 +2,10 @@
 ledger-driven planner subsystem (ISSUE 7): device-truth cost model
 (:mod:`.cost_model`), deterministic candidate search with AOT ranking
 (:mod:`.planner`), and the plan artifact + apply (:mod:`.plan`). The
+serving control plane's offline half lives in :mod:`.serving`
+(ISSUE 19): a ServingCandidate grid ranked against a declarative
+TrafficModel by a queueing cost model, emitting a ServingPlan whose
+``apply()`` reproduces the chosen engine/serving configs. The
 reference-shaped measured-trial :class:`Autotuner` and tuners remain
 for the classic stage x microbatch grid."""
 
@@ -12,5 +16,8 @@ from .cost_model import (AOTFacts, Calibration, CostModel,  # noqa: F401
                          MemoryModel, hbm_headroom_bytes)
 from .plan import Plan, summarize  # noqa: F401
 from .planner import Candidate, Planner, mesh_factorizations  # noqa: F401
+from .serving import (ServingCalibration, ServingCandidate,  # noqa: F401
+                      ServingCostModel, ServingPlan, ServingPlanner,
+                      TrafficModel, summarize_serving)
 from .tuner import (BaseTuner, GridSearchTuner, ModelBasedTuner,  # noqa: F401
                     RandomTuner)

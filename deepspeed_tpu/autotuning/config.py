@@ -110,3 +110,30 @@ class AutotuningConfig(DeepSpeedConfigModel):
     measure_top_k: int = 0
     # write the plan artifact here ("" = don't write)
     plan_path: str = ""
+
+    # --- serving planner search space (ISSUE 19) ---------------------
+    # grids for the ServingPlanner's ServingCandidate product: fused
+    # decode K, chain depth (max_inflight_dispatches), ring vs plain
+    # chain admission, speculative draft lengths (0 = off), KV pool
+    # dtype and block budget (0 = keep the base pool), admission bound
+    # (shed_queue_depth, 0 = unbounded), replica count, and the
+    # prefill/decode disaggregated split. The base engine/serving
+    # config is always a grid point (include_base above), so a serving
+    # plan can never rank below the hand-tuned start under its own
+    # model.
+    serving_k_steps: list[int] = Field(default_factory=lambda: [4, 8])
+    serving_chain_depths: list[int] = Field(
+        default_factory=lambda: [1, 2, 4])
+    serving_ring_modes: list[bool] = Field(
+        default_factory=lambda: [False, True])
+    serving_draft_lens: list[int] = Field(
+        default_factory=lambda: [0, 3])
+    serving_kv_dtypes: list[str] = Field(
+        default_factory=lambda: ["fp16"])
+    serving_kv_blocks: list[int] = Field(default_factory=lambda: [0])
+    serving_shed_depths: list[int] = Field(
+        default_factory=lambda: [0, 16])
+    serving_replicas: list[int] = Field(default_factory=lambda: [1])
+    serving_disagg: list[bool] = Field(default_factory=lambda: [False])
+    # write the serving plan artifact here ("" = don't write)
+    serving_plan_path: str = ""

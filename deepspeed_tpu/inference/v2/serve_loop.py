@@ -110,8 +110,11 @@ class TokenEvent:
 class FusedServeLoop:
     """See module docstring. Construct against a live
     :class:`~.engine_v2.InferenceEngineV2`; sampling parameters default
-    to the engine config and are fixed for the loop's lifetime (one
-    compiled executable family per loop)."""
+    to the engine config and are fixed for the loop's lifetime. The
+    serving controller (ISSUE 19) may adjust chain depth and toggle
+    the draft length between chains via :meth:`set_chain_depth` /
+    :meth:`set_draft_len` — at most two compiled executable families
+    per loop, both pinned by the recompile sentinel."""
 
     def __init__(self, engine, *, k_steps: Optional[int] = None,
                  temperature: Optional[float] = None,
@@ -134,39 +137,27 @@ class FusedServeLoop:
         self.strict = bool(strict)
         self.preemption = bool(preemption)
         self.depth = max(1, int(cfg.max_inflight_dispatches))
+        # the configured depth is the runtime CEILING: set_chain_depth
+        # (ISSUE 19 controller knob) may step below it but never above,
+        # so the ring capacity sized from it is always sufficient
+        self.max_depth = self.depth
         self.ring_mode = bool(cfg.fused_admission)
         # speculative decoding (ISSUE 9): swap in the spec executables;
         # every scheduling decision below sizes advances by
-        # k * (1 + draft_len) instead of k
-        self.spec = bool(cfg.speculative.enabled)
-        self.draft_len = int(cfg.speculative.draft_len) if self.spec \
-            else 0
-        sp_key = (cfg.speculative.draft_len, cfg.speculative.min_ngram)
+        # k * (1 + draft_len) instead of k. The configured draft length
+        # is the only nonzero runtime value — the spec executables bake
+        # it at trace time, so set_draft_len toggles between exactly
+        # two compiled families: {0, _draft_cfg}.
+        self._draft_cfg = (int(cfg.speculative.draft_len)
+                           if cfg.speculative.enabled else 0)
+        self._pending_draft: Optional[int] = None
         if self.ring_mode:
-            if self.spec:
-                self.fn = engine._spec_serve_fn(
-                    self.k, self.temperature, self.top_k, self.top_p,
-                    self.eos)
-                self._fn_key = ("spec_serve", self.k, *sp_key,
-                                self.temperature, self.top_k,
-                                self.top_p, self.eos)
-            else:
-                self.fn = engine._serve_fn(self.k, self.temperature,
-                                           self.top_k, self.top_p,
-                                           self.eos)
-                self._fn_key = ("serve", self.k, self.temperature,
-                                self.top_k, self.top_p, self.eos)
-            self.ring_cap = self.k * self.depth * (1 + self.draft_len)
-        elif self.spec:
-            self.fn = engine._spec_fn(self.k, self.temperature,
-                                      self.top_k, self.top_p, self.eos)
-            self._fn_key = ("spec", self.k, *sp_key, self.temperature,
-                            self.top_k, self.top_p, self.eos)
-        else:
-            self.fn = engine._fused_fn(self.k, self.temperature,
-                                       self.top_k, self.top_p, self.eos)
-            self._fn_key = (self.k, self.temperature, self.top_k,
-                            self.top_p, self.eos)
+            # fixed at the MAXIMUM family's advance so runtime depth /
+            # draft changes never change operand shapes (the recompile
+            # sentinel pins each family to one warmup)
+            self.ring_cap = (self.k * self.max_depth
+                             * (1 + self._draft_cfg))
+        self._bind_fn(self._draft_cfg)
 
         self.waiting: list[ServeRequest] = []
         self.live: dict[int, ServeRequest] = {}
@@ -206,6 +197,74 @@ class FusedServeLoop:
         self._hm = (self._tel.get_health_monitor()
                     if self._tel is not None else None)
         self._beat_next = 0.0   # beat rate limit (see step())
+
+    def _bind_fn(self, draft_len: int) -> None:
+        """Bind ``self.fn``/``self._fn_key`` to the executable family
+        for ``draft_len`` (0 = plain decode). Called once at
+        construction and again by the boundary-applied
+        :meth:`set_draft_len` toggle; each (key, operand-shape) pair
+        still warms up exactly once under the recompile sentinel."""
+        e, cfg = self.e, self.e._config
+        self.draft_len = int(draft_len)
+        self.spec = self.draft_len > 0
+        sp_key = (self.draft_len, cfg.speculative.min_ngram)
+        if self.ring_mode:
+            if self.spec:
+                self.fn = e._spec_serve_fn(
+                    self.k, self.temperature, self.top_k, self.top_p,
+                    self.eos)
+                self._fn_key = ("spec_serve", self.k, *sp_key,
+                                self.temperature, self.top_k,
+                                self.top_p, self.eos)
+            else:
+                self.fn = e._serve_fn(self.k, self.temperature,
+                                      self.top_k, self.top_p, self.eos)
+                self._fn_key = ("serve", self.k, self.temperature,
+                                self.top_k, self.top_p, self.eos)
+        elif self.spec:
+            self.fn = e._spec_fn(self.k, self.temperature,
+                                 self.top_k, self.top_p, self.eos)
+            self._fn_key = ("spec", self.k, *sp_key, self.temperature,
+                            self.top_k, self.top_p, self.eos)
+        else:
+            self.fn = e._fused_fn(self.k, self.temperature,
+                                  self.top_k, self.top_p, self.eos)
+            self._fn_key = (self.k, self.temperature, self.top_k,
+                            self.top_p, self.eos)
+
+    # ------------------------------------------------------------------
+    # runtime control knobs (ISSUE 19): the serving controller adjusts
+    # these between chains — both are recompile-free by construction
+    def set_chain_depth(self, depth: int) -> int:
+        """Set the live chain depth, clamped to [1, configured
+        ``max_inflight_dispatches``]. Effective immediately — depth only
+        bounds the host-side enqueue loops, never an operand shape
+        (``ring_cap`` stays sized for the configured maximum)."""
+        self.depth = max(1, min(int(depth), self.max_depth))
+        return self.depth
+
+    def set_draft_len(self, draft_len: int) -> int:
+        """Request a speculative draft-length toggle: 0 disables
+        drafting, any nonzero value means the CONFIGURED draft length
+        (the spec executables bake it at trace time, so those are the
+        only two compiled families). Applied at the next chain
+        boundary — mid-chain device state (in-flight dispatches, carry
+        operands) belongs to the current family. Returns the value that
+        will be in effect after it applies."""
+        want = self._draft_cfg if int(draft_len) > 0 else 0
+        self._pending_draft = None if want == self.draft_len else want
+        return want
+
+    def _apply_pending_draft(self) -> None:
+        """Boundary application of :meth:`set_draft_len`: with nothing
+        in flight every device commit has landed, so dropping the carry
+        and rebuilding host operands under the other family replays
+        nothing (the same rebuild a membership change forces)."""
+        if self._pending_draft is None or self.infl:
+            return
+        self._carry = None
+        self._bind_fn(self._pending_draft)
+        self._pending_draft = None
 
     # ------------------------------------------------------------------
     # request intake (single-threaded with step(); see module docstring)
@@ -301,7 +360,9 @@ class FusedServeLoop:
                     self._hm.min_interval_s, 1e-3)
                 self._hm.heartbeat(self.replica or "replica0")
         if not self.has_work():
+            self._apply_pending_draft()
             return ev
+        self._apply_pending_draft()
         try:
             if self.ring_mode:
                 self._step_ring(ev)

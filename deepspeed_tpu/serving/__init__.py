@@ -1,11 +1,15 @@
 """Async continuous-batching serving layer (ISSUE 6) and the
 disaggregated multi-replica deployment layer over it (ISSUE 13:
 prefill/decode split, prefix-affinity router, cross-mesh KV
-migration) — the FastGen/DeepSpeed-MII front end over inference v2
-(see docs/serving.md)."""
+migration) — the FastGen/DeepSpeed-MII front end over inference v2 —
+plus the online serving control plane (ISSUE 19: admission shedding
+and the burn-rate feedback controller in :mod:`.controller`). See
+docs/serving.md."""
 
-from .config import (DisaggregationConfig, RouterConfig,  # noqa: F401
-                     ServingConfig)
+from .config import (ControllerConfig, DisaggregationConfig,  # noqa: F401
+                     RouterConfig, ServingConfig)
+from .controller import (Action, ServingController,  # noqa: F401
+                         Signals, read_server_signals)
 from .router import (InferenceRouter, PrefillEngine,  # noqa: F401
                      RoutedHandle)
 from .server import (AsyncInferenceServer, RequestCancelled,  # noqa: F401

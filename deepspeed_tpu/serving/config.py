@@ -10,6 +10,49 @@ from pydantic import Field
 from ..runtime.config_utils import DeepSpeedConfigModel
 
 
+class ControllerConfig(DeepSpeedConfigModel):
+    """Online serving feedback controller (ISSUE 19,
+    ``deepspeed_tpu/serving/controller.py``): a worker-thread state
+    machine stepped at ``interval_s`` cadence from the server's beat
+    that reads SLO burn rates (``telemetry/timeseries.py``) and
+    reqtrace component p99s, and adapts three knobs the offline plan
+    cannot set per-minute — the admission bound (shed depth), the
+    dispatch-chain depth, and the speculative draft length. Policy:
+    queue pressure throttles admission first (fast-fail beats silent
+    aging — the BENCH_r06 11.2 s queue_wait failure); sustained ITL
+    saturation then steps chain depth down, then drafts off (deep
+    chains and long drafts win at low load and kill ITL at
+    saturation). Recovery relaxes in reverse order and only after
+    ``step_up_after`` consecutive healthy intervals (hysteresis — no
+    flapping on jittered load). Every decision bumps
+    ``ds_serving_controller_actions_total``. See docs/serving.md."""
+    enabled: bool = False
+    # controller decision cadence (seconds between update() steps)
+    interval_s: float = Field(1.0, gt=0.0)
+    # SLO burn-rate trip/clear thresholds (breaches per request over
+    # the shortest telemetry burn window; 1.0 = every request burning).
+    # Trip above burn_high; an interval only counts as healthy below
+    # burn_low (the gap is the hysteresis band).
+    burn_high: float = Field(0.1, ge=0.0)
+    burn_low: float = Field(0.02, ge=0.0)
+    # queue-wait p99 above this fraction of the TTFT SLO reads as
+    # admission pressure (throttle the shed depth)
+    queue_wait_frac: float = Field(0.5, gt=0.0)
+    # ITL p99 above slo_itl_ms * this ratio reads as decode saturation
+    # (step chain depth down, then drafts off)
+    saturation_ratio: float = Field(1.5, gt=0.0)
+    # consecutive healthy intervals required before relaxing one step
+    step_up_after: int = Field(5, ge=1)
+    # shed-depth bounds the throttle moves within; min_shed_depth also
+    # arms shedding when ServingConfig.shed_queue_depth is 0
+    min_shed_depth: int = Field(4, ge=1)
+    max_shed_depth: int = Field(256, ge=1)
+    # floors for the step-downs (chain depth never below this; draft
+    # toggle is {0, configured})
+    min_chain_depth: int = Field(1, ge=1)
+    min_draft_len: int = Field(0, ge=0)
+
+
 class ServingConfig(DeepSpeedConfigModel):
     """Async continuous-batching server over ``InferenceEngineV2``
     (``deepspeed_tpu.serving.AsyncInferenceServer``). Engine-level
@@ -28,6 +71,15 @@ class ServingConfig(DeepSpeedConfigModel):
     # upper bound on requests open at once (queued + running);
     # submit() past it raises. 0 = unbounded.
     max_queue: int = Field(0, ge=0)
+    # admission bound (ISSUE 19): a submit() arriving with this many
+    # requests already open is SHED — it fails fast with a
+    # RequestFailed("... shed ...") instead of aging in the mailbox
+    # (BENCH_r06: unbounded admission put 11.2 s of queue_wait in an
+    # 11.5 s TTFT p99). Shed requests are counted
+    # (ds_serving_shed_total, reqtrace outcome=shed) — never silently
+    # dropped. 0 = off (existing behavior, byte-identical); the
+    # controller tightens/relaxes the live bound at runtime.
+    shed_queue_depth: int = Field(0, ge=0)
     # preemption: a higher-priority prompt that cannot be admitted may
     # PARK strictly-lower-priority running requests — KV blocks swap
     # out (prefix-cached full blocks stay warm in the LRU), the token
@@ -59,6 +111,8 @@ class ServingConfig(DeepSpeedConfigModel):
     # same for the request's MEAN inter-token latency ->
     # ds_serving_slo_itl_breaches_total. 0 = no target.
     slo_itl_ms: float = Field(0.0, ge=0.0)
+    # online feedback controller (ISSUE 19); off by default
+    controller: ControllerConfig = Field(default_factory=ControllerConfig)
 
 
 class DisaggregationConfig(DeepSpeedConfigModel):

@@ -148,6 +148,15 @@ class AsyncInferenceServer:
         self._accepting = False
         self._stopping = False
         self._open = 0          # queued + running requests
+        # live admission bound (ISSUE 19): submits arriving with this
+        # many requests open are SHED (fast-fail, counted). Written by
+        # the config at start and by the controller on the worker
+        # thread, read by submit() on the event loop — a GIL-atomic
+        # int whose staleness costs one admit/shed decision, never
+        # correctness
+        self._shed_depth = int(config.shed_queue_depth)  # graftlint: disable=GL052
+        self._shed_count = 0    # event-loop-thread owned (like _open)
+        self._controller = None     # online feedback loop (ISSUE 19)
         self._worker_error: Optional[BaseException] = None
         self.session: Optional[FusedServeLoop] = None
         self._rt = None         # request-trace recorder (ISSUE 10)
@@ -181,6 +190,21 @@ class AsyncInferenceServer:
         if self._rt is not None:
             # SLO burn counters measure against this server's targets
             self._rt.set_slo(*_slo_seconds(cfg))
+        if cfg.controller.enabled:
+            # online feedback controller (ISSUE 19): stepped from the
+            # worker loop (every knob it turns mutates worker-owned
+            # state), reading burn rates / component p99s each interval
+            from .controller import ServingController
+            self._controller = ServingController(
+                cfg.controller,
+                chain_depth=self.session.max_depth,
+                draft_len=self.session._draft_cfg,
+                shed_depth=cfg.shed_queue_depth,
+                set_shed_depth=self._set_shed_depth,
+                set_chain_depth=self.session.set_chain_depth,
+                set_draft_len=self.session.set_draft_len,
+                registry=(tel.get_registry() if tel is not None
+                          else None))
         # GIL-atomic bool flags shared with the worker: _accepting is
         # flipped off by a dying worker (the losing race costs one
         # submit that then hits the _worker_error check), _stopping is
@@ -215,7 +239,10 @@ class AsyncInferenceServer:
     def _admit_handle(self, max_new_tokens, priority,
                       uid, prompt_tokens: int):
         """Shared submit-side bookkeeping: accept/backpressure checks,
-        handle + trace registration. Returns (handle, max_new, prio)."""
+        shed decision, handle + trace registration. Returns
+        (handle, max_new, prio, shed) — a shed handle is already
+        finished (its stream raises ``RequestFailed`` naming the shed)
+        and must NOT be posted to the worker."""
         if not self._accepting:
             raise RuntimeError("server is not accepting requests")
         if self._worker_error is not None:
@@ -226,6 +253,35 @@ class AsyncInferenceServer:
             raise RuntimeError(
                 f"serving queue full ({self._open} open requests >= "
                 f"max_queue {cfg.max_queue})")
+        shed_at = self._shed_depth
+        if shed_at and self._open >= shed_at:
+            # admission control (ISSUE 19): past the bound the request
+            # fails FAST instead of aging in the mailbox (BENCH_r06:
+            # unbounded admission buried an 11.5 s TTFT p99 under
+            # 11.2 s of queue_wait). Counted three ways — handle
+            # error, ds_serving_shed_total, reqtrace outcome=shed —
+            # never silently dropped.
+            uid = next(self._uid) if uid is None else int(uid)
+            handle = RequestHandle(uid, self)
+            msg = (f"request {uid} shed: {self._open} open requests "
+                   f">= admission bound {shed_at}")
+            self._shed_count += 1
+            tel = _telemetry()
+            if self._rt is not None:
+                handle.trace_id = self._rt.enqueue(
+                    uid, priority=int(
+                        priority if priority is not None
+                        else cfg.default_priority),
+                    prompt_tokens=prompt_tokens)
+                self._rt.finished(uid, "shed", error=msg)
+            if tel is not None:
+                reg = tel.get_registry()
+                if reg is not None:
+                    reg.counter("ds_serving_shed_total",
+                                "requests fast-failed at the admission "
+                                "bound").inc()
+            handle._push(TokenEvent(uid, [], finished=True, error=msg))
+            return handle, None, None, True
         # callers spanning several replicas (the router) pass their own
         # globally-unique uid so one request keeps ONE trace across
         # prefill hand-off, migration and reroute
@@ -246,7 +302,7 @@ class AsyncInferenceServer:
             handle.trace_id = self._rt.enqueue(
                 uid, priority=prio, prompt_tokens=prompt_tokens,
                 max_new_tokens=max_new)
-        return handle, max_new, prio
+        return handle, max_new, prio, False
 
     async def submit(self, prompt: Sequence[int], *,
                      max_new_tokens: Optional[int] = None,
@@ -255,9 +311,10 @@ class AsyncInferenceServer:
         """Queue one generation request; returns its streaming handle.
         Raises when the server is stopped or ``max_queue`` is hit."""
         toks = [int(t) for t in prompt]
-        handle, max_new, prio = self._admit_handle(
+        handle, max_new, prio, shed = self._admit_handle(
             max_new_tokens, priority, uid, len(toks))
-        self._post(("submit", handle.uid, toks, max_new, prio))
+        if not shed:
+            self._post(("submit", handle.uid, toks, max_new, prio))
         return handle
 
     async def submit_imported(self, state, *,
@@ -284,10 +341,11 @@ class AsyncInferenceServer:
             raise ValueError(
                 f"imported request already generated {n_gen} of "
                 f"{max_new_chk} tokens — finish it without a hand-off")
-        handle, max_new, prio = self._admit_handle(
+        handle, max_new, prio, shed = self._admit_handle(
             max_new_tokens, priority, uid, n_prompt)
-        self._post(("submit_imported", handle.uid, state, max_new,
-                    prio, bool(emit_carried)))
+        if not shed:
+            self._post(("submit_imported", handle.uid, state, max_new,
+                        prio, bool(emit_carried)))
         return handle
 
     async def generate(self, prompt: Sequence[int], **kw) -> list[int]:
@@ -313,8 +371,19 @@ class AsyncInferenceServer:
         if self.session is not None:
             m.update(self.session.counters)
         m["open_requests"] = self._open
+        m["shed_requests"] = self._shed_count
         m["replica"] = self.config.replica
+        if self._controller is not None:
+            m["controller_actions"] = self._controller.action_counts()
+            m["controller_chain_depth"] = self._controller.chain_depth
+            m["controller_draft_len"] = self._controller.draft_len
+            m["controller_shed_depth"] = self._controller.shed_depth
         return m
+
+    def _set_shed_depth(self, depth: int) -> None:
+        """Controller knob: move the live admission bound (worker
+        thread writes, submit() reads — GIL-atomic int)."""
+        self._shed_depth = int(depth)   # graftlint: disable=GL052
 
     # -- router-facing placement probes (ISSUE 13; all host-only) ------
     @property
@@ -393,11 +462,13 @@ class AsyncInferenceServer:
                         # the idle loop is ALIVE: without this beat an
                         # idle replica's silence would read as death
                         self._beat(tel)
+                    self._control()
                     self._wake.wait(timeout=0.1)
                     self._wake.clear()
                     continue
                 events = s.step()
                 self._observe(s)
+                self._control()
                 if events:
                     self._emit(events)
                 elif s.has_work():
@@ -448,6 +519,20 @@ class AsyncInferenceServer:
             elif m[0] == "die":
                 raise RuntimeError("fault injection: replica killed")
         return stop
+
+    def _control(self) -> None:     # graftsan: domain=worker
+        """One (rate-limited) controller interval. Runs on the worker
+        thread — the depth/draft knobs mutate session state the worker
+        owns; the shed bound crosses back to submit() GIL-atomically.
+        Works with telemetry off too: the signal reader then degrades
+        to the open-request fallback, which still protects the
+        queue."""
+        c = self._controller
+        if c is None:
+            return
+        from .controller import read_server_signals
+        tel = _telemetry()
+        c.maybe_step(lambda: read_server_signals(self, tel))
 
     def _observe(self, s: FusedServeLoop) -> None:
         """Per-step telemetry: scheduler counters -> registry, plus a

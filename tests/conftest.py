@@ -218,6 +218,19 @@ _SLOW = {
     ("test_serving.py",
      "test_ring_mode_eos_swap_constrained_and_stochastic"),
     ("test_serving.py", "test_ring_mode_in_graph_swap_occupies_slot"),
+    # serving control plane (ISSUE 19): the fake-clock controller state
+    # machine, engine-less shed admission, planner determinism/
+    # crossover and gate-row tests all stay tier-1 (no engine builds);
+    # the controller-armed burst end-to-end is the engine-heavy tail
+    # (the same path also runs in the bench serve_openloop load-step
+    # phase). Buying its seconds back: the rows-bound preemption
+    # variant below has a tier-1 sibling
+    # (test_preemption_park_restore_roundtrip covers the same
+    # park/restore path on a cheaper engine)
+    ("test_serving_control.py",
+     "test_controller_load_step_e2e_sheds_under_burst"),
+    ("test_serving.py",
+     "test_preemption_frees_decode_row_when_rows_bound"),
     ("test_prefix_cache.py",
      "test_schedule_admission_counts_only_uncached_blocks"),
     ("test_prefix_cache.py", "test_serving_metrics_schema_and_reset"),

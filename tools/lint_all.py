@@ -17,7 +17,11 @@ no jax import anywhere):
    equivalent to ``graftlint.py --select numerics``);
 3. **host-only audits** — ``traced_roots`` over the packages whose
    contract forbids jit-reachable code: ``autotuning/`` (deterministic
-   planner ranking), ``serving/`` + ``telemetry/reqtrace.py`` (the
+   planner ranking, incl. the ISSUE 19 serving planner in
+   ``autotuning/serving.py``), ``serving/`` (the async front end AND
+   the ISSUE 19 feedback controller in ``serving/controller.py`` —
+   control decisions are host arithmetic over telemetry, never
+   traced) + ``telemetry/reqtrace.py`` (the
    request-trace recorder runs on the event loop) +
    ``telemetry/{timeseries,health,fleet}.py`` (the ISSUE 17 fleet
    health plane is stdlib-only host logic), and
@@ -108,6 +112,9 @@ def run_sections() -> list[dict]:
 
     # 3. host-only package audits (no jit-reachable code allowed)
     for label, paths in (
+            # ISSUE 19: the serving planner (autotuning/serving.py)
+            # and the online controller (serving/controller.py) ride
+            # these whole-directory roots — both are host arithmetic
             ("host-only: autotuning",
              [os.path.join(_PACKAGE, "autotuning")]),
             ("host-only: serving + reqtrace + fleet plane",

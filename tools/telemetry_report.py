@@ -430,6 +430,17 @@ _GATES = {
         # chain-boundary gap, preemption stall); decode_active scales
         # with tokens generated, so gating it would flag longer
         # outputs as regressions.
+        # serving control plane (ISSUE 19, bench serve_openloop
+        # load-step phase + serve_autotune stage): goodput under the
+        # declared SLOs with the shed/controller armed must not
+        # shrink, the controlled queue-wait p99 must not creep back up
+        # (the BENCH_r06 failure), and the offline plan must keep
+        # beating the hand-tuned baseline it was ranked against. The
+        # deliberately-saturated control arms (uncontrolled_*,
+        # baseline_/plan_ ttft/itl points) are excluded below.
+        ("goodput_under_slo", +1, 0.05),
+        ("queue_wait_p99", -1, 0.15),
+        ("plan_vs_baseline", +1, 0.05),
         ("queue_wait", -1, 0.15),
         ("first_drain", -1, 0.15),
         ("boundary_gap", -1, 0.15),
@@ -536,7 +547,13 @@ _GATE_EXCLUDE = {
     # engine drift ratio and raw per-length chat ITL points exist to
     # show the degradation disaggregation removes — inherently noisy
     # and not a product metric (the disagg_* drift ratio still gates)
-    "serving": ("per_tick", "v2_tick", "single_itl", "chat_itl_p99_ms"),
+    # ... and the ISSUE 19 control arms: the uncontrolled load-step
+    # run exists to be terrible (its queue grows unbounded by design),
+    # and the saturated serve_autotune latency points grade the
+    # traffic, not the engine — the goodput ratios above still gate
+    "serving": ("per_tick", "v2_tick", "single_itl", "chat_itl_p99_ms",
+                "uncontrolled", "baseline_ttft", "plan_ttft",
+                "baseline_itl", "plan_itl", "ctl_itl", "ctl_ttft"),
     # the all-measured error includes the short-step base candidate,
     # the noisiest row — informational, the top-K figure gates
     "autotune": ("rel_err_all",),
