@@ -84,7 +84,7 @@ def _mfu_fields(tps: float, cfg, seq: int) -> dict:
     return {"mfu": round(tps * cfg.flops_per_token(seq) / peak, 4),
             "mfu_noncausal": round(
                 tps * cfg.flops_per_token(seq, causal=False) / peak, 4),
-            **_ledger_truth_fields(peak)}
+            **_ledger_truth_fields(peak), **_steptrace_fields()}
 
 
 def _ledger_truth_fields(peak: float) -> dict:
@@ -122,6 +122,26 @@ def _ledger_truth_fields(peak: float) -> dict:
         out["wire_bytes_per_el"] = {
             a: round(w, 3) for a, w in axis_wire_width(traffic).items()}
     return out
+
+
+def _steptrace_fields() -> dict:
+    """{goodput_fraction, badput_seconds, recon_max_rel_err} from the
+    steptrace run ledger when it is live (bench --telemetry, ISSUE 20):
+    the train stages' artifacts carry the goodput/badput breakdown and
+    the telescoping reconciliation error so `--gate train` can watch
+    goodput across rounds and the recon contract is checkable from the
+    bench record alone. Empty when telemetry/steptrace are off or no
+    step completed."""
+    from deepspeed_tpu.utils.telemetry_probe import active_telemetry
+    mod = active_telemetry()
+    st = mod.get_step_recorder() if mod is not None else None
+    if st is None or not st.steps_recorded:
+        return {}
+    s = st.goodput_summary()
+    return {"goodput_fraction": round(s["goodput_fraction"], 4),
+            "badput_seconds": {k: round(v, 4) for k, v in
+                               s["badput_seconds"].items()},
+            "recon_max_rel_err": s["recon_max_rel_err"]}
 
 
 def _train_tput(ds, model, config_extra: dict, batch: int, seq: int,
@@ -3143,6 +3163,66 @@ def _install_signal_handlers() -> None:
     signal.signal(signal.SIGTERM, on_term)
 
 
+def steptrace_bench(ds, on_tpu):
+    """Seeded-regression micro-phase (ISSUE 20): drive a fake-clock
+    StepTraceRecorder through a healthy plateau, then inject a slow
+    collective (excess over the calibrated device baseline on a
+    comm-carrying executable) and assert the online changepoint
+    finding names the injected component AND its owning executable.
+    Pure host arithmetic — runs in milliseconds on any rig; the
+    assertions make a detector regression a stage failure, not a
+    silent artifact drift."""
+    from deepspeed_tpu.telemetry.steptrace import StepTraceRecorder
+
+    class _Clock:
+        t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    class _Led:
+        compile_seconds: dict = {}
+
+        def collective_bytes_by_axis(self, name):
+            return {"dp": 4.2e6}
+
+    clk = _Clock()
+    rec = StepTraceRecorder(capacity=256, clock=clk,
+                            ledger=lambda: _Led(),
+                            regression_window=8,
+                            regression_threshold=0.3)
+    inject_at, detect_at = 24, None
+    for i in range(64):
+        rec.step_begin(i + 1)
+        clk.t += 0.002
+        rec.data_ready()
+        clk.t += 0.001
+        rec.h2d_done()
+        # healthy device window 10 ms; the fault adds 4 ms of exposed
+        # comm on the same executable from step `inject_at` on
+        clk.t += 0.010 if i < inject_at else 0.014
+        rec.dispatch_done("compiled_step")
+        clk.t += 0.0005
+        rec.step_end()
+        if detect_at is None and any(
+                f["component"] == "exposed_comm"
+                for f in rec.regressions()):
+            detect_at = i + 1
+    findings = rec.regressions()
+    assert findings, "seeded slow-comm fault produced no finding"
+    hit = next(f for f in findings if f["component"] == "exposed_comm")
+    assert hit["executable"] == "compiled_step", hit
+    assert rec.recon_max_rel_err <= 1e-6, rec.recon_max_rel_err
+    s = rec.goodput_summary()
+    return {"seeded_component": "exposed_comm",
+            "finding_component": hit["component"],
+            "finding_executable": hit["executable"],
+            "finding_step": hit["step"],
+            "detect_latency_steps": detect_at - inject_at,
+            "recon_max_rel_err": rec.recon_max_rel_err,
+            "goodput_fraction": round(s["goodput_fraction"], 4)}
+
+
 # headline first (its JSON goes out as soon as it lands), kernel_smoke
 # BEFORE the slow 7B sections so a harness-level timeout can only cost
 # the capability rows, not the kernel evidence
@@ -3163,6 +3243,7 @@ STAGES = [("headline", headline_bench),
           ("autotune", autotune_bench),
           ("zeropp", zeropp_bench),
           ("numsan", numsan_bench),
+          ("steptrace", steptrace_bench),
           ("domino", domino_bench),
           ("kernel_smoke", lambda *_: kernel_smoke()),
           ("serve7b", serve7b_int8),

@@ -25,6 +25,19 @@ from .checkpoint_engine import build_checkpoint_engine
 LATEST_FILE = "latest"
 
 
+def _steptrace_note(kind: str, seconds: float) -> None:
+    """Charge a save/load duration to the steptrace checkpoint/restart
+    badput buckets (ISSUE 20). Probe-resolved: no-op (and no import)
+    when telemetry is off."""
+    from ..utils.telemetry_probe import active_telemetry
+    tel = active_telemetry()
+    if tel is None:
+        return
+    st = tel.get_step_recorder()
+    if st is not None:
+        st.note_checkpoint(seconds, kind=kind)
+
+
 def _tag(engine, tag: Optional[str]) -> str:
     return tag if tag is not None else f"global_step{engine.global_steps}"
 
@@ -40,9 +53,14 @@ def _ckpt_engine(engine):
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
                     save_latest: bool = True) -> bool:
-    with _tel_span("checkpoint_save", step=engine.global_steps):
-        return _save_checkpoint(engine, save_dir, tag, client_state,
-                                save_latest)
+    import time as _time
+    t0 = _time.perf_counter()
+    try:
+        with _tel_span("checkpoint_save", step=engine.global_steps):
+            return _save_checkpoint(engine, save_dir, tag, client_state,
+                                    save_latest)
+    finally:
+        _steptrace_note("save", _time.perf_counter() - t0)
 
 
 def _save_checkpoint(engine, save_dir, tag, client_state, save_latest):
@@ -107,9 +125,15 @@ def _validate_tag(engine, tag: str):
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_module_only: bool = False):
-    with _tel_span("checkpoint_load", step=engine.global_steps):
-        return _load_checkpoint(engine, load_dir, tag,
-                                load_optimizer_states, load_module_only)
+    import time as _time
+    t0 = _time.perf_counter()
+    try:
+        with _tel_span("checkpoint_load", step=engine.global_steps):
+            return _load_checkpoint(engine, load_dir, tag,
+                                    load_optimizer_states,
+                                    load_module_only)
+    finally:
+        _steptrace_note("load", _time.perf_counter() - t0)
 
 
 def _load_checkpoint(engine, load_dir, tag, load_optimizer_states,

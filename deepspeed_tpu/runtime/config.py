@@ -245,6 +245,26 @@ class TelemetryConfig(DeepSpeedConfigModel):
     request_traces: bool = True
     # completed-trace ring capacity (requests; oldest dropped first)
     request_trace_size: int = 1024
+    # --- per-step training traces (ISSUE 20) -------------------------
+    # record one telescoped record per train_batch (step_wall =
+    # data_wait + h2d + dispatch_overhead + device_compute +
+    # exposed_comm + optimizer + checkpoint + recompile + residual,
+    # exact by construction), the run goodput/badput ledger
+    # (ds_train_goodput_fraction / ds_train_badput_seconds{bucket}),
+    # a JSONL step log, per-step Perfetto tracks, and an online
+    # mean-shift regression detector over the per-component series.
+    # Host-only ring; nothing is recorded until train_batch runs.
+    steptrace: bool = True
+    # step-record ring capacity (steps; oldest dropped first)
+    steptrace_size: int = 2048
+    # regression detector: compare the mean of the last W steps
+    # against the W before them, per component; fire when the recent
+    # mean exceeds base * (1 + threshold)
+    steptrace_regression_window: int = 32
+    steptrace_regression_threshold: float = 0.5
+    # minimum seconds between per-step straggler-skew samples (two
+    # tiny host collectives each; multiprocess only)
+    straggler_interval_s: float = 1.0
     # --- fleet health plane (ISSUE 17), opt-in on top of enabled -----
     # install the time-series ring (periodic registry snapshots ->
     # windowed rates / SLO burn), the phi-accrual health monitor, and

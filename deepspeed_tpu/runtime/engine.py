@@ -899,18 +899,28 @@ class DeepSpeedEngine:
         ``data_iter`` and the engine pulls one batch (pipeline-engine-style
         API, reference pipe/engine.py:338).
         """
+        # sys.modules probe — None (and zero telemetry work) when off
+        tel = _telemetry()
+        st = tel.get_step_recorder() if tel is not None else None
+        if st is not None:
+            # steptrace (ISSUE 20): the step window opens BEFORE the
+            # data fetch so iterator stalls land in data_wait
+            st.step_begin(self.global_steps + 1)
         if batch is None:
             if data_iter is None:
                 raise ValueError("train_batch needs a batch or data_iter")
             batch = next(data_iter)
-        # sys.modules probe — None (and zero telemetry work) when off
-        tel = _telemetry()
+        if st is not None:
+            st.data_ready()
         with (tel.span(TRAIN_BATCH_TIMER, step=self.global_steps + 1)
               if tel is not None else _NULLCM):
             batch = self._apply_curriculum(batch)
             with (tel.span("batch_to_device")
                   if tel is not None else _NULLCM):
                 batch = self._put_batch(batch)
+            if st is not None:
+                # h2d covers curriculum slicing + the device transfer
+                st.h2d_done()
             if tel is not None:
                 # device-truth hooks (ISSUE 5): BEFORE the dispatch
                 # (state is donated through the step) and OUTSIDE the
@@ -941,6 +951,11 @@ class DeepSpeedEngine:
                             self._disable_host_memory(e)
                             self.state, metrics = self._train_step(
                                 self.state, batch)
+            if st is not None:
+                # both paths dispatch the same ledger-observed
+                # executable; host bookkeeping past this point lands
+                # in dispatch_overhead
+                st.dispatch_done("compiled_step")
             self.global_steps += 1
             self.global_samples += self.train_batch_size_
             self._last_metrics = metrics
@@ -955,6 +970,16 @@ class DeepSpeedEngine:
         # cost never pollutes the step timing
         if tel is not None:
             self._telemetry_boundary(tel, metrics)
+            if jax.process_count() > 1:
+                # per-step straggler cadence (ISSUE 20): rate-limited
+                # inside, so the two tiny host collectives run at most
+                # once per straggler_interval_s; the sample feeds both
+                # the skew gauge and the steptrace straggler bucket
+                skew = tel.flightrec.maybe_record_straggler_skew(
+                    tel.get_registry(), self.global_steps,
+                    interval_s=self.config.telemetry.straggler_interval_s)
+                if skew is not None and st is not None:
+                    st.note_straggler(skew)
         if self.monitor is not None:
             # reference event set (engine.py:2348 _write_monitor): loss,
             # lr, and the loss scale when fp16 is live
@@ -974,6 +999,10 @@ class DeepSpeedEngine:
                                float(metrics["loss_scale"]),
                                self.global_samples))
             self.monitor.write_events(events)
+        if st is not None:
+            # the step window closes AFTER the boundary/monitor work so
+            # flush cost telescopes into dispatch_overhead, not the gap
+            st.step_end()
         return metrics["loss"]
 
     def _dispatch_scope(self, batch):
@@ -1110,11 +1139,12 @@ class DeepSpeedEngine:
                 # counters/memory/comms without blocking dispatch-ahead
                 tel.bridges.record_train_step(
                     reg, self, metrics if on_print else None)
-                if jax.process_count() > 1:
-                    # per-step straggler skew: two tiny host
-                    # collectives, boundary cadence only (ISSUE 5)
-                    tel.flightrec.record_straggler_skew(
-                        reg, self.global_steps)
+                st = tel.get_step_recorder()
+                if st is not None:
+                    # overflow badput feed (ISSUE 20): the step-counter
+                    # sync is already paid by record_train_step's
+                    # ds_overflow_steps_total read just above
+                    st.note_overflow_total(self.overflow_steps)
                 if self.monitor is not None and self.monitor.enabled:
                     tel.bridges.flush_to_monitor(
                         self.monitor, self.global_samples)

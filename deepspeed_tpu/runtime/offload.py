@@ -30,6 +30,7 @@ reference.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -186,10 +187,16 @@ class NVMeOffloadOptimizer:
         NVMe: read shard i+1's moments from disk while shard i computes;
         write shard i's right after. RAM high-water: 2 shards of moments."""
         tel = _tel()
+        t0 = time.perf_counter() if tel is not None else 0.0
         with (tel.span("nvme_opt_step", step=self._step + 1)
               if tel is not None else _NULLCM):
             out = self._step_impl(grads, lr, grad_scale)
         if tel is not None:
+            st = tel.get_step_recorder()
+            if st is not None:
+                # steptrace optimizer bucket (ISSUE 20): host optimizer
+                # time inside the current step's dispatch window
+                st.note_offload(time.perf_counter() - t0)
             reg = tel.get_registry()
             if reg is not None:
                 reg.counter("ds_offload_nvme_steps_total",

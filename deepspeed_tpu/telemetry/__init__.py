@@ -37,7 +37,7 @@ from . import (bridges, collectives, flightrec as _flightrec_mod,  # noqa: F401
                fleet as _fleet_mod, health as _health_mod,
                ledger as _ledger_mod, registry as _registry_mod,
                reqtrace as _reqtrace_mod, spans as _spans_mod,
-               timeseries as _timeseries_mod)
+               steptrace as _steptrace_mod, timeseries as _timeseries_mod)
 from .fleet import FleetScope, get_fleet  # noqa: F401
 from .flightrec import (FlightRecorder, HangWatchdog,  # noqa: F401
                         get_flight_recorder, get_watchdog)
@@ -49,6 +49,8 @@ from .registry import (Counter, Gauge, Histogram,  # noqa: F401
 from .reqtrace import (RequestTraceRecorder,  # noqa: F401
                        get_request_recorder)
 from .spans import NULL_CONTEXT, SpanTracer, get_tracer  # noqa: F401
+from .steptrace import (StepTraceRecorder,  # noqa: F401
+                        get_step_recorder)
 from .timeseries import TimeSeriesRing, get_timeseries  # noqa: F401
 
 _ACTIVE = False
@@ -73,6 +75,10 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
               watchdog_abort: Optional[bool] = None,
               request_traces: Optional[bool] = None,
               request_trace_size: Optional[int] = None,
+              steptrace: Optional[bool] = None,
+              steptrace_size: Optional[int] = None,
+              steptrace_regression_window: Optional[int] = None,
+              steptrace_regression_threshold: Optional[float] = None,
               fleet: Optional[bool] = None,
               fleet_replica: Optional[str] = None,
               timeseries_capacity: Optional[int] = None,
@@ -125,6 +131,22 @@ def configure(config=None, *, span_buffer_size: Optional[int] = None,
     if ledger_on:
         _ledger_mod.set_ledger(ExecutableLedger(
             hlo_collectives=hlo_coll))
+    if pick(steptrace, "steptrace", True):
+        # per-step training traces (ISSUE 20): host-only ring like
+        # reqtrace; the engine resolves it through the probe and guards
+        # every call, so nothing is recorded until train_batch runs.
+        # The ledger/timeseries hooks are zero-arg accessors — wiring
+        # stays correct whether those layers are on, off, or re-wired.
+        _steptrace_mod.set_step_recorder(StepTraceRecorder(
+            capacity=pick(steptrace_size, "steptrace_size", 2048),
+            registry=_registry_mod.get_registry(),
+            ledger=_ledger_mod.get_ledger,
+            timeseries=_timeseries_mod.get_timeseries,
+            regression_window=pick(steptrace_regression_window,
+                                   "steptrace_regression_window", 32),
+            regression_threshold=pick(
+                steptrace_regression_threshold,
+                "steptrace_regression_threshold", 0.5)))
     if flight_on:
         rec = FlightRecorder(capacity=flight_cap)
         _flightrec_mod.set_flight_recorder(rec)
@@ -198,6 +220,7 @@ def shutdown() -> None:
     _flightrec_mod.set_watchdog(None)
     _flightrec_mod.set_flight_recorder(None)
     _ledger_mod.set_ledger(None)
+    _steptrace_mod.set_step_recorder(None)
     _reqtrace_mod.set_request_recorder(None)
     _spans_mod.set_tracer(None)
     _registry_mod.set_registry(None)
@@ -221,6 +244,9 @@ def clear() -> None:
     rt = get_request_recorder()
     if rt is not None:
         rt.clear()
+    st = get_step_recorder()
+    if st is not None:
+        st.clear()
     ts = get_timeseries()
     if ts is not None:
         ts.clear()
@@ -268,6 +294,9 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
     rt = get_request_recorder()
     if rt is not None:
         rt.collect(reg)     # component p50/p99 gauges
+    st = get_step_recorder()
+    if st is not None:
+        st.collect(reg)     # goodput/badput + step-component gauges
     hm = get_health_monitor()
     if hm is not None:
         hm.collect(reg)     # ds_fleet_replica_{phi,score,state} gauges
@@ -276,11 +305,16 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
     # document as the host spans — one named tid per request — so
     # `telemetry_report --merge` composes them per rank unchanged
     doc = tracer.chrome_trace()
+    pid = doc["traceEvents"][0].get("pid", 0) \
+        if doc["traceEvents"] else 0
     if rt is not None:
-        pid = doc["traceEvents"][0].get("pid", 0) \
-            if doc["traceEvents"] else 0
         doc["traceEvents"].extend(
             rt.chrome_events(pid, tracer.epoch_ns))
+    if st is not None:
+        # per-step training tracks (ISSUE 20) share the document too,
+        # so --merge composes steps + components alongside host spans
+        doc["traceEvents"].extend(
+            st.chrome_events(pid, tracer.epoch_ns))
     trace_path = os.path.join(out_dir, f"{prefix}.trace.json")
     import json as _json
     with open(trace_path, "w") as f:
@@ -296,6 +330,13 @@ def export_artifacts(out_dir: str, prefix: str = "telemetry",
             os.path.join(out_dir, f"{prefix}.access.jsonl"))
         if log_path:
             out["access_log"] = log_path
+    if st is not None:
+        # step log: one STEP_LOG_KEYS JSONL line per training step;
+        # telemetry_report --diff accepts it as a numeric source
+        log_path = st.write_step_log(
+            os.path.join(out_dir, f"{prefix}.steps.jsonl"))
+        if log_path:
+            out["step_log"] = log_path
     led = get_ledger()
     if led is not None:
         import json as _json
@@ -328,4 +369,5 @@ def dump_flight_record(reason: str,
     return _flightrec_mod.dump_state(
         reason, out_dir or _ARTIFACT_DIR, recorder=rec,
         tracer=get_tracer(), ledger=get_ledger(),
-        registry=get_registry(), reqtrace=get_request_recorder())
+        registry=get_registry(), reqtrace=get_request_recorder(),
+        steptrace=get_step_recorder())

@@ -136,6 +136,28 @@ def record_straggler_skew(reg, step: int, now: Optional[float] = None,
     return skew
 
 
+_SKEW_NEXT = 0.0
+
+
+def maybe_record_straggler_skew(reg, step: int,
+                                interval_s: float = 1.0,
+                                monotonic_now: Optional[float] = None,
+                                now: Optional[float] = None,
+                                reduce_fn=None) -> Optional[float]:
+    """Rate-limited :func:`record_straggler_skew` for a per-step call
+    cadence (ISSUE 20): the engine ticks this every ``train_batch``
+    (same ``process_count > 1`` guard as before) and the two tiny host
+    collectives actually run at most once per ``interval_s``. Same
+    ``ds_straggler_skew_seconds`` gauge. Returns the skew when a sample
+    was taken, None when inside the interval."""
+    global _SKEW_NEXT
+    t = time.monotonic() if monotonic_now is None else monotonic_now
+    if t < _SKEW_NEXT:
+        return None
+    _SKEW_NEXT = t + max(float(interval_s), 0.0)
+    return record_straggler_skew(reg, step, now=now, reduce_fn=reduce_fn)
+
+
 # --- hang dump -----------------------------------------------------------
 
 def _thread_stacks() -> dict:
@@ -150,7 +172,8 @@ def _thread_stacks() -> dict:
 
 
 def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
-               ledger=None, registry=None, reqtrace=None) -> str:
+               ledger=None, registry=None, reqtrace=None,
+               steptrace=None) -> str:
     """Write one self-contained hang-dump JSON artifact and return its
     path. Safe to call from any thread (the watchdog's, bench's
     budget watchdog, a signal handler's deferred path); never raises —
@@ -186,6 +209,18 @@ def dump_state(reason: str, out_dir: str, recorder=None, tracer=None,
             doc["in_flight_requests"] = reqtrace.in_flight()
     except Exception as e:   # noqa: BLE001
         doc["in_flight_requests_error"] = repr(e)
+    try:
+        # the recent training STEPS (ISSUE 20): last N telescoped step
+        # records, the run goodput/badput ledger, and any regression
+        # findings — a training hang's dump says what the steps were
+        # spending time on right before the stall
+        if steptrace is not None:
+            doc["steptrace"] = {
+                "last_steps": steptrace.last_steps(16),
+                "goodput": steptrace.goodput_summary(),
+                "regressions": steptrace.regressions()}
+    except Exception as e:   # noqa: BLE001
+        doc["steptrace_error"] = repr(e)
     try:
         if registry is not None:
             doc["metrics"] = registry.snapshot()
@@ -336,11 +371,12 @@ class HangWatchdog:
         """Dump now, regardless of stall state (bench's total-budget
         watchdog routes through here)."""
         from . import (get_ledger, get_registry, get_request_recorder,
-                       get_tracer)
+                       get_step_recorder, get_tracer)
         path = dump_state(reason, self.artifact_dir,
                           recorder=self.recorder, tracer=get_tracer(),
                           ledger=get_ledger(), registry=get_registry(),
-                          reqtrace=get_request_recorder())
+                          reqtrace=get_request_recorder(),
+                          steptrace=get_step_recorder())
         if path:
             self.dumps.append(path)
             from ..utils.logging import logger

@@ -1,0 +1,635 @@
+"""Per-step training trace + goodput/badput ledger + online regression
+attribution (ISSUE 20 tentpole) — the training-side mirror of
+:mod:`.reqtrace`.
+
+The training loop's telemetry so far is aggregate: spans time
+``train_batch`` and the ledger knows what a compiled step *costs*, but
+nothing reconciles one step's wall time into named components, nothing
+accounts goodput vs badput across a run, and a step-time regression is
+still diagnosed by hand. This module records one host-side record per
+``engine.train_batch`` and derives an EXACT telescoping decomposition::
+
+    step_wall = data_wait + h2d + dispatch_overhead + device_compute
+              + exposed_comm + optimizer + checkpoint + recompile
+              + residual
+
+where ``step_wall`` spans from the PREVIOUS step's end (so checkpoint
+saves and data stalls between steps are inside the telescoping, not
+lost), ``device_compute`` is the per-executable calibration baseline
+(the running minimum of the cleaned dispatch window — the PR 7
+cost-model convention: the baseline already contains overlapped comm),
+``exposed_comm`` is the excess over that baseline when the ledger says
+the executable carries collectives (excess on a collective-free
+executable is host jitter and lands in ``dispatch_overhead``),
+``recompile`` is charged from the jax compile-event listener's
+per-phase seconds (via the executable ledger), and ``residual`` closes
+the telescoping exactly — ``recon_max_rel_err`` (float-associativity
+noise, <= 1e-6 by construction) is exported so the contract is
+checkable from artifacts alone.
+
+On top of the per-step records:
+
+- a run-level **goodput/badput ledger**: goodput fraction = productive
+  device seconds / wall, badput bucketed into ``compile``, ``overflow``
+  (skipped steps via ``ds_overflow_steps_total``), ``checkpoint``,
+  ``data_wait``, ``straggler`` (cross-rank skew samples) and
+  ``restart`` (checkpoint loads), exported as
+  ``ds_train_goodput_fraction`` + ``ds_train_badput_seconds{bucket}``;
+- a JSONL **step log** with the stable :data:`STEP_LOG_KEYS` schema
+  (one line per step; ``telemetry_report --diff`` aggregates it as a
+  numeric source);
+- per-step **Perfetto tracks** composable with ``--merge``;
+- an online **regression detector**: sliding-window mean-shift
+  changepoints per component that emit findings NAMING the moved
+  component, the owning executable, and the step index, bumping
+  ``ds_steptrace_regressions_total{component}`` and riding the
+  hang-watchdog dump.
+
+Host-only, stdlib-only (graftlint host-only package audit applies);
+zero-import when telemetry is disabled — the engine resolves the
+recorder through the telemetry probe and guards every call. The ledger
+and timeseries ring are handed in as zero-arg accessors so this module
+imports nothing outside the stdlib.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+# the telescoping components, in telescoped order (a step's Perfetto
+# component track lays them out sequentially in exactly this order)
+COMPONENT_KEYS = ("data_wait", "h2d", "dispatch_overhead",
+                  "device_compute", "exposed_comm", "optimizer",
+                  "checkpoint", "recompile", "residual")
+
+# one JSONL step-log line per finalized step — the stable schema
+# consumers (and the schema test) hold on to. *_ms components
+# telescope: their sum equals step_wall_ms exactly (residual included);
+# straggler_skew_ms is an attribution overlay (the skew overlaps the
+# dispatch wait), NOT a tenth telescoping term.
+STEP_LOG_KEYS = ("step", "unix_s", "executable", "step_wall_ms",
+                 "data_wait_ms", "h2d_ms", "dispatch_overhead_ms",
+                 "device_compute_ms", "exposed_comm_ms", "optimizer_ms",
+                 "checkpoint_ms", "recompile_ms", "residual_ms",
+                 "straggler_skew_ms", "recon_rel_err")
+
+# run-level badput buckets (seconds) — see goodput_summary()
+BADPUT_BUCKETS = ("compile", "overflow", "checkpoint", "data_wait",
+                  "straggler", "restart")
+
+# components owned by the compiled executable (regression findings on
+# these name the executable; the rest are host-side)
+_DEVICE_COMPONENTS = frozenset(
+    ("device_compute", "exposed_comm", "recompile", "optimizer",
+     "dispatch_overhead"))
+
+_FINDINGS_CAP = 128
+
+
+class StepRecord:
+    """One finalized training step. Timestamps are recorder-clock
+    (default ``time.perf_counter``) seconds, the span tracer's clock
+    family, so the Chrome export shares the host-span timebase."""
+
+    __slots__ = ("step", "unix_s", "executable", "t_end", "step_wall",
+                 "components", "straggler_s", "recon_rel_err")
+
+    def __init__(self, step: int, unix_s: float, executable: str,
+                 t_end: float, step_wall: float, components: dict,
+                 straggler_s: float, recon_rel_err: float):
+        self.step = step
+        self.unix_s = unix_s
+        self.executable = executable
+        self.t_end = t_end
+        self.step_wall = step_wall
+        self.components = components
+        self.straggler_s = straggler_s
+        self.recon_rel_err = recon_rel_err
+
+    def log_row(self) -> dict:
+        def ms(v: float) -> float:
+            return round(v * 1e3, 6)
+
+        row = {"step": self.step, "unix_s": round(self.unix_s, 6),
+               "executable": self.executable,
+               "step_wall_ms": ms(self.step_wall)}
+        for name in COMPONENT_KEYS:
+            row[f"{name}_ms"] = ms(self.components[name])
+        row["straggler_skew_ms"] = ms(self.straggler_s)
+        row["recon_rel_err"] = self.recon_rel_err
+        return row
+
+
+class _Pending:
+    """The step currently being traced (between step_begin and
+    step_end)."""
+
+    __slots__ = ("step", "t_begin", "t_data", "t_h2d", "t_disp",
+                 "executable", "compile_at_begin", "offload_s",
+                 "straggler_s", "unix_s")
+
+    def __init__(self, step: int, now: float, unix_s: float,
+                 compile_at_begin: float):
+        self.step = step
+        self.t_begin = now
+        self.t_data = now
+        self.t_h2d = now
+        self.t_disp = now
+        self.executable = "compiled_step"
+        self.compile_at_begin = compile_at_begin
+        self.offload_s = 0.0
+        self.straggler_s = 0.0
+        self.unix_s = unix_s
+
+
+class StepTraceRecorder:
+    """Bounded recorder of per-train-step telescoping records plus the
+    run-level goodput/badput ledger and the online regression detector.
+    All methods are host-only and O(1)-ish per step (the detector is
+    O(components x window) of float means); registry work happens at
+    :meth:`collect` (export boundaries) except the regressions counter,
+    bumped once per finding."""
+
+    def __init__(self, capacity: int = 2048, registry=None,
+                 ledger: Optional[Callable] = None,
+                 timeseries: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 regression_window: int = 32,
+                 regression_threshold: float = 0.5,
+                 regression_min_shift_s: float = 1e-4):
+        self.capacity = max(int(capacity), 8)
+        self._done: deque[StepRecord] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._registry = registry
+        # zero-arg accessors (stdlib shell: never imports siblings)
+        self._ledger_fn = ledger
+        self._timeseries_fn = timeseries
+        self._cur: Optional[_Pending] = None
+        self._prev_end: Optional[float] = None
+        self._run_start: Optional[float] = None
+        # per-executable calibration baseline: running min of the
+        # cleaned dispatch window (recompile/optimizer removed) — the
+        # "no interference" device seconds the excess is measured over
+        self._baseline: dict[str, float] = {}
+        self._has_comm: dict[str, bool] = {}
+        # charges accumulated between/inside steps
+        self._pending_ckpt = 0.0
+        # run-level accounting (survives ring eviction)
+        self._n_steps = 0
+        self._wall_s_total = 0.0
+        self._device_s_total = 0.0
+        self._data_wait_s_total = 0.0
+        self._ckpt_s_total = 0.0
+        self._restart_s_total = 0.0
+        self._straggler_s_total = 0.0
+        self._recompile_s_total = 0.0
+        self._overflow_total = 0
+        self.recon_max_rel_err = 0.0
+        # regression detector state
+        self.regression_window = max(int(regression_window), 2)
+        self.regression_threshold = float(regression_threshold)
+        self.regression_min_shift_s = float(regression_min_shift_s)
+        self._history: dict[str, deque] = {}
+        self._findings: deque[dict] = deque(maxlen=_FINDINGS_CAP)
+
+    # -- configuration -------------------------------------------------
+    def set_registry(self, registry) -> None:
+        self._registry = registry
+
+    def _ledger(self):
+        fn = self._ledger_fn
+        if fn is None:
+            return None
+        return fn() if callable(fn) else fn
+
+    def _compile_total(self) -> float:
+        """Process-wide compile seconds so far (every phase), from the
+        jax.monitoring listener via the executable ledger; 0.0 when the
+        ledger is off (the listener's plain tallies carry counts, not
+        seconds)."""
+        led = self._ledger()
+        if led is None:
+            return 0.0
+        try:
+            return float(sum(led.compile_seconds.values()))
+        except Exception:   # noqa: BLE001 - telemetry never raises
+            return 0.0
+
+    def _executable_has_comm(self, name: str) -> bool:
+        """Does this executable carry collectives (per the ledger's HLO
+        accounting)? Sticky-cached once true — collective content is a
+        compile-time property of the executable."""
+        if self._has_comm.get(name):
+            return True
+        led = self._ledger()
+        if led is None:
+            return False
+        try:
+            has = bool(led.collective_bytes_by_axis(name))
+        except Exception:   # noqa: BLE001
+            return False
+        if has:
+            self._has_comm[name] = True
+        return has
+
+    # -- per-step lifecycle (engine call sites, probe-guarded) ---------
+    def step_begin(self, step: int) -> None:
+        """``train_batch`` entered (before the data fetch)."""
+        now = self._clock()
+        with self._lock:
+            if self._run_start is None:
+                self._run_start = now
+            self._cur = _Pending(int(step), now, time.time(),
+                                 self._compile_total())
+
+    def data_ready(self) -> None:
+        """The batch is in hand (``next(data_iter)`` returned / the
+        caller passed one)."""
+        with self._lock:
+            if self._cur is not None:
+                self._cur.t_data = self._clock()
+
+    def h2d_done(self) -> None:
+        """Batch staged on device (curriculum slicing + transfer)."""
+        with self._lock:
+            if self._cur is not None:
+                self._cur.t_h2d = self._clock()
+
+    def dispatch_done(self, executable: str = "compiled_step") -> None:
+        """The step dispatch returned to the host (with donated state
+        the window tracks true per-step device wall in steady state)."""
+        with self._lock:
+            if self._cur is not None:
+                self._cur.t_disp = self._clock()
+                self._cur.executable = str(executable)
+
+    def note_checkpoint(self, seconds: float, kind: str = "save") -> None:
+        """A checkpoint save/load took ``seconds``. Saves charge the
+        ``checkpoint`` telescoping component of the NEXT step (the stall
+        sits in the inter-step gap) and the ``checkpoint`` badput
+        bucket; loads charge the ``restart`` bucket (a load mid-run IS
+        the restart cost elasticity pays)."""
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            if kind == "load":
+                self._restart_s_total += s
+            else:
+                self._ckpt_s_total += s
+            self._pending_ckpt += s
+
+    def note_offload(self, seconds: float) -> None:
+        """Host-side optimizer/offload work inside the current step's
+        dispatch window (the NVMe-tier ``nvme_opt_step``)."""
+        with self._lock:
+            if self._cur is not None:
+                self._cur.offload_s += max(float(seconds), 0.0)
+
+    def note_straggler(self, skew_s: float) -> None:
+        """A cross-rank skew sample landed for the current step (the
+        rate-limited per-step ``record_straggler_skew`` cadence)."""
+        s = max(float(skew_s), 0.0)
+        with self._lock:
+            self._straggler_s_total += s
+            if self._cur is not None:
+                self._cur.straggler_s += s
+
+    def note_overflow_total(self, n: int) -> None:
+        """Latest device-truth overflow-step count (the engine reads
+        ``overflow_steps`` at flush boundaries where the sync is
+        already paid — mirrors ``ds_overflow_steps_total``)."""
+        with self._lock:
+            self._overflow_total = max(self._overflow_total, int(n))
+
+    def step_end(self) -> Optional[StepRecord]:
+        """Finalize the current step: derive the exact telescoping
+        decomposition, update the run ledger and calibration baseline,
+        run the regression detector, and append the record."""
+        now = self._clock()
+        with self._lock:
+            cur, self._cur = self._cur, None
+            if cur is None:
+                return None
+            rec = self._finalize(cur, now)
+            self._done.append(rec)
+        self._detect(rec)
+        ts_fn = self._timeseries_fn
+        ring = ts_fn() if callable(ts_fn) else None
+        if ring is not None:
+            try:
+                ring.maybe_sample(self._registry)
+            except Exception:   # noqa: BLE001
+                pass
+        return rec
+
+    def _finalize(self, cur: _Pending, now: float) -> StepRecord:
+        prev_end = self._prev_end
+        self._prev_end = now
+        gap = (max(cur.t_begin - prev_end, 0.0)
+               if prev_end is not None else 0.0)
+        fetch = max(cur.t_data - cur.t_begin, 0.0)
+        h2d = max(cur.t_h2d - cur.t_data, 0.0)
+        window = max(cur.t_disp - cur.t_h2d, 0.0)
+        tail = max(now - cur.t_disp, 0.0)
+        step_wall = gap + fetch + h2d + window + tail
+
+        # inter-step gap: the checkpoint stall first, data wait takes
+        # the rest (plus the in-step fetch)
+        ckpt = min(self._pending_ckpt, gap)
+        self._pending_ckpt = max(self._pending_ckpt - ckpt, 0.0)
+        data_wait = (gap - ckpt) + fetch
+
+        # dispatch window: compile charge (the listener's per-phase
+        # seconds delta across the step — first-sight ledger AOT
+        # registration included), then host optimizer/offload, then
+        # the calibrated device baseline; the excess over the baseline
+        # is exposed comm on a collective-carrying executable, host
+        # jitter otherwise
+        recompile = min(max(self._compile_total() - cur.compile_at_begin,
+                            0.0), window)
+        optimizer = min(cur.offload_s, window - recompile)
+        cleaned = window - recompile - optimizer
+        base = self._baseline.get(cur.executable)
+        if recompile <= 0.0:
+            # only compile-free steps calibrate: a compiling step's
+            # cleaned window is whatever scraps the build left over,
+            # not a device measurement — as a running-min seed it
+            # would zero device_compute for the whole run
+            base = cleaned if base is None else min(base, cleaned)
+            self._baseline[cur.executable] = base
+        device_compute = cleaned if base is None else min(base, cleaned)
+        excess = cleaned - device_compute
+        if self._executable_has_comm(cur.executable):
+            exposed_comm = excess
+            dispatch_overhead = tail
+        else:
+            exposed_comm = 0.0
+            dispatch_overhead = tail + excess
+
+        components = {
+            "data_wait": data_wait, "h2d": h2d,
+            "dispatch_overhead": dispatch_overhead,
+            "device_compute": device_compute,
+            "exposed_comm": exposed_comm, "optimizer": optimizer,
+            "checkpoint": ckpt, "recompile": recompile}
+        components["residual"] = step_wall - sum(components.values())
+        recon = (abs(step_wall - sum(components.values()))
+                 / max(step_wall, 1e-12))
+        self.recon_max_rel_err = max(self.recon_max_rel_err, recon)
+
+        self._n_steps += 1
+        self._wall_s_total += step_wall
+        self._device_s_total += device_compute
+        self._data_wait_s_total += data_wait
+        self._recompile_s_total += recompile
+        return StepRecord(cur.step, cur.unix_s, cur.executable, now,
+                          step_wall, components, cur.straggler_s, recon)
+
+    # -- regression detector -------------------------------------------
+    def _detect(self, rec: StepRecord) -> None:
+        """Sliding-window mean-shift changepoint per component: the
+        mean of the last W steps against the mean of the W before
+        them. The warmup step (first record — XLA compile) never
+        enters the history."""
+        if self._n_steps <= 1:
+            return
+        w = self.regression_window
+        series = dict(rec.components)
+        series["step_wall"] = rec.step_wall
+        for name, value in series.items():
+            hist = self._history.setdefault(name, deque(maxlen=2 * w))
+            hist.append(value)
+            if len(hist) < 2 * w:
+                continue
+            vals = list(hist)
+            base = sum(vals[:w]) / w
+            recent = sum(vals[w:]) / w
+            shift = recent - base
+            if (shift < self.regression_min_shift_s
+                    or recent <= base * (1.0 + self.regression_threshold)):
+                continue
+            owner = (rec.executable if name in _DEVICE_COMPONENTS
+                     or name == "step_wall" else "host")
+            finding = {"step": rec.step, "component": name,
+                       "executable": owner,
+                       "base_mean_s": round(base, 6),
+                       "recent_mean_s": round(recent, 6),
+                       "shift_s": round(shift, 6),
+                       "ratio": round(recent / max(base, 1e-12), 4)}
+            self._findings.append(finding)
+            hist.clear()    # re-baseline: one finding per shift
+            reg = self._registry
+            if reg is not None:
+                reg.counter(
+                    "ds_steptrace_regressions_total",
+                    "mean-shift changepoints detected in the per-step "
+                    "component series (the finding names the moved "
+                    "component, its owning executable, and the step)"
+                ).inc(component=name)
+
+    # -- run-level goodput/badput ledger -------------------------------
+    def goodput_summary(self, now: Optional[float] = None) -> dict:
+        """Run-level ledger: goodput fraction = productive device
+        seconds / wall since the first step; badput bucketed per
+        :data:`BADPUT_BUCKETS`. The ``overflow`` bucket charges the
+        skipped-step count (``ds_overflow_steps_total``) at the mean
+        step wall — the whole step was spent to apply nothing."""
+        with self._lock:
+            n = self._n_steps
+            if n == 0 or self._run_start is None:
+                return {"steps": 0, "goodput_fraction": 0.0,
+                        "productive_device_s": 0.0, "wall_s": 0.0,
+                        "recon_max_rel_err": self.recon_max_rel_err,
+                        "badput_seconds": dict.fromkeys(BADPUT_BUCKETS,
+                                                        0.0)}
+            t = self._clock() if now is None else float(now)
+            wall = max(t - self._run_start, 1e-12)
+            mean_wall = self._wall_s_total / n
+            mean_dev = self._device_s_total / n
+            overflow_s = self._overflow_total * mean_wall
+            productive = max(self._device_s_total
+                             - self._overflow_total * mean_dev, 0.0)
+            badput = {
+                "compile": (self._compile_total()
+                            or self._recompile_s_total),
+                "overflow": overflow_s,
+                "checkpoint": self._ckpt_s_total,
+                "data_wait": self._data_wait_s_total,
+                "straggler": self._straggler_s_total,
+                "restart": self._restart_s_total}
+            return {"steps": n,
+                    "goodput_fraction": min(productive / wall, 1.0),
+                    "productive_device_s": productive, "wall_s": wall,
+                    "overflow_steps": self._overflow_total,
+                    "recon_max_rel_err": self.recon_max_rel_err,
+                    "badput_seconds": badput}
+
+    # -- registry export -----------------------------------------------
+    def collect(self, reg=None, now: Optional[float] = None) -> None:
+        """Goodput/badput/recon gauges + component p50/p99 gauges from
+        the step ring (export boundaries only)."""
+        reg = reg if reg is not None else self._registry
+        if reg is None:
+            return
+        s = self.goodput_summary(now=now)
+        if not s["steps"]:
+            return
+        reg.gauge("ds_train_goodput_fraction",
+                  "productive device seconds / run wall seconds "
+                  "(steptrace run ledger)").set(
+            round(s["goodput_fraction"], 6))
+        bad = reg.gauge(
+            "ds_train_badput_seconds",
+            "run seconds lost per badput bucket: compile, overflow-"
+            "skipped steps, checkpoint saves, data wait, straggler "
+            "skew, restart (checkpoint loads)")
+        for bucket, v in s["badput_seconds"].items():
+            bad.set(round(v, 6), bucket=bucket)
+        reg.gauge("ds_steptrace_recon_max_rel_err",
+                  "worst per-step telescoping reconciliation error "
+                  "(|sum(components) - step_wall| / step_wall; float "
+                  "noise only — the decomposition is exact by "
+                  "construction)").set(self.recon_max_rel_err)
+        reg.gauge("ds_steptrace_steps",
+                  "training steps the steptrace recorder finalized"
+                  ).set(s["steps"])
+        pcts = self.component_percentiles()
+        if pcts:
+            p50 = reg.gauge("ds_train_step_component_p50_seconds",
+                            "median per-step telescoping component "
+                            "over the step-record ring")
+            p99 = reg.gauge("ds_train_step_component_p99_seconds",
+                            "p99 per-step telescoping component over "
+                            "the step-record ring")
+            for name, row in pcts.items():
+                p50.set(round(row["p50"], 6), component=name)
+                p99.set(round(row["p99"], 6), component=name)
+
+    # -- readers -------------------------------------------------------
+    def completed(self) -> list[StepRecord]:
+        with self._lock:
+            return list(self._done)
+
+    @property
+    def steps_recorded(self) -> int:
+        return self._n_steps
+
+    def component_percentiles(self) -> dict[str, dict]:
+        """{component: {p50, p99, mean, n}} seconds over the step ring
+        (``step_wall`` rides along as a pseudo-component)."""
+        rows = self.completed()
+        if not rows:
+            return {}
+        out = {}
+        for name in COMPONENT_KEYS + ("step_wall",):
+            vals = sorted((r.step_wall if name == "step_wall"
+                           else r.components[name]) for r in rows)
+            out[name] = {"p50": vals[len(vals) // 2],
+                         "p99": vals[min(len(vals) - 1,
+                                         int(len(vals) * 0.99))],
+                         "mean": sum(vals) / len(vals), "n": len(vals)}
+        return out
+
+    def regressions(self) -> list[dict]:
+        return list(self._findings)
+
+    def last_steps(self, n: int = 16) -> list[dict]:
+        """The last ``n`` step-log rows — the hang-watchdog dump's
+        'what were the recent steps doing' section."""
+        rows = self.completed()[-max(int(n), 1):]
+        return [r.log_row() for r in rows]
+
+    # -- artifact export -----------------------------------------------
+    def write_step_log(self, path: str) -> Optional[str]:
+        """JSONL, one :data:`STEP_LOG_KEYS` line per finalized step.
+        Returns the path, or None when no step completed."""
+        rows = self.completed()
+        if not rows:
+            return None
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r.log_row(), sort_keys=True) + "\n")
+        return path
+
+    def chrome_events(self, pid: int, epoch_ns: int) -> list[dict]:
+        """Two named tracks for the Chrome-trace export: one slice per
+        step, and the telescoped components laid out sequentially
+        inside each step's window (exact by construction, so the
+        component track tiles the step track with no gaps). ``epoch_ns``
+        is the span tracer's epoch so the tracks share the host-span
+        timebase; tids sit clear of real thread ids AND the reqtrace
+        request tracks (0x52xxxx)."""
+        rows = self.completed()
+        if not rows:
+            return []
+        tid_steps, tid_comp = 0x570000, 0x570001
+        events: list[dict] = [
+            {"name": "thread_name", "ph": "M", "pid": pid,
+             "tid": tid_steps, "args": {"name": "train steps"}},
+            {"name": "thread_name", "ph": "M", "pid": pid,
+             "tid": tid_comp, "args": {"name": "train step components"}},
+        ]
+
+        def us(t: float) -> float:
+            return round((t * 1e9 - epoch_ns) / 1e3, 3)
+
+        for r in rows:
+            t0 = r.t_end - r.step_wall
+            events.append({
+                "name": f"step {r.step}", "ph": "X", "ts": us(t0),
+                "dur": round(r.step_wall * 1e6, 3), "pid": pid,
+                "tid": tid_steps, "cat": "steptrace",
+                "args": {"step": r.step, "executable": r.executable,
+                         "recon_rel_err": r.recon_rel_err}})
+            cur = t0
+            for name in COMPONENT_KEYS:
+                v = r.components[name]
+                if v <= 0:
+                    continue
+                events.append({
+                    "name": f"step/{name}", "ph": "X", "ts": us(cur),
+                    "dur": round(v * 1e6, 3), "pid": pid,
+                    "tid": tid_comp, "cat": "steptrace",
+                    "args": {"step": r.step}})
+                cur += v
+        return events
+
+    def clear(self) -> None:
+        with self._lock:
+            self._done.clear()
+            self._cur = None
+            self._prev_end = None
+            self._run_start = None
+            self._baseline.clear()
+            self._has_comm.clear()
+            self._pending_ckpt = 0.0
+            self._n_steps = 0
+            self._wall_s_total = 0.0
+            self._device_s_total = 0.0
+            self._data_wait_s_total = 0.0
+            self._ckpt_s_total = 0.0
+            self._restart_s_total = 0.0
+            self._straggler_s_total = 0.0
+            self._recompile_s_total = 0.0
+            self._overflow_total = 0
+            self.recon_max_rel_err = 0.0
+            self._history.clear()
+            self._findings.clear()
+
+
+# --- module-level current recorder (wired by telemetry.configure) --------
+
+_RECORDER: Optional[StepTraceRecorder] = None
+
+
+def get_step_recorder() -> Optional[StepTraceRecorder]:
+    return _RECORDER
+
+
+def set_step_recorder(rec: Optional[StepTraceRecorder]) -> None:
+    global _RECORDER
+    _RECORDER = rec

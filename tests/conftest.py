@@ -173,6 +173,11 @@ _SLOW = {
     # drain-and-reroute end-to-end is the engine-heavy tail (the same
     # path also runs in the bench `fleet` stage)
     ("test_fleet.py", "test_replica_kill_drains_and_reroutes_zero_drops"),
+    # steptrace (ISSUE 20): telescoping/detector/goodput/gate tests all
+    # run fake-clock tier-1; the engine-backed train-run e2e (ledger +
+    # checkpoint + export) is the heavy tail — the same recorder also
+    # runs under every telemetry-enabled bench train stage
+    ("test_steptrace.py", "test_engine_steptrace_end_to_end"),
     ("test_device_truth.py", "test_quantized_kv_pool_ledger_footprint"),
     ("test_spec_decode.py", "test_spec_stochastic_schedule_invariance"),
     ("test_spec_decode.py", "test_spec_admission_order_invariance"),

@@ -1,0 +1,494 @@
+"""Per-step training trace (ISSUE 20): exact telescoping
+reconciliation, goodput/badput ledger, regression detection, step-log
+schema, gate + JSONL-diff tooling, hang-dump ride-along, and the
+engine-backed end-to-end (slow tier)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.telemetry import flightrec
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.steptrace import (BADPUT_BUCKETS,
+                                               COMPONENT_KEYS,
+                                               STEP_LOG_KEYS,
+                                               StepTraceRecorder)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    telemetry.shutdown()
+    yield
+    telemetry.shutdown()
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeLedger:
+    """Just the two surfaces steptrace reads: per-phase compile seconds
+    and per-executable collective content."""
+
+    def __init__(self, comm_execs=("compiled_step",)):
+        self.compile_seconds = {}
+        self._comm = set(comm_execs)
+
+    def collective_bytes_by_axis(self, name):
+        return {"dp": 1e6} if name in self._comm else {}
+
+
+def _drive_step(rec, clk, fetch=0.002, h2d=0.001, window=0.010,
+                tail=0.0005, gap_after=0.0, step=None,
+                executable="compiled_step"):
+    """One scripted train step through the recorder's engine hooks."""
+    rec.step_begin(step if step is not None else rec.steps_recorded + 1)
+    clk.advance(fetch)
+    rec.data_ready()
+    clk.advance(h2d)
+    rec.h2d_done()
+    clk.advance(window)
+    rec.dispatch_done(executable)
+    clk.advance(tail)
+    out = rec.step_end()
+    if gap_after:
+        clk.advance(gap_after)
+    return out
+
+
+def _import_report():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    return telemetry_report
+
+
+# ---------------------------------------------------------------------
+# exact telescoping
+# ---------------------------------------------------------------------
+
+def test_telescoping_exact_reconciliation():
+    """The tentpole contract: every component is what the script put
+    there, the nine components sum to step_wall exactly, and
+    recon_max_rel_err stays at float-noise level."""
+    clk, led = FakeClock(), FakeLedger()
+    rec = StepTraceRecorder(capacity=32, clock=clk, ledger=lambda: led)
+    # step 1 calibrates the baseline (device_compute = full window)
+    r1 = _drive_step(rec, clk, window=0.010, gap_after=0.004)
+    assert r1.components["device_compute"] == pytest.approx(0.010)
+    assert r1.components["exposed_comm"] == 0.0
+    assert r1.components["data_wait"] == pytest.approx(0.002)
+
+    # step 2: slower window on a comm-carrying executable -> the
+    # excess over the calibrated baseline is exposed comm; the 4 ms
+    # gap since step 1 is data wait (no checkpoint pending)
+    r2 = _drive_step(rec, clk, window=0.013)
+    c = r2.components
+    assert c["device_compute"] == pytest.approx(0.010)
+    assert c["exposed_comm"] == pytest.approx(0.003)
+    assert c["data_wait"] == pytest.approx(0.004 + 0.002)
+    assert c["h2d"] == pytest.approx(0.001)
+    assert c["dispatch_overhead"] == pytest.approx(0.0005)
+    assert c["checkpoint"] == 0.0 and c["recompile"] == 0.0
+    for rec_i in (r1, r2):
+        assert sum(rec_i.components.values()) == pytest.approx(
+            rec_i.step_wall, abs=1e-12)
+        assert rec_i.recon_rel_err <= 1e-9
+    assert rec.recon_max_rel_err <= 1e-9
+    assert set(COMPONENT_KEYS) == set(r2.components)
+
+
+def test_excess_without_collectives_is_dispatch_overhead():
+    """Window excess on a collective-free executable is host jitter,
+    not exposed comm (the PR 7 charge-only-excess convention needs the
+    ledger to say the executable carries collectives at all)."""
+    clk = FakeClock()
+    rec = StepTraceRecorder(capacity=8, clock=clk,
+                            ledger=lambda: FakeLedger(comm_execs=()))
+    _drive_step(rec, clk, window=0.010)
+    r = _drive_step(rec, clk, window=0.013)
+    assert r.components["exposed_comm"] == 0.0
+    assert r.components["dispatch_overhead"] == pytest.approx(
+        0.0005 + 0.003)
+    assert sum(r.components.values()) == pytest.approx(r.step_wall)
+
+
+def test_checkpoint_stall_charged_from_gap():
+    """A checkpoint save between steps charges the NEXT step's
+    checkpoint component out of the inter-step gap; the remainder of
+    the gap stays data wait. Loads land in the restart badput bucket,
+    never the telescoping."""
+    clk, led = FakeClock(), FakeLedger()
+    rec = StepTraceRecorder(capacity=8, clock=clk, ledger=lambda: led)
+    _drive_step(rec, clk)
+    # 30 ms of checkpoint save inside a 50 ms gap
+    rec.note_checkpoint(0.030, kind="save")
+    clk.advance(0.050)
+    r = _drive_step(rec, clk, fetch=0.001)
+    assert r.components["checkpoint"] == pytest.approx(0.030)
+    assert r.components["data_wait"] == pytest.approx(0.020 + 0.001)
+    assert sum(r.components.values()) == pytest.approx(r.step_wall)
+    rec.note_checkpoint(0.2, kind="load")
+    bad = rec.goodput_summary()["badput_seconds"]
+    assert bad["checkpoint"] == pytest.approx(0.030)
+    assert bad["restart"] == pytest.approx(0.2)
+
+
+def test_recompile_and_offload_charged_inside_window():
+    """Compile seconds accrued during the step (the jax.monitoring
+    listener feeding the ledger) and host optimizer time (note_offload)
+    are carved out of the dispatch window before the device baseline is
+    calibrated — a mid-run retrace never pollutes device_compute."""
+    clk, led = FakeClock(), FakeLedger()
+    rec = StepTraceRecorder(capacity=8, clock=clk, ledger=lambda: led)
+    # warmup step compiles: 40 ms of the 50 ms window is backend compile
+    rec.step_begin(1)
+    clk.advance(0.002)
+    rec.data_ready()
+    clk.advance(0.001)
+    rec.h2d_done()
+    led.compile_seconds["backend_compile"] = 0.040
+    clk.advance(0.050)
+    rec.dispatch_done("compiled_step")
+    clk.advance(0.0005)
+    r1 = rec.step_end()
+    assert r1.components["recompile"] == pytest.approx(0.040)
+    assert r1.components["device_compute"] == pytest.approx(0.010)
+    # steady step with 3 ms of host optimizer inside the window
+    rec.step_begin(2)
+    clk.advance(0.002)
+    rec.data_ready()
+    clk.advance(0.001)
+    rec.h2d_done()
+    rec.note_offload(0.003)
+    clk.advance(0.013)
+    rec.dispatch_done("compiled_step")
+    clk.advance(0.0005)
+    r2 = rec.step_end()
+    assert r2.components["recompile"] == 0.0
+    assert r2.components["optimizer"] == pytest.approx(0.003)
+    assert r2.components["device_compute"] == pytest.approx(0.010)
+    for r in (r1, r2):
+        assert sum(r.components.values()) == pytest.approx(r.step_wall)
+    assert rec.recon_max_rel_err <= 1e-9
+
+
+# ---------------------------------------------------------------------
+# goodput / badput ledger
+# ---------------------------------------------------------------------
+
+def test_goodput_badput_ledger():
+    clk, led = FakeClock(), FakeLedger()
+    led.compile_seconds["backend_compile"] = 0.5
+    rec = StepTraceRecorder(capacity=64, clock=clk, ledger=lambda: led)
+    for _ in range(10):
+        _drive_step(rec, clk, gap_after=0.001)
+    rec.note_straggler(0.02)
+    rec.note_overflow_total(2)
+    s = rec.goodput_summary()
+    assert s["steps"] == 10
+    assert tuple(sorted(s["badput_seconds"])) == tuple(
+        sorted(BADPUT_BUCKETS))
+    bad = s["badput_seconds"]
+    assert bad["compile"] == pytest.approx(0.5)
+    assert bad["straggler"] == pytest.approx(0.02)
+    # overflow charged at the mean step wall; data_wait sums the
+    # per-step components (9 inter-step gaps land on steps 2..10)
+    assert bad["overflow"] == pytest.approx(
+        2 * s["wall_s"] and 2 * (10 * 0.0135 + 9 * 0.001) / 10, rel=0.1)
+    assert bad["data_wait"] == pytest.approx(10 * 0.002 + 9 * 0.001)
+    # productive device seconds discount the overflow-wasted steps
+    assert 0.0 < s["goodput_fraction"] < 1.0
+    assert s["productive_device_s"] == pytest.approx(8 * 0.010)
+
+
+# ---------------------------------------------------------------------
+# regression detection
+# ---------------------------------------------------------------------
+
+def test_regression_finding_names_component_and_executable():
+    """Acceptance: a seeded slow component produces a finding naming
+    that component, its owning executable, and the step index — and
+    bumps the regressions counter with the component label."""
+    clk, led, reg = FakeClock(), FakeLedger(), MetricsRegistry()
+    rec = StepTraceRecorder(capacity=128, clock=clk, registry=reg,
+                            ledger=lambda: led, regression_window=4,
+                            regression_threshold=0.3)
+    for i in range(24):
+        _drive_step(rec, clk, window=0.010 if i < 16 else 0.014)
+    findings = rec.regressions()
+    hit = next(f for f in findings if f["component"] == "exposed_comm")
+    assert hit["executable"] == "compiled_step"
+    assert hit["step"] > 16
+    assert hit["recent_mean_s"] > hit["base_mean_s"]
+    assert reg.counter("ds_steptrace_regressions_total").value(
+        component="exposed_comm") >= 1
+    # re-baseline after a finding: one finding per shift, not one per
+    # step for the rest of the run
+    n = sum(1 for f in findings if f["component"] == "exposed_comm")
+    assert n == 1
+
+
+def test_detector_quiet_on_steady_run():
+    clk, led = FakeClock(), FakeLedger()
+    rec = StepTraceRecorder(capacity=64, clock=clk, ledger=lambda: led,
+                            regression_window=4)
+    for _ in range(32):
+        _drive_step(rec, clk)
+    assert rec.regressions() == []
+
+
+# ---------------------------------------------------------------------
+# exports: step log, chrome events, gauges, fleet rollup
+# ---------------------------------------------------------------------
+
+def test_step_log_schema_and_chrome_events(tmp_path):
+    clk, led = FakeClock(), FakeLedger()
+    rec = StepTraceRecorder(capacity=16, clock=clk, ledger=lambda: led)
+    for _ in range(3):
+        _drive_step(rec, clk, gap_after=0.001)
+    path = rec.write_step_log(str(tmp_path / "steps.jsonl"))
+    rows = [json.loads(line) for line in open(path)]
+    assert len(rows) == 3
+    for row in rows:
+        assert tuple(sorted(row)) == tuple(sorted(STEP_LOG_KEYS))
+        assert row["recon_rel_err"] <= 1e-9
+        # the ms components telescope in the log too
+        comp_ms = sum(row[f"{k}_ms"] for k in COMPONENT_KEYS)
+        assert comp_ms == pytest.approx(row["step_wall_ms"], abs=1e-3)
+    # hang-dump ride-along rows are the same schema
+    last = rec.last_steps(2)
+    assert len(last) == 2 and last[-1]["step"] == 3
+
+    events = rec.chrome_events(pid=7, epoch_ns=int(999 * 1e9))
+    names = {e["name"] for e in events}
+    assert "step 1" in names and "step/device_compute" in names
+    metas = [e for e in events if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {
+        "train steps", "train step components"}
+    slices = [e for e in events if e["ph"] == "X"]
+    assert all(e["dur"] > 0 and e["ts"] >= 0 for e in slices)
+    # the component track tiles each step slice exactly
+    step1 = next(e for e in slices if e["name"] == "step 1")
+    comp1 = [e for e in slices
+             if e["tid"] == 0x570001 and e["args"]["step"] == 1]
+    assert sum(e["dur"] for e in comp1) == pytest.approx(
+        step1["dur"], abs=1e-2)
+
+
+def test_collect_gauges_and_fleet_rollup():
+    """collect() exports the goodput/badput/recon/percentile gauges,
+    and — the FleetScope satellite — a fleet merge over the registry
+    surfaces them in the rollup's flat key space."""
+    from deepspeed_tpu.telemetry.fleet import FleetScope
+    clk, led, reg = FakeClock(), FakeLedger(), MetricsRegistry()
+    rec = StepTraceRecorder(capacity=16, clock=clk, registry=reg,
+                            ledger=lambda: led)
+    for _ in range(4):
+        _drive_step(rec, clk, gap_after=0.001)
+    rec.collect(reg)
+    assert 0.0 < reg.gauge("ds_train_goodput_fraction").value() <= 1.0
+    for bucket in BADPUT_BUCKETS:
+        assert reg.gauge("ds_train_badput_seconds").value(
+            bucket=bucket) >= 0.0
+    assert reg.gauge("ds_steptrace_recon_max_rel_err").value() <= 1e-6
+    assert reg.gauge("ds_steptrace_steps").value() == 4
+    assert reg.gauge("ds_train_step_component_p99_seconds").value(
+        component="device_compute") == pytest.approx(0.010)
+
+    scope = FleetScope()
+    scope.add_replica("r0", reg)
+    flat = scope.merge()["fleet_flat"]
+    assert any("ds_train_goodput_fraction" in k for k in flat)
+    assert any("ds_train_badput_seconds" in k and "bucket=data_wait" in k
+               for k in flat)
+
+
+def test_configure_wires_steptrace_and_export_writes_step_log(tmp_path):
+    """Default-on wiring (like reqtrace): plain configure() installs
+    the recorder, export_artifacts writes the step log + step tracks,
+    clear() resets it, shutdown() drops it."""
+    telemetry.configure()
+    st = telemetry.get_step_recorder()
+    assert st is not None
+    st.step_begin(1)
+    st.data_ready()
+    st.h2d_done()
+    st.dispatch_done()
+    st.step_end()
+    paths = telemetry.export_artifacts(str(tmp_path), prefix="st")
+    assert os.path.exists(paths["step_log"])
+    doc = json.load(open(paths["trace"]))
+    assert any(e.get("name", "").startswith("step ")
+               for e in doc["traceEvents"])
+    snap = json.load(open(paths["metrics_json"]))
+    assert "ds_train_goodput_fraction" in snap
+    telemetry.clear()
+    assert telemetry.get_step_recorder().steps_recorded == 0
+    telemetry.shutdown()
+    assert telemetry.get_step_recorder() is None
+
+
+def test_hang_dump_rides_last_steps(tmp_path):
+    """The satellite contract: a hang dump carries the last N step
+    records, the goodput summary, and any regression findings."""
+    clk, led = FakeClock(), FakeLedger()
+    rec = StepTraceRecorder(capacity=16, clock=clk, ledger=lambda: led)
+    for _ in range(5):
+        _drive_step(rec, clk)
+    path = flightrec.dump_state("test", str(tmp_path), steptrace=rec)
+    doc = json.load(open(path))
+    sect = doc["steptrace"]
+    assert len(sect["last_steps"]) == 5
+    assert sect["last_steps"][-1]["step"] == 5
+    assert sect["goodput"]["steps"] == 5
+    assert sect["regressions"] == []
+
+
+# ---------------------------------------------------------------------
+# straggler promotion (satellite)
+# ---------------------------------------------------------------------
+
+def test_maybe_record_straggler_skew_rate_limit():
+    reg = MetricsRegistry()
+    calls = []
+
+    def fake_reduce(value, op):
+        calls.append(op)
+        return value
+
+    flightrec._SKEW_NEXT = 0.0
+    s1 = flightrec.maybe_record_straggler_skew(
+        reg, 1, interval_s=1.0, monotonic_now=10.0,
+        reduce_fn=fake_reduce)
+    assert s1 == 0.0 and len(calls) == 2
+    # inside the interval: no collective, no sample
+    assert flightrec.maybe_record_straggler_skew(
+        reg, 2, interval_s=1.0, monotonic_now=10.5,
+        reduce_fn=fake_reduce) is None
+    assert len(calls) == 2
+    # past the interval: samples again, same gauge names as before
+    assert flightrec.maybe_record_straggler_skew(
+        reg, 3, interval_s=1.0, monotonic_now=11.1,
+        reduce_fn=fake_reduce) == 0.0
+    assert reg.gauge("ds_straggler_skew_seconds").value() == 0.0
+    assert reg.gauge("ds_straggler_last_step").value() == 3
+    flightrec._SKEW_NEXT = 0.0
+
+
+# ---------------------------------------------------------------------
+# telemetry_report: --gate train + JSONL step-log diffing (satellite)
+# ---------------------------------------------------------------------
+
+def test_gate_train_family(tmp_path):
+    tr = _import_report()
+    a = {"goodput_fraction": 0.80, "data_wait_ms_p99": 10.0,
+         "ckpt_stall_p99_ms": 5.0, "extra_executables": 0,
+         "tokens_per_sec": 1000.0, "residual_ms": 0.001}
+    good = dict(a, goodput_fraction=0.79)      # -1.2%: inside 5%
+    bad = dict(a, goodput_fraction=0.70,       # -12.5%: gates
+               data_wait_ms_p99=12.0,          # +20%: gates
+               extra_executables=1)            # zero-tolerance: gates
+    pa, pb, pc = (str(tmp_path / f"{n}.json") for n in "abc")
+    for p, doc in ((pa, a), (pb, good), (pc, bad)):
+        json.dump(doc, open(p, "w"))
+    ok = tr.diff_snapshots(pa, pb, gate="train")
+    assert ok["regressions"] == []
+    d = tr.diff_snapshots(pa, pc, gate="train")
+    flagged = {r["metric"] for r in d["regressions"]}
+    assert flagged == {"goodput_fraction", "data_wait_ms_p99",
+                       "extra_executables"}
+    # residual noise never participates in the gate
+    assert all("residual" not in r["metric"] for r in d["rows"])
+
+
+def test_diff_accepts_jsonl_step_log(tmp_path):
+    """--diff on two steptrace JSONL logs: rows aggregate per key into
+    mean/p50/p99/max so runs of different lengths diff, and the train
+    gate catches a data-wait p99 shift between them."""
+    tr = _import_report()
+
+    def write_log(path, data_wait_ms, n):
+        clk, led = FakeClock(), FakeLedger()
+        rec = StepTraceRecorder(capacity=64, clock=clk,
+                                ledger=lambda: led)
+        for _ in range(n):
+            _drive_step(rec, clk, fetch=data_wait_ms / 1e3)
+        rec.write_step_log(path)
+
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    write_log(pa, data_wait_ms=2.0, n=12)
+    write_log(pb, data_wait_ms=3.0, n=9)       # +50% data wait
+    flat = tr._load_numeric(pa)
+    assert flat["rows"] == 12.0
+    assert flat["data_wait_ms_p99"] == pytest.approx(2.0, abs=1e-3)
+    assert flat["step_wall_ms_mean"] > 0
+    d = tr.diff_snapshots(pa, pb, gate="train")
+    assert any("data_wait" in r["metric"] for r in d["regressions"])
+    # equal logs pass the gate
+    d0 = tr.diff_snapshots(pa, pa, gate="train")
+    assert d0["regressions"] == []
+    # CLI end-to-end: exit 1 on the regressed pair
+    assert tr.main([pa, pb, "--diff", "--gate", "train"]) == 1
+
+
+# ---------------------------------------------------------------------
+# engine-backed end-to-end (slow tier)
+# ---------------------------------------------------------------------
+
+def test_engine_steptrace_end_to_end(tmp_path, devices8):
+    """Acceptance on the CPU rig: a real train run (ledger on) logs
+    every step with recon_max_rel_err <= 1e-6, charges a checkpoint
+    save into the buckets, exports the step log, and keeps the
+    goodput fraction in (0, 1]."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2
+    engine, _, _, _ = ds.initialize(model=GPT2(size="tiny"), config={
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 4,
+        "telemetry": {"enabled": True, "executable_ledger": True}})
+    st = telemetry.get_step_recorder()
+    assert st is not None
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (16, 17), 0, 512)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    for _ in range(6):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    engine.train_batch(batch)
+
+    assert st.steps_recorded == 7
+    assert st.recon_max_rel_err <= 1e-6
+    s = st.goodput_summary()
+    assert 0.0 < s["goodput_fraction"] <= 1.0
+    assert s["badput_seconds"]["checkpoint"] > 0.0
+    # the warmup compile landed in the recompile component (the ledger
+    # fed the compile-event listener), not in the device baseline
+    first = st.completed()[0]
+    steady = st.completed()[-1]
+    assert first.components["recompile"] > 0.0
+    assert steady.components["device_compute"] <= \
+        first.components["device_compute"] + first.components["recompile"]
+    # the step AFTER the save carries the checkpoint stall
+    post_ckpt = st.completed()[6]
+    assert post_ckpt.components["checkpoint"] > 0.0
+    paths = telemetry.export_artifacts(str(tmp_path), prefix="e2e")
+    rows = [json.loads(line) for line in open(paths["step_log"])]
+    assert len(rows) == 7
+    assert all(r["recon_rel_err"] <= 1e-6 for r in rows)
+    assert max(r["step"] for r in rows) == 7

@@ -555,7 +555,7 @@ assert "deepspeed_tpu.telemetry" not in sys.modules, \
     "telemetry was imported on the disabled path"
 assert "deepspeed_tpu.telemetry.reqtrace" not in sys.modules, \
     "reqtrace was imported on the disabled path"
-for mod in ("timeseries", "health", "fleet"):
+for mod in ("timeseries", "health", "fleet", "steptrace"):
     assert f"deepspeed_tpu.telemetry.{mod}" not in sys.modules, \
         f"{mod} was imported on the disabled path"
 print("GUARD_OK")

@@ -24,7 +24,9 @@ no jax import anywhere):
    traced) + ``telemetry/reqtrace.py`` (the
    request-trace recorder runs on the event loop) +
    ``telemetry/{timeseries,health,fleet}.py`` (the ISSUE 17 fleet
-   health plane is stdlib-only host logic), and
+   health plane is stdlib-only host logic) +
+   ``telemetry/steptrace.py`` (the ISSUE 20 per-step training trace
+   is a stdlib shell on the train loop's host side), and
    ``analysis/numsan.py`` (the sanitizer shell is host-side state
    keeping; its in-graph probes live at the call sites).
 
@@ -124,7 +126,11 @@ def run_sections() -> list[dict]:
               # logic — stdlib-only, nothing jit-reachable
               os.path.join(_PACKAGE, "telemetry", "timeseries.py"),
               os.path.join(_PACKAGE, "telemetry", "health.py"),
-              os.path.join(_PACKAGE, "telemetry", "fleet.py")]),
+              os.path.join(_PACKAGE, "telemetry", "fleet.py"),
+              # ISSUE 20: the per-step training trace recorder is a
+              # stdlib shell — ledger/timeseries arrive as accessors,
+              # nothing jit-reachable
+              os.path.join(_PACKAGE, "telemetry", "steptrace.py")]),
             # ISSUE 18: the numsan sanitizer shell is host-side state
             # keeping — the in-graph probes live at the call sites
             # (engine, ops/pallas/quantization.py), never here

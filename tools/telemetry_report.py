@@ -135,7 +135,8 @@ def serving_summary(metrics: dict) -> dict:
            if "ds_serving_" in k or "ds_blocksan_" in k
            or "ds_affinity_" in k or "ds_meshsan_" in k
            or "ds_kv_" in k or "ds_moe_" in k or "ds_fleet_" in k
-           or "ds_numsan_" in k}
+           or "ds_numsan_" in k or "ds_steptrace_" in k
+           or "ds_train_goodput" in k or "ds_train_badput" in k}
 
     def total(stem: str):
         vals = [v for k, v in metrics.items() if stem in k
@@ -158,16 +159,28 @@ def train_summary(metrics: dict) -> dict:
     run reads as "overflow count, which finding kind, which quantize
     site" without raw snapshots. Adds a derived
     ``overflow_rate_derived`` (overflow steps / total steps) when both
-    counters are present."""
+    counters are present.
+
+    The steptrace goodput/badput table (ISSUE 20) rides the same
+    rollup: ``ds_train_goodput_fraction``,
+    ``ds_train_badput_seconds{bucket}``, the per-step component
+    p50/p99 gauges and ``ds_steptrace_*`` (recon error, step count,
+    regression findings counter) all carry the ``ds_train_`` /
+    ``ds_steptrace_`` stems, plus a derived
+    ``badput_total_seconds_derived`` sum over the buckets."""
     out = {k: v for k, v in sorted(metrics.items())
            if "ds_train_" in k or "ds_overflow_" in k
-           or "ds_numsan_" in k}
+           or "ds_numsan_" in k or "ds_steptrace_" in k}
     steps = next((v for k, v in metrics.items()
                   if "ds_train_steps_total" in k), None)
     ov = next((v for k, v in metrics.items()
                if "ds_overflow_steps_total" in k), None)
     if steps and ov is not None and steps > 0:
         out["overflow_rate_derived"] = round(ov / steps, 4)
+    badput = [v for k, v in metrics.items()
+              if "ds_train_badput_seconds" in k]
+    if badput:
+        out["badput_total_seconds_derived"] = round(sum(badput), 6)
     return out
 
 
@@ -536,6 +549,23 @@ _GATES = {
         ("extra_executables", -1, 0.0),
         ("tokens_per_sec", +1, 0.05),
     ),
+    # train gate (ISSUE 20, steptrace): run goodput must not shrink,
+    # the host-overhead legs of the step telescoping (data wait,
+    # checkpoint stall) must not creep up — the stems match the
+    # component p50/p99 gauges, the bench fields AND the aggregated
+    # JSONL step log (data_wait_ms_p99 etc. via _load_numeric) — and
+    # the steptrace-disabled path must keep compiling ZERO extra
+    # executables (deterministic, zero-tolerance). Throughput rides at
+    # the usual ±5%.
+    "train": (
+        ("goodput_fraction", +1, 0.05),
+        ("data_wait", -1, 0.15),
+        ("ckpt_stall", -1, 0.15),
+        ("component=checkpoint", -1, 0.15),
+        ("checkpoint_ms", -1, 0.15),
+        ("extra_executables", -1, 0.0),
+        ("tokens_per_sec", +1, 0.05),
+    ),
 }
 
 # metric families a gate must NOT touch even though a stem matches by
@@ -590,6 +620,37 @@ def _flatten_numeric(obj, prefix="") -> dict[str, float]:
     return out
 
 
+def _load_numeric(path: str) -> dict[str, float]:
+    """Numeric leaves of a snapshot file. Accepts a single JSON
+    document (registry snapshot, bench record) — or a JSONL log (the
+    steptrace step log, the reqtrace access log): JSONL rows aggregate
+    per numeric key into ``<key>_{mean,p50,p99,max}`` plus a ``rows``
+    count, so two runs of different lengths diff cleanly."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return _flatten_numeric(json.loads(text))
+    except json.JSONDecodeError:
+        pass
+    series: dict[str, list[float]] = {}
+    rows = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        rows += 1
+        for k, v in _flatten_numeric(json.loads(line)).items():
+            series.setdefault(k, []).append(v)
+    out: dict[str, float] = {"rows": float(rows)}
+    for k, vals in series.items():
+        vals.sort()
+        out[f"{k}_mean"] = sum(vals) / len(vals)
+        out[f"{k}_p50"] = vals[len(vals) // 2]
+        out[f"{k}_p99"] = vals[min(len(vals) - 1, int(len(vals) * 0.99))]
+        out[f"{k}_max"] = vals[-1]
+    return out
+
+
 def _direction(name: str) -> int:
     """+1 higher-is-better, -1 lower-is-better, 0 report-only."""
     low = name.lower()
@@ -610,10 +671,8 @@ def diff_snapshots(path_a: str, path_b: str,
     its direction-aware relative change exceeds ``threshold``. With
     ``gate`` (e.g. ``"serving"``) only the gate's metric families
     participate, each under its own per-metric threshold."""
-    with open(path_a) as f:
-        a = _flatten_numeric(json.load(f))
-    with open(path_b) as f:
-        b = _flatten_numeric(json.load(f))
+    a = _load_numeric(path_a)
+    b = _load_numeric(path_b)
     rows, regressions = [], []
     for name in sorted(set(a) & set(b)):
         va, vb = a[name], b[name]
@@ -677,8 +736,10 @@ def main(argv=None) -> int:
                     help="merge the input Chrome traces into OUT with "
                          "rank-labelled tracks")
     ap.add_argument("--diff", action="store_true",
-                    help="diff two metric-snapshot JSONs (A B); exit 1 "
-                         "on regression past --threshold")
+                    help="diff two metric snapshots (A B) — JSON "
+                         "documents or JSONL logs (steptrace step "
+                         "logs aggregate per-key mean/p50/p99/max); "
+                         "exit 1 on regression past --threshold")
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative regression threshold for --diff "
                          "(default 0.05)")
