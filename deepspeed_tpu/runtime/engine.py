@@ -971,9 +971,11 @@ class DeepSpeedEngine:
         if tel is not None:
             self._telemetry_boundary(tel, metrics)
             if jax.process_count() > 1:
-                # per-step straggler cadence (ISSUE 20): rate-limited
-                # inside, so the two tiny host collectives run at most
-                # once per straggler_interval_s; the sample feeds both
+                # per-step straggler cadence (ISSUE 20): step-stride
+                # rate-limited inside (the stride derives only from
+                # cross-rank-identical inputs, so every rank joins the
+                # two tiny host collectives at the same step, roughly
+                # once per straggler_interval_s); the sample feeds both
                 # the skew gauge and the steptrace straggler bucket
                 skew = tel.flightrec.maybe_record_straggler_skew(
                     tel.get_registry(), self.global_steps,

@@ -219,6 +219,7 @@ def shutdown() -> None:
     _BURN_WINDOWS_S = _timeseries_mod.DEFAULT_BURN_WINDOWS_S
     _flightrec_mod.set_watchdog(None)
     _flightrec_mod.set_flight_recorder(None)
+    _flightrec_mod.reset_straggler_gate()
     _ledger_mod.set_ledger(None)
     _steptrace_mod.set_step_recorder(None)
     _reqtrace_mod.set_request_recorder(None)
@@ -241,6 +242,7 @@ def clear() -> None:
     fr = get_flight_recorder()
     if fr is not None:
         fr.clear()
+    _flightrec_mod.reset_straggler_gate()
     rt = get_request_recorder()
     if rt is not None:
         rt.clear()

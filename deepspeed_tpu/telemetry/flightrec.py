@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import math
 import os
 import threading
 import time
@@ -113,12 +114,12 @@ def skew_from_timestamps(timestamps) -> float:
     return max(ts) - min(ts)
 
 
-def record_straggler_skew(reg, step: int, now: Optional[float] = None,
-                          reduce_fn=None) -> float:
-    """Host-all-reduce this rank's step timestamp and expose the
-    cross-rank spread as ``ds_straggler_skew_seconds``. Costs two tiny
-    host collectives — call at flush boundaries only. Returns the skew
-    (0.0 single-process, where no collective runs)."""
+def _sample_skew(reg, step: int, now: Optional[float] = None,
+                 reduce_fn=None) -> tuple:
+    """One skew sample: two host all-reduces (MIN, MAX) over this
+    rank's timestamp. Returns ``(skew, lo)`` — ``lo`` is the
+    MIN-reduced timestamp, identical on every rank, which the step
+    gate uses to schedule the next sample deterministically."""
     if reduce_fn is None:
         from .. import comm as dist
         reduce_fn = dist.host_all_reduce
@@ -133,29 +134,83 @@ def record_straggler_skew(reg, step: int, now: Optional[float] = None,
                   "(max - min over processes)").set(skew)
         reg.gauge("ds_straggler_last_step",
                   "step the skew gauge was sampled at").set(step)
-    return skew
+    return skew, lo
 
 
-_SKEW_NEXT = 0.0
+def record_straggler_skew(reg, step: int, now: Optional[float] = None,
+                          reduce_fn=None) -> float:
+    """Host-all-reduce this rank's step timestamp and expose the
+    cross-rank spread as ``ds_straggler_skew_seconds``. Costs two tiny
+    host collectives — call at flush boundaries only. Returns the skew
+    (0.0 single-process, where no collective runs)."""
+    return _sample_skew(reg, step, now=now, reduce_fn=reduce_fn)[0]
+
+
+class _SkewGate:
+    """Deterministic cross-rank gate for the per-step straggler
+    cadence. Participation in the two host collectives MUST be decided
+    from quantities every rank agrees on — the step counter and the
+    MIN-reduced timestamp of the previous sample — never a per-process
+    clock, which would let ranks disagree near an interval boundary
+    (rank A samples at step N, rank B at step N+1) and desynchronize
+    the collective call sequence: mismatched reduces corrupt the skew
+    and every later host collective, or hang the job."""
+
+    __slots__ = ("next_step", "prev_step", "prev_lo")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.next_step = None     # None -> sample on the first call
+        self.prev_step = None
+        self.prev_lo = None
+
+
+_SKEW_GATE = _SkewGate()
+
+
+def reset_straggler_gate() -> None:
+    """Drop the straggler gate's schedule (telemetry ``shutdown()`` /
+    ``clear()``) so the cadence starts clean for the next engine or
+    test in this process."""
+    _SKEW_GATE.reset()
 
 
 def maybe_record_straggler_skew(reg, step: int,
                                 interval_s: float = 1.0,
-                                monotonic_now: Optional[float] = None,
                                 now: Optional[float] = None,
-                                reduce_fn=None) -> Optional[float]:
+                                reduce_fn=None,
+                                gate: Optional[_SkewGate] = None
+                                ) -> Optional[float]:
     """Rate-limited :func:`record_straggler_skew` for a per-step call
     cadence (ISSUE 20): the engine ticks this every ``train_batch``
     (same ``process_count > 1`` guard as before) and the two tiny host
-    collectives actually run at most once per ``interval_s``. Same
-    ``ds_straggler_skew_seconds`` gauge. Returns the skew when a sample
-    was taken, None when inside the interval."""
-    global _SKEW_NEXT
-    t = time.monotonic() if monotonic_now is None else monotonic_now
-    if t < _SKEW_NEXT:
+    collectives actually run roughly once per ``interval_s``. The gate
+    is a step stride derived only from cross-rank-identical inputs
+    (the step counter and the MIN-reduced sample timestamps), so every
+    rank takes the same sample/skip decision at the same step — see
+    :class:`_SkewGate`. Same ``ds_straggler_skew_seconds`` gauge.
+    Returns the skew when a sample was taken, None when inside the
+    stride."""
+    g = _SKEW_GATE if gate is None else gate
+    step = int(step)
+    if g.next_step is not None and step < g.next_step:
         return None
-    _SKEW_NEXT = t + max(float(interval_s), 0.0)
-    return record_straggler_skew(reg, step, now=now, reduce_fn=reduce_fn)
+    skew, lo = _sample_skew(reg, step, now=now, reduce_fn=reduce_fn)
+    # convert interval_s into a step stride from the steps/sec between
+    # the last two samples; both inputs (step delta, reduced-timestamp
+    # delta) are identical on every rank, so next_step is too
+    iv = max(float(interval_s), 0.0)
+    if (g.prev_lo is not None and lo > g.prev_lo
+            and step > g.prev_step):
+        rate = (step - g.prev_step) / (lo - g.prev_lo)
+        stride = max(int(math.ceil(iv * rate)), 1)
+    else:
+        stride = 1
+    g.prev_step, g.prev_lo = step, lo
+    g.next_step = step + stride
+    return skew
 
 
 # --- hang dump -----------------------------------------------------------

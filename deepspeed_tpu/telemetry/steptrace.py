@@ -10,8 +10,8 @@ still diagnosed by hand. This module records one host-side record per
 ``engine.train_batch`` and derives an EXACT telescoping decomposition::
 
     step_wall = data_wait + h2d + dispatch_overhead + device_compute
-              + exposed_comm + optimizer + checkpoint + recompile
-              + residual
+              + exposed_comm + optimizer + checkpoint + restart
+              + recompile + residual
 
 where ``step_wall`` spans from the PREVIOUS step's end (so checkpoint
 saves and data stalls between steps are inside the telescoping, not
@@ -30,9 +30,11 @@ checkable from artifacts alone.
 On top of the per-step records:
 
 - a run-level **goodput/badput ledger**: goodput fraction = productive
-  device seconds / wall, badput bucketed into ``compile``, ``overflow``
-  (skipped steps via ``ds_overflow_steps_total``), ``checkpoint``,
-  ``data_wait``, ``straggler`` (cross-rank skew samples) and
+  device seconds / wall, badput bucketed into ``compile`` (compile
+  seconds accrued since the run's first step — pre-run AOT/serving
+  builds never charge the training wall), ``overflow`` (skipped steps
+  via ``ds_overflow_steps_total``), ``checkpoint``, ``data_wait``,
+  ``straggler`` (cross-rank skew samples) and
   ``restart`` (checkpoint loads), exported as
   ``ds_train_goodput_fraction`` + ``ds_train_badput_seconds{bucket}``;
 - a JSONL **step log** with the stable :data:`STEP_LOG_KEYS` schema
@@ -64,7 +66,7 @@ from typing import Callable, Optional
 # component track lays them out sequentially in exactly this order)
 COMPONENT_KEYS = ("data_wait", "h2d", "dispatch_overhead",
                   "device_compute", "exposed_comm", "optimizer",
-                  "checkpoint", "recompile", "residual")
+                  "checkpoint", "restart", "recompile", "residual")
 
 # one JSONL step-log line per finalized step — the stable schema
 # consumers (and the schema test) hold on to. *_ms components
@@ -74,8 +76,8 @@ COMPONENT_KEYS = ("data_wait", "h2d", "dispatch_overhead",
 STEP_LOG_KEYS = ("step", "unix_s", "executable", "step_wall_ms",
                  "data_wait_ms", "h2d_ms", "dispatch_overhead_ms",
                  "device_compute_ms", "exposed_comm_ms", "optimizer_ms",
-                 "checkpoint_ms", "recompile_ms", "residual_ms",
-                 "straggler_skew_ms", "recon_rel_err")
+                 "checkpoint_ms", "restart_ms", "recompile_ms",
+                 "residual_ms", "straggler_skew_ms", "recon_rel_err")
 
 # run-level badput buckets (seconds) — see goodput_summary()
 BADPUT_BUCKETS = ("compile", "overflow", "checkpoint", "data_wait",
@@ -179,6 +181,12 @@ class StepTraceRecorder:
         self._has_comm: dict[str, bool] = {}
         # charges accumulated between/inside steps
         self._pending_ckpt = 0.0
+        self._pending_restart = 0.0
+        # compile seconds already on the listener's books when the
+        # run's first step began — the badput `compile` bucket charges
+        # the delta since, so pre-run AOT/eval/serving builds never
+        # count against the training wall
+        self._compile_at_run_start = 0.0
         # run-level accounting (survives ring eviction)
         self._n_steps = 0
         self._wall_s_total = 0.0
@@ -242,10 +250,12 @@ class StepTraceRecorder:
         """``train_batch`` entered (before the data fetch)."""
         now = self._clock()
         with self._lock:
+            compile_now = self._compile_total()
             if self._run_start is None:
                 self._run_start = now
+                self._compile_at_run_start = compile_now
             self._cur = _Pending(int(step), now, time.time(),
-                                 self._compile_total())
+                                 compile_now)
 
     def data_ready(self) -> None:
         """The batch is in hand (``next(data_iter)`` returned / the
@@ -272,15 +282,18 @@ class StepTraceRecorder:
         """A checkpoint save/load took ``seconds``. Saves charge the
         ``checkpoint`` telescoping component of the NEXT step (the stall
         sits in the inter-step gap) and the ``checkpoint`` badput
-        bucket; loads charge the ``restart`` bucket (a load mid-run IS
-        the restart cost elasticity pays)."""
+        bucket; loads charge the ``restart`` telescoping component and
+        badput bucket (a load mid-run IS the restart cost elasticity
+        pays) — save and restart stalls never conflate, so the train
+        gate's checkpoint stems only see saves."""
         s = max(float(seconds), 0.0)
         with self._lock:
             if kind == "load":
                 self._restart_s_total += s
+                self._pending_restart += s
             else:
                 self._ckpt_s_total += s
-            self._pending_ckpt += s
+                self._pending_ckpt += s
 
     def note_offload(self, seconds: float) -> None:
         """Host-side optimizer/offload work inside the current step's
@@ -316,7 +329,10 @@ class StepTraceRecorder:
                 return None
             rec = self._finalize(cur, now)
             self._done.append(rec)
-        self._detect(rec)
+            # detection mutates _history/_findings, which clear()
+            # resets under this same lock — keep it inside (it is
+            # O(components x window) on floats, cheap)
+            self._detect(rec)
         ts_fn = self._timeseries_fn
         ring = ts_fn() if callable(ts_fn) else None
         if ring is not None:
@@ -337,11 +353,14 @@ class StepTraceRecorder:
         tail = max(now - cur.t_disp, 0.0)
         step_wall = gap + fetch + h2d + window + tail
 
-        # inter-step gap: the checkpoint stall first, data wait takes
-        # the rest (plus the in-step fetch)
+        # inter-step gap: the checkpoint (save) stall first, then the
+        # restart (load) stall, data wait takes the rest (plus the
+        # in-step fetch)
         ckpt = min(self._pending_ckpt, gap)
         self._pending_ckpt = max(self._pending_ckpt - ckpt, 0.0)
-        data_wait = (gap - ckpt) + fetch
+        restart = min(self._pending_restart, gap - ckpt)
+        self._pending_restart = max(self._pending_restart - restart, 0.0)
+        data_wait = (gap - ckpt - restart) + fetch
 
         # dispatch window: compile charge (the listener's per-phase
         # seconds delta across the step — first-sight ledger AOT
@@ -375,7 +394,8 @@ class StepTraceRecorder:
             "dispatch_overhead": dispatch_overhead,
             "device_compute": device_compute,
             "exposed_comm": exposed_comm, "optimizer": optimizer,
-            "checkpoint": ckpt, "recompile": recompile}
+            "checkpoint": ckpt, "restart": restart,
+            "recompile": recompile}
         components["residual"] = step_wall - sum(components.values())
         recon = (abs(step_wall - sum(components.values()))
                  / max(step_wall, 1e-12))
@@ -435,9 +455,13 @@ class StepTraceRecorder:
     def goodput_summary(self, now: Optional[float] = None) -> dict:
         """Run-level ledger: goodput fraction = productive device
         seconds / wall since the first step; badput bucketed per
-        :data:`BADPUT_BUCKETS`. The ``overflow`` bucket charges the
-        skipped-step count (``ds_overflow_steps_total``) at the mean
-        step wall — the whole step was spent to apply nothing."""
+        :data:`BADPUT_BUCKETS`. The ``compile`` bucket is the compile
+        seconds accrued SINCE the run's first step (delta over the
+        listener's books at run start — pre-run AOT/eval/serving
+        builds never charge the training wall). The ``overflow``
+        bucket charges the skipped-step count
+        (``ds_overflow_steps_total``) at the mean step wall — the
+        whole step was spent to apply nothing."""
         with self._lock:
             n = self._n_steps
             if n == 0 or self._run_start is None:
@@ -454,8 +478,8 @@ class StepTraceRecorder:
             productive = max(self._device_s_total
                              - self._overflow_total * mean_dev, 0.0)
             badput = {
-                "compile": (self._compile_total()
-                            or self._recompile_s_total),
+                "compile": max(self._compile_total()
+                               - self._compile_at_run_start, 0.0),
                 "overflow": overflow_s,
                 "checkpoint": self._ckpt_s_total,
                 "data_wait": self._data_wait_s_total,
@@ -607,6 +631,8 @@ class StepTraceRecorder:
             self._baseline.clear()
             self._has_comm.clear()
             self._pending_ckpt = 0.0
+            self._pending_restart = 0.0
+            self._compile_at_run_start = 0.0
             self._n_steps = 0
             self._wall_s_total = 0.0
             self._device_s_total = 0.0

@@ -83,7 +83,7 @@ def _import_report():
 
 def test_telescoping_exact_reconciliation():
     """The tentpole contract: every component is what the script put
-    there, the nine components sum to step_wall exactly, and
+    there, the ten components sum to step_wall exactly, and
     recon_max_rel_err stays at float-noise level."""
     clk, led = FakeClock(), FakeLedger()
     rec = StepTraceRecorder(capacity=32, clock=clk, ledger=lambda: led)
@@ -130,8 +130,9 @@ def test_excess_without_collectives_is_dispatch_overhead():
 def test_checkpoint_stall_charged_from_gap():
     """A checkpoint save between steps charges the NEXT step's
     checkpoint component out of the inter-step gap; the remainder of
-    the gap stays data wait. Loads land in the restart badput bucket,
-    never the telescoping."""
+    the gap stays data wait. Loads charge the separate restart
+    component + badput bucket — a restart stall never inflates the
+    checkpoint (save) stems the train gate watches."""
     clk, led = FakeClock(), FakeLedger()
     rec = StepTraceRecorder(capacity=8, clock=clk, ledger=lambda: led)
     _drive_step(rec, clk)
@@ -140,12 +141,23 @@ def test_checkpoint_stall_charged_from_gap():
     clk.advance(0.050)
     r = _drive_step(rec, clk, fetch=0.001)
     assert r.components["checkpoint"] == pytest.approx(0.030)
+    assert r.components["restart"] == 0.0
     assert r.components["data_wait"] == pytest.approx(0.020 + 0.001)
     assert sum(r.components.values()) == pytest.approx(r.step_wall)
+    # 200 ms of checkpoint load (mid-run restart) inside a 250 ms gap
     rec.note_checkpoint(0.2, kind="load")
+    clk.advance(0.250)
+    r2 = _drive_step(rec, clk, fetch=0.001)
+    assert r2.components["restart"] == pytest.approx(0.2)
+    assert r2.components["checkpoint"] == 0.0
+    assert r2.components["data_wait"] == pytest.approx(0.050 + 0.001)
+    assert sum(r2.components.values()) == pytest.approx(r2.step_wall)
     bad = rec.goodput_summary()["badput_seconds"]
     assert bad["checkpoint"] == pytest.approx(0.030)
     assert bad["restart"] == pytest.approx(0.2)
+    # restart gap never leaks into the data-wait badput bucket
+    assert bad["data_wait"] == pytest.approx(
+        0.002 + (0.020 + 0.001) + (0.050 + 0.001))
 
 
 def test_recompile_and_offload_charged_inside_window():
@@ -193,10 +205,14 @@ def test_recompile_and_offload_charged_inside_window():
 
 def test_goodput_badput_ledger():
     clk, led = FakeClock(), FakeLedger()
+    # 0.5 s of PRE-run compile (AOT / serving builds before the first
+    # step): never charged to the training wall's compile bucket
     led.compile_seconds["backend_compile"] = 0.5
     rec = StepTraceRecorder(capacity=64, clock=clk, ledger=lambda: led)
     for _ in range(10):
         _drive_step(rec, clk, gap_after=0.001)
+    # +0.2 s of compile accrued inside the run window
+    led.compile_seconds["backend_compile"] = 0.7
     rec.note_straggler(0.02)
     rec.note_overflow_total(2)
     s = rec.goodput_summary()
@@ -204,7 +220,7 @@ def test_goodput_badput_ledger():
     assert tuple(sorted(s["badput_seconds"])) == tuple(
         sorted(BADPUT_BUCKETS))
     bad = s["badput_seconds"]
-    assert bad["compile"] == pytest.approx(0.5)
+    assert bad["compile"] == pytest.approx(0.2)
     assert bad["straggler"] == pytest.approx(0.02)
     # overflow charged at the mean step wall; data_wait sums the
     # per-step components (9 inter-step gaps land on steps 2..10)
@@ -363,7 +379,12 @@ def test_hang_dump_rides_last_steps(tmp_path):
 # straggler promotion (satellite)
 # ---------------------------------------------------------------------
 
-def test_maybe_record_straggler_skew_rate_limit():
+def test_maybe_record_straggler_skew_step_stride_gate():
+    """The per-step cadence gates on a step stride derived ONLY from
+    cross-rank-identical inputs (the step counter and the MIN-reduced
+    sample timestamps) — never a per-process clock, which could let
+    ranks disagree near an interval boundary and desync the host
+    collective sequence."""
     reg = MetricsRegistry()
     calls = []
 
@@ -371,23 +392,75 @@ def test_maybe_record_straggler_skew_rate_limit():
         calls.append(op)
         return value
 
-    flightrec._SKEW_NEXT = 0.0
+    gate = flightrec._SkewGate()
+    # the first call always samples (two collectives: MIN + MAX)
     s1 = flightrec.maybe_record_straggler_skew(
-        reg, 1, interval_s=1.0, monotonic_now=10.0,
-        reduce_fn=fake_reduce)
+        reg, 1, interval_s=1.0, now=10.0, reduce_fn=fake_reduce,
+        gate=gate)
     assert s1 == 0.0 and len(calls) == 2
-    # inside the interval: no collective, no sample
+    # the second sample calibrates the stride: 2 steps/s x 1 s -> 2
     assert flightrec.maybe_record_straggler_skew(
-        reg, 2, interval_s=1.0, monotonic_now=10.5,
-        reduce_fn=fake_reduce) is None
-    assert len(calls) == 2
-    # past the interval: samples again, same gauge names as before
+        reg, 2, interval_s=1.0, now=10.5, reduce_fn=fake_reduce,
+        gate=gate) == 0.0
+    assert len(calls) == 4 and gate.next_step == 4
+    # inside the stride: no collective, no sample — regardless of the
+    # local clock
     assert flightrec.maybe_record_straggler_skew(
-        reg, 3, interval_s=1.0, monotonic_now=11.1,
-        reduce_fn=fake_reduce) == 0.0
+        reg, 3, interval_s=1.0, now=99.0, reduce_fn=fake_reduce,
+        gate=gate) is None
+    assert len(calls) == 4
+    # at the stride boundary: samples again, same gauge names
+    assert flightrec.maybe_record_straggler_skew(
+        reg, 4, interval_s=1.0, now=11.5, reduce_fn=fake_reduce,
+        gate=gate) == 0.0
     assert reg.gauge("ds_straggler_skew_seconds").value() == 0.0
-    assert reg.gauge("ds_straggler_last_step").value() == 3
-    flightrec._SKEW_NEXT = 0.0
+    assert reg.gauge("ds_straggler_last_step").value() == 4
+
+
+def test_straggler_gate_lockstep_across_ranks():
+    """Two ranks with skewed local clocks take identical sample/skip
+    decisions at every step: participation in the two host collectives
+    never depends on a per-process clock (the wall-clock gate this
+    replaces could sample at step N on one rank and N+1 on another,
+    desynchronizing every later collective)."""
+    from deepspeed_tpu.comm.comm import ReduceOp
+    g0, g1 = flightrec._SkewGate(), flightrec._SkewGate()
+    t0, t1 = 100.0, 100.3          # rank wall clocks, 300 ms apart
+
+    def reduce_for(a, b):
+        def fn(value, op):
+            return min(a, b) if op == ReduceOp.MIN else max(a, b)
+        return fn
+
+    samples = 0
+    for step in range(1, 40):
+        # ~70 ms per step with per-rank jitter around the boundary
+        t0 += 0.07
+        t1 += 0.07 + (0.010 if step % 3 == 0 else -0.005)
+        fn = reduce_for(t0, t1)
+        s0 = flightrec.maybe_record_straggler_skew(
+            None, step, interval_s=0.25, now=t0, reduce_fn=fn, gate=g0)
+        s1 = flightrec.maybe_record_straggler_skew(
+            None, step, interval_s=0.25, now=t1, reduce_fn=fn, gate=g1)
+        assert (s0 is None) == (s1 is None)
+        if s0 is not None:
+            samples += 1
+            assert s0 == pytest.approx(s1)
+            assert g0.next_step == g1.next_step
+    # the stride actually rate-limits (~0.25 s / ~0.07 s-per-step)
+    assert 2 <= samples < 20
+
+
+def test_straggler_gate_reset_on_clear_and_shutdown():
+    """The module-level gate never leaks its schedule across
+    configure/shutdown cycles or between tests in one process."""
+    g = flightrec._SKEW_GATE
+    g.next_step, g.prev_step, g.prev_lo = 100, 50, 1.0
+    telemetry.clear()
+    assert g.next_step is None and g.prev_lo is None
+    g.next_step, g.prev_step, g.prev_lo = 100, 50, 1.0
+    telemetry.shutdown()
+    assert g.next_step is None and g.prev_lo is None
 
 
 # ---------------------------------------------------------------------
